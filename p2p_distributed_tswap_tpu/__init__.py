@@ -1,0 +1,26 @@
+"""p2p_distributed_tswap_tpu — a TPU-native framework for large-scale Multi-Agent
+Pickup and Delivery (MAPD) with the TSWAP target-swapping algorithm.
+
+This is a ground-up JAX/XLA/Pallas redesign of the capabilities of the reference
+system ``RenKoya1/p2p_distributed_tswap`` (a Rust + libp2p process fleet): the
+per-agent A* + message-passing solver becomes a batched kernel over dense agent /
+grid tensors, sharded across TPU chips with ``shard_map`` and ICI collectives,
+while a native C++ host runtime (under ``cpp/``) reproduces the reference's
+manager/agent process roles, pub/sub wire protocol, operator CLI, and CSV metrics.
+
+Package layout
+--------------
+- ``core``     — domain model: grids, map IO, tasks, sampling, config (ref ``src/map/``)
+- ``ops``      — array kernels: BFS distance / direction fields (fast-sweeping scans)
+- ``solver``   — TSWAP step kernels + offline MAPD loop (ref ``src/algorithm/``)
+- ``parallel`` — device meshes, shard_map solver, collectives
+- ``metrics``  — task / path / network metrics with reference-compatible CSV schemas
+- ``runtime``  — Python side of the host runtime (bus client, solver daemon)
+- ``models``   — benchmark scenario/config ladder (flagship configs)
+- ``utils``    — small shared helpers
+"""
+
+__version__ = "0.1.0"
+
+from p2p_distributed_tswap_tpu.core.grid import Grid  # noqa: F401
+from p2p_distributed_tswap_tpu.core.tasks import Task, TaskGenerator  # noqa: F401

@@ -1,0 +1,14 @@
+from p2p_distributed_tswap_tpu.core.grid import Grid, DEFAULT_MAP_ASCII
+from p2p_distributed_tswap_tpu.core.tasks import Task, TaskGenerator
+from p2p_distributed_tswap_tpu.core.agent import AgentPhase, AgentState
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+
+__all__ = [
+    "Grid",
+    "DEFAULT_MAP_ASCII",
+    "Task",
+    "TaskGenerator",
+    "AgentPhase",
+    "AgentState",
+    "SolverConfig",
+]

@@ -1,0 +1,78 @@
+"""Configuration system.
+
+The reference scatters its knobs across compile-time constants, one CLI flag and
+two env vars (SURVEY §5: TSWAP_RADIUS=15 at src/bin/decentralized/agent.rs:796,
+planning interval 500 ms at src/bin/centralized/manager.rs:567, timestep cap
+2000 at src/algorithm/tswap.rs:167, memory caps, gossipsub tunings, --clean,
+TASK_CSV_PATH/PATH_CSV_PATH).  Here every knob lives in explicit frozen
+dataclasses: ``SolverConfig`` is hashable and passed as a static jit argument
+(shapes and loop bounds must be compile-time constants under XLA), and
+``RuntimeConfig`` carries the host-runtime knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Static (compile-time) solver parameters.
+
+    Hashable so it can be a `static_argnums` jit argument; every field changes
+    the compiled program (shapes or loop bounds).
+    """
+
+    height: int
+    width: int
+    num_agents: int
+    # Offline-solver horizon cap (ref src/algorithm/tswap.rs:167).
+    max_timesteps: int = 2000
+    # Max direction-field recomputations processed per replan call; fields
+    # beyond this spill to the next call. Static so replan has fixed shapes.
+    replan_chunk: int = 64
+    # Rule-4 deadlock cycles are detected exactly up to this length
+    # (ref walks unbounded chains, src/algorithm/tswap.rs:204-249; cycles
+    # longer than this simply wait and retry next step).
+    cycle_cap: int = 32
+    # Decentralized-mode visibility radius (Manhattan); None = centralized
+    # global view. Ref: TSWAP_RADIUS=15, src/bin/decentralized/agent.rs:796-801.
+    visibility_radius: Optional[int] = None
+    # Upper bound on movement-phase resolution rounds (the exact-order fixpoint
+    # finalizes >=1 agent per round; convoys resolve in a few).
+    max_move_rounds: int = 64
+    # Fast-sweeping rounds cap for distance fields (each round = 4 directional
+    # scans; fixpoint is reached much earlier on benchmark maps).
+    max_sweep_rounds: int = 128
+
+    @property
+    def num_cells(self) -> int:
+        return self.height * self.width
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Host-runtime knobs (C++ bus / manager / agents)."""
+
+    # Centralized planning tick (ref 500 ms, src/bin/centralized/manager.rs:567).
+    planning_interval_ms: int = 500
+    # Decentralized per-agent decision cadence (ref src/bin/decentralized/agent.rs:730).
+    decision_interval_ms: int = 500
+    # Periodic state cleanup (ref 30 s, src/bin/centralized/manager.rs:727).
+    cleanup_interval_ms: int = 30_000
+    # Memory caps (ref manager.rs:734,752; decentralized/manager.rs:173;
+    # decentralized/agent.rs:800-804).
+    max_tracked_agents: int = 500
+    max_tracked_peers: int = 1000
+    max_cached_positions: int = 60
+    # Neighbor-info age-out (ref 10 s, src/bin/decentralized/agent.rs:156-167).
+    neighbor_ttl_ms: int = 10_000
+    # Bus endpoint.
+    bus_host: str = "127.0.0.1"
+    bus_port: int = 7400
+    topic: str = "mapd"
+    # CSV auto-save on exit (ref env vars TASK_CSV_PATH / PATH_CSV_PATH,
+    # src/bin/decentralized/manager.rs:48-50).
+    task_csv_path: Optional[str] = None
+    path_csv_path: Optional[str] = None

@@ -1,0 +1,16 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run on
+xla_force_host_platform_device_count=8 CPU devices, which exercises the same
+SPMD partitioner and collectives as a real mesh.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,107 @@
+"""Core domain tests: grids, sampling, tasks, config."""
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid, DEFAULT_MAP_ASCII
+from p2p_distributed_tswap_tpu.core.sampling import (
+    sample_start_goal_pairs,
+    sample_start_positions,
+    start_positions_array,
+)
+from p2p_distributed_tswap_tpu.core.tasks import Task, TaskGenerator
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+
+
+def test_default_grid_matches_reference_shape():
+    g = Grid.default()
+    assert (g.height, g.width) == (100, 100)
+    assert g.free.all()  # reference MAP is all-free (src/map/map.rs:5-105)
+    assert len(g.free_cells()) == 10000
+
+
+def test_ascii_roundtrip_with_obstacles():
+    text = "..@.\n....\n@@..\n...."
+    g = Grid.from_ascii(text)
+    assert g.free.sum() == 13
+    assert g.to_ascii() == text
+    # (x, y) convention: cell (2, 0) is the '@' in row 0
+    assert not g.free[0, 2]
+
+
+def test_idx_point_roundtrip():
+    g = Grid.from_ascii("....\n....\n....")
+    assert g.idx((3, 2)) == 2 * 4 + 3
+    assert g.point(g.idx((3, 2))) == (3, 2)
+    pts = g.free_cells()
+    idxs = g.idx_array(pts)
+    assert idxs[0] == 0 and idxs[-1] == g.num_cells - 1
+
+
+def test_random_obstacles_connected():
+    g = Grid.random_obstacles(64, 64, density=0.2, seed=7)
+    free = g.free
+    # flood fill from any free cell must reach all free cells
+    ys, xs = np.nonzero(free)
+    seen = np.zeros_like(free)
+    stack = [(ys[0], xs[0])]
+    seen[ys[0], xs[0]] = True
+    while stack:
+        y, x = stack.pop()
+        for dy, dx in ((0, 1), (1, 0), (0, -1), (-1, 0)):
+            ny, nx = y + dy, x + dx
+            if 0 <= ny < 64 and 0 <= nx < 64 and free[ny, nx] and not seen[ny, nx]:
+                seen[ny, nx] = True
+                stack.append((ny, nx))
+    assert seen.sum() == free.sum()
+
+
+def test_warehouse_has_obstacles_and_aisles():
+    g = Grid.warehouse(64, 64)
+    assert 0 < (~g.free).sum() < g.num_cells
+    # margins free
+    assert g.free[0].all() and g.free[-1].all()
+
+
+def test_mapf_file_loader(tmp_path):
+    p = tmp_path / "toy.map"
+    p.write_text("type octile\nheight 3\nwidth 4\nmap\n.@..\n....\nT.@.\n")
+    g = Grid.from_mapf_file(str(p))
+    assert (g.height, g.width) == (3, 4)
+    assert not g.free[0, 1] and not g.free[2, 0] and not g.free[2, 2]
+    assert g.free.sum() == 9
+
+
+def test_sampling_distinct_and_seeded():
+    g = Grid.default()
+    a = sample_start_positions(g, 50, seed=3)
+    b = sample_start_positions(g, 50, seed=3)
+    c = sample_start_positions(g, 50, seed=4)
+    assert a == b and a != c
+    assert len(set(a)) == 50  # collision-free by construction
+    pairs = sample_start_goal_pairs(g, 10, seed=0)
+    flat = [p for pr in pairs for p in pr]
+    assert len(set(flat)) == 20
+    idxs = start_positions_array(g, 5, seed=1)
+    assert idxs.dtype == np.int32 and len(np.unique(idxs)) == 5
+
+
+def test_task_generator_seeded_and_wire_roundtrip():
+    g = Grid.default()
+    gen = TaskGenerator(g, seed=11)
+    t1 = gen.generate_task()
+    t2 = gen.generate_task()
+    assert t1.task_id == 0 and t2.task_id == 1
+    assert t1.pickup != t1.delivery
+    d = t1.to_json_dict()
+    assert Task.from_json_dict(d) == t1
+    arrs = TaskGenerator(g, seed=11).generate_task_arrays(4)
+    assert arrs.shape == (4, 2)
+    assert arrs[0, 0] == g.idx(t1.pickup)
+
+
+def test_solver_config_hashable_static():
+    c1 = SolverConfig(height=100, width=100, num_agents=50)
+    c2 = SolverConfig(height=100, width=100, num_agents=50)
+    assert hash(c1) == hash(c2) and c1 == c2
+    assert c1.num_cells == 10000
