@@ -39,8 +39,12 @@ class SolverConfig:
     # Decentralized-mode visibility radius (Manhattan); None = centralized
     # global view. Ref: TSWAP_RADIUS=15, src/bin/decentralized/agent.rs:796-801.
     visibility_radius: Optional[int] = None
-    # Upper bound on movement-phase resolution rounds (the exact-order fixpoint
-    # finalizes >=1 agent per round; convoys resolve in a few).
+    # Rounds of the (Rule 3, Rule 4) goal-swapping phase per step.  The
+    # reference's sequential pass lets swaps cascade within one step
+    # (src/algorithm/tswap.rs:180-252); extra parallel rounds approximate that.
+    swap_rounds: int = 2
+    # Upper bound on movement-phase cascade rounds (each round finalizes at
+    # least the front of every convoy; loop exits early at fixpoint).
     max_move_rounds: int = 64
     # Fast-sweeping rounds cap for distance fields (each round = 4 directional
     # scans; fixpoint is reached much earlier on benchmark maps).

@@ -1,0 +1,159 @@
+"""Exact BFS distance / direction fields, batched over goals.
+
+This replaces the reference's per-agent A* (``get_path``,
+src/algorithm/tswap.rs:288-390, duplicated in both binaries) with the
+TPU-native formulation from SURVEY §7: on an unweighted 4-connected grid the
+shortest-path next hop is simply descent of the BFS distance-to-goal field, so
+we compute exact distance fields for a *batch* of goals at once and derive a
+dense next-hop **direction field** per goal.  Goal swaps and rotations in TSWAP
+then never recompute anything — they permute field *slots* among agents.
+
+Algorithm: fast sweeping (Gauss-Seidel on the Bellman equation restricted to
+row/column propagation).  One round = 4 directional sweeps (+x, -x, +y, -y);
+each sweep is a **segmented min-plus prefix scan** along rows or columns
+(``jax.lax.associative_scan``, log-depth), with obstacle cells breaking
+propagation segments.  Rounds iterate under ``lax.while_loop`` until fixpoint —
+the fixpoint is the exact BFS distance; round count is bounded by the number of
+direction changes of shortest paths (1 on an empty grid, a handful on
+warehouse-style maps).
+
+Directions are encoded to match the reference's neighbor iteration order
+``[(0,1),(1,0),(0,-1),(-1,0)]`` as (dx, dy) (src/algorithm/tswap.rs:62), with
+first-minimum tie-breaking; code 4 = stay (at goal / unreachable).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+INF = jnp.int32(1 << 30)
+# (dx, dy) in the reference's neighbor order; index = direction code.
+DIR_DXDY = ((0, 1), (1, 0), (0, -1), (-1, 0))
+DIR_STAY = 4
+
+
+def _seg_min_scan(values: jnp.ndarray, resets: jnp.ndarray, axis: int,
+                  reverse: bool) -> jnp.ndarray:
+    """Segmented running minimum along ``axis``: at positions where ``resets``
+    is True the minimum restarts from that position's value."""
+
+    def op(a, b):
+        av, ar = a
+        bv, br = b
+        return jnp.where(br, bv, jnp.minimum(av, bv)), ar | br
+
+    out, _ = jax.lax.associative_scan(op, (values, resets), axis=axis,
+                                      reverse=reverse)
+    return out
+
+
+def _sweep(d: jnp.ndarray, free: jnp.ndarray, axis: int, reverse: bool,
+           coord: jnp.ndarray) -> jnp.ndarray:
+    """One directional sweep: propagate ``d`` along ``axis`` in one direction
+    with unit step cost, not crossing obstacles.
+
+    Uses the affine trick: along the scan direction, reachability from an
+    earlier cell k at position x costs (x - k), so minimizing ``d[k] - k``
+    with a segmented scan and adding back the coordinate gives the relaxed
+    distance.  ``coord`` is the (broadcastable) position along ``axis``,
+    negated by the caller for reverse sweeps.
+    """
+    blocked = ~free
+    # Blocked sentinel must stay >= INF after the coordinate shift below for
+    # any position in the axis, else it would leak as a fake INF-eps distance.
+    axis_len = d.shape[axis]
+    v = jnp.where(blocked, INF + axis_len, d - coord)
+    m = _seg_min_scan(v, blocked, axis=axis, reverse=reverse)
+    relaxed = jnp.where(blocked, INF, jnp.minimum(d, m + coord))
+    # guard overflow: anything >= INF stays INF
+    return jnp.minimum(relaxed, INF)
+
+
+def distance_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
+                    max_rounds: int = 128) -> jnp.ndarray:
+    """Exact BFS distances from every cell to each goal.
+
+    Args:
+      free: (H, W) bool, True where traversable.
+      goals_idx: (G,) int32 flat cell indices of goals.
+      max_rounds: safety cap on sweep rounds (fixpoint normally comes long
+        before; each round is 4 scans).
+
+    Returns:
+      (G, H, W) int32; INF (2^30) at obstacles and unreachable cells. A goal
+      on an obstacle cell yields an all-INF field (agents then stay).
+    """
+    h, w = free.shape
+    g = goals_idx.shape[0]
+    cell = jnp.arange(h * w, dtype=jnp.int32).reshape(1, h, w)
+    d0 = jnp.where(cell == goals_idx.reshape(g, 1, 1), jnp.int32(0), INF)
+    d0 = jnp.where(free[None], d0, INF)
+
+    xcoord = jnp.arange(w, dtype=jnp.int32).reshape(1, 1, w)
+    ycoord = jnp.arange(h, dtype=jnp.int32).reshape(1, h, 1)
+    free_b = jnp.broadcast_to(free[None], (g, h, w))
+
+    def one_round(d):
+        d = _sweep(d, free_b, axis=2, reverse=False, coord=xcoord)
+        d = _sweep(d, free_b, axis=2, reverse=True, coord=-xcoord)
+        d = _sweep(d, free_b, axis=1, reverse=False, coord=ycoord)
+        d = _sweep(d, free_b, axis=1, reverse=True, coord=-ycoord)
+        return d
+
+    def cond(state):
+        d, prev_changed, i = state
+        return prev_changed & (i < max_rounds)
+
+    def body(state):
+        d, _, i = state
+        nd = one_round(d)
+        return nd, jnp.any(nd != d), i + 1
+
+    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), jnp.int32(0)))
+    return d
+
+
+def directions_from_distance(dist: jnp.ndarray, free: jnp.ndarray) -> jnp.ndarray:
+    """Next-hop direction field from a distance field.
+
+    Args:
+      dist: (..., H, W) int32 distances (INF = unreachable).
+      free: (H, W) bool.
+
+    Returns:
+      (..., H, W) uint8 direction codes: 0..3 = step (dx,dy) per DIR_DXDY
+      toward the goal (always strictly descends the field on reachable cells),
+      4 = stay (at goal, obstacle, or unreachable).
+    """
+    pad = [(0, 0)] * (dist.ndim - 2)
+
+    def shifted(dx, dy):
+        # value of dist at (x+dx, y+dy), INF out of bounds
+        s = jnp.pad(dist, pad + [(1, 1), (1, 1)], constant_values=INF)
+        return jax.lax.slice_in_dim(
+            jax.lax.slice_in_dim(s, 1 + dy, 1 + dy + dist.shape[-2], axis=-2),
+            1 + dx, 1 + dx + dist.shape[-1], axis=-1)
+
+    neigh = jnp.stack([shifted(dx, dy) for dx, dy in DIR_DXDY], axis=0)
+    best = jnp.argmin(neigh, axis=0).astype(jnp.uint8)  # first-min tie-break
+    best_val = jnp.min(neigh, axis=0)
+    stay = (dist == 0) | (dist >= INF) | (best_val >= INF) | (best_val >= dist) | ~free
+    return jnp.where(stay, jnp.uint8(DIR_STAY), best)
+
+
+def direction_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
+                     max_rounds: int = 128) -> jnp.ndarray:
+    """(G, H, W) uint8 next-hop directions toward each goal."""
+    return directions_from_distance(distance_fields(free, goals_idx, max_rounds),
+                                    free)
+
+
+def apply_direction(pos_idx: jnp.ndarray, dir_code: jnp.ndarray,
+                    width: int) -> jnp.ndarray:
+    """Next flat cell index after taking ``dir_code`` from ``pos_idx``.
+    Stay (code 4) maps to the same cell.  No bounds check needed: direction
+    fields never point off-grid (off-grid neighbors are INF)."""
+    dx = jnp.array([d[0] for d in DIR_DXDY] + [0], dtype=jnp.int32)[dir_code]
+    dy = jnp.array([d[1] for d in DIR_DXDY] + [0], dtype=jnp.int32)[dir_code]
+    return pos_idx + dy * width + dx

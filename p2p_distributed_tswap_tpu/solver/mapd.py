@@ -1,0 +1,230 @@
+"""Offline MAPD loop — the TPU equivalent of the reference's ``tswap_mapd``
+(src/algorithm/tswap.rs:39-172): greedy nearest-pickup task assignment, the
+Idle -> ToPickup -> ToDelivery machine, TSWAP stepping, per-step path
+recording, and the all-done-or-horizon termination rule — as one jitted
+``lax.while_loop`` over device state.
+
+The one genuinely new mechanism versus the reference is **replanning**: goal
+changes from the task lifecycle (assignment, pickup -> delivery) need fresh
+direction fields.  Goal *swaps* never do (slot permutation), so the per-step
+replan set is small; it is processed in static-size chunks of
+``cfg.replan_chunk`` fields per round (fast-sweeping over a (R, H, W) batch),
+looping until the set drains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from p2p_distributed_tswap_tpu.core.agent import AgentPhase, AgentState
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.ops.distance import DIR_STAY, direction_fields
+from p2p_distributed_tswap_tpu.solver.step import step_parallel
+
+_FAR = jnp.int32(1 << 20)  # > any grid manhattan distance
+
+
+@struct.dataclass
+class MapdState:
+    pos: jnp.ndarray          # (N,) int32 flat cell
+    goal: jnp.ndarray         # (N,) int32 flat cell
+    slot: jnp.ndarray         # (N,) int32 agent -> field row
+    dirs: jnp.ndarray         # (N, HW) uint8 direction fields by row
+    phase: jnp.ndarray        # (N,) int8 AgentPhase
+    agent_task: jnp.ndarray   # (N,) int32 task index or -1
+    task_used: jnp.ndarray    # (T,) bool
+    need_replan: jnp.ndarray  # (N,) bool: agent's goal changed, field stale
+    t: jnp.ndarray            # () int32 timestep counter
+    paths_pos: jnp.ndarray    # (Tmax+1, N) int32 recorded positions
+    paths_state: jnp.ndarray  # (Tmax+1, N) int8 recorded AgentState
+
+
+def init_state(cfg: SolverConfig, starts: jnp.ndarray,
+               num_tasks: int) -> MapdState:
+    n, hw, tmax = cfg.num_agents, cfg.num_cells, cfg.max_timesteps
+    return MapdState(
+        pos=jnp.asarray(starts, jnp.int32),
+        goal=jnp.asarray(starts, jnp.int32),
+        slot=jnp.arange(n, dtype=jnp.int32),
+        dirs=jnp.full((n, hw), DIR_STAY, jnp.uint8),
+        phase=jnp.full(n, AgentPhase.IDLE, jnp.int8),
+        agent_task=jnp.full(n, -1, jnp.int32),
+        task_used=jnp.zeros(num_tasks, bool),
+        # All rows start stale: an uncomputed all-STAY row is only valid while
+        # its agent sits on its start cell, but Rule-3 swaps can hand the row
+        # to an agent elsewhere — so every field is computed on the first step.
+        need_replan=jnp.ones(n, bool),
+        t=jnp.int32(0),
+        paths_pos=jnp.zeros((tmax + 1, n), jnp.int32),
+        paths_state=jnp.zeros((tmax + 1, n), jnp.int8),
+    )
+
+
+def _transitions(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray) -> MapdState:
+    """Arrival transitions (ref tswap.rs:106-121), vectorized: transitions of
+    distinct agents are independent, so order does not matter."""
+    arrived = s.pos == s.goal
+    tp = arrived & (s.phase == AgentPhase.TO_PICKUP)
+    td = arrived & (s.phase == AgentPhase.TO_DELIVERY)
+    task = jnp.clip(s.agent_task, 0)
+    goal = jnp.where(tp, tasks[task, 1], s.goal)
+    phase = jnp.where(tp, AgentPhase.TO_DELIVERY,
+                      jnp.where(td, AgentPhase.IDLE, s.phase)).astype(jnp.int8)
+    agent_task = jnp.where(td, -1, s.agent_task)
+    return s.replace(goal=goal, phase=phase, agent_task=agent_task,
+                     need_replan=s.need_replan | tp)
+
+
+def _assign(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray) -> MapdState:
+    """Greedy nearest-pickup assignment in agent-id order (ref tswap.rs:123-138):
+    a sequential scan, because each claim removes a task from the pool.
+    Ties go to the lowest task index (Rust min_by_key keeps the first min)."""
+    n, w = cfg.num_agents, cfg.width
+    px, py = tasks[:, 0] % w, tasks[:, 0] // w
+
+    def body(carry, i):
+        task_used, goal, phase, agent_task, need = carry
+        d = (jnp.abs(px - s.pos[i] % w) + jnp.abs(py - s.pos[i] // w)
+             + _FAR * task_used)
+        k = jnp.argmin(d).astype(jnp.int32)
+        do = (phase[i] == AgentPhase.IDLE) & ~task_used[k]
+        return (
+            task_used.at[k].set(task_used[k] | do),
+            goal.at[i].set(jnp.where(do, tasks[k, 0], goal[i])),
+            phase.at[i].set(jnp.where(do, AgentPhase.TO_PICKUP, phase[i])
+                            .astype(jnp.int8)),
+            agent_task.at[i].set(jnp.where(do, k, agent_task[i])),
+            need.at[i].set(need[i] | do),
+        ), None
+
+    init = (s.task_used, s.goal, s.phase, s.agent_task, s.need_replan)
+    (task_used, goal, phase, agent_task, need), _ = jax.lax.scan(
+        body, init, jnp.arange(n, dtype=jnp.int32))
+    return s.replace(task_used=task_used, goal=goal, phase=phase,
+                     agent_task=agent_task, need_replan=need)
+
+
+def _replan(cfg: SolverConfig, s: MapdState, free: jnp.ndarray) -> MapdState:
+    """Recompute direction-field rows for agents whose goal changed, in
+    static chunks of ``replan_chunk`` per round until the set drains."""
+    n, r = cfg.num_agents, min(cfg.replan_chunk, cfg.num_agents)
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    def cond(carry):
+        dirs, need = carry
+        return jnp.any(need)
+
+    def body(carry):
+        dirs, need = carry
+        priority = jnp.where(need, idx, n)
+        sel = -jax.lax.top_k(-priority, r)[0]       # r lowest flagged ids
+        valid = sel < n
+        selc = jnp.clip(sel, 0, n - 1)
+        fields = direction_fields(free, s.goal[selc],
+                                  max_rounds=cfg.max_sweep_rounds)
+        fields = fields.reshape(r, cfg.num_cells)
+        # Invalid lanes clip to agent n-1, whose (goal, slot) pair is still
+        # consistent — so their writes are redundant but *correct*, and no
+        # out-of-bounds scatter index is ever needed (XLA CPU has been seen
+        # wrapping OOB scatter rows instead of dropping them).
+        dirs = dirs.at[s.slot[selc]].set(fields)
+        cleared = jnp.zeros(n, bool).at[selc].max(valid)
+        return dirs, need & ~cleared
+
+    dirs, need = jax.lax.while_loop(cond, body, (s.dirs, s.need_replan))
+    return s.replace(dirs=dirs, need_replan=need)
+
+
+def _record(cfg: SolverConfig, s: MapdState) -> MapdState:
+    """Path recording (ref tswap.rs:143-158)."""
+    state = jnp.where(
+        s.phase == AgentPhase.IDLE, AgentState.IDLE,
+        jnp.where(s.phase == AgentPhase.TO_PICKUP, AgentState.PICKING,
+                  jnp.where(s.pos == s.goal, AgentState.DELIVERED,
+                            AgentState.CARRYING))).astype(jnp.int8)
+    return s.replace(
+        paths_pos=jax.lax.dynamic_update_index_in_dim(
+            s.paths_pos, s.pos, s.t, axis=0),
+        paths_state=jax.lax.dynamic_update_index_in_dim(
+            s.paths_state, state, s.t, axis=0),
+        t=s.t + 1)
+
+
+def mapd_step(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray,
+              free: jnp.ndarray) -> MapdState:
+    """One full MAPD timestep: transitions -> assignment -> replan -> TSWAP
+    step -> record."""
+    s = _transitions(cfg, s, tasks)
+    any_idle = jnp.any((s.phase == AgentPhase.IDLE) & ~jnp.all(s.task_used))
+    s = jax.lax.cond(any_idle, lambda s: _assign(cfg, s, tasks), lambda s: s, s)
+    s = _replan(cfg, s, free)
+    pos, goal, slot = step_parallel(cfg, s.pos, s.goal, s.slot, s.dirs)
+    return _record(cfg, s.replace(pos=pos, goal=goal, slot=slot))
+
+
+def _finished(cfg: SolverConfig, s: MapdState) -> jnp.ndarray:
+    """Ref tswap.rs:162-168: all tasks used and all agents idle, or horizon."""
+    done = jnp.all(s.task_used) & jnp.all(s.phase == AgentPhase.IDLE)
+    return done | (s.t > cfg.max_timesteps)
+
+
+def run_mapd(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
+             free: jnp.ndarray) -> MapdState:
+    """Jittable end-to-end MAPD solve. Returns the final state; makespan is
+    ``state.t`` and paths are in ``paths_pos/paths_state[: state.t]``."""
+    if tasks.shape[0] == 0:
+        # keep the traced body total: substitute one dummy task, pre-used
+        tasks = jnp.zeros((1, 2), jnp.int32)
+        s = init_state(cfg, starts, 1)
+        s = s.replace(task_used=jnp.ones(1, bool))
+    else:
+        s = init_state(cfg, starts, tasks.shape[0])
+
+    def cond(s):
+        return ~_finished(cfg, s)
+
+    def body(s):
+        return mapd_step(cfg, s, tasks, free)
+
+    return jax.lax.while_loop(cond, body, s)
+
+
+_run_mapd_jit = jax.jit(run_mapd, static_argnums=0)
+
+
+def solve_offline(grid: Grid, starts_idx: np.ndarray, tasks: np.ndarray,
+                  cfg: SolverConfig | None = None) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Host-facing offline solver (capability of ref tswap_mapd).
+
+    Args:
+      grid: the world.
+      starts_idx: (N,) flat start cells (distinct).
+      tasks: (T, 2) int32 [pickup_idx, delivery_idx].
+
+    Returns:
+      (paths_pos (makespan, N), paths_state (makespan, N), makespan).
+    """
+    if cfg is None:
+        cfg = SolverConfig(height=grid.height, width=grid.width,
+                           num_agents=len(starts_idx))
+    starts_np = np.asarray(starts_idx)
+    if len(np.unique(starts_np)) != len(starts_np):
+        raise ValueError("duplicate start cells: agents must be vertex-disjoint")
+    if not grid.free.reshape(-1)[starts_np].all():
+        raise ValueError("start cell on an obstacle")
+    if len(tasks) == 0:
+        n = len(starts_np)
+        return (np.zeros((0, n), np.int32), np.zeros((0, n), np.int8), 0)
+    final = _run_mapd_jit(cfg, jnp.asarray(starts_idx, jnp.int32),
+                          jnp.asarray(tasks, jnp.int32),
+                          jnp.asarray(grid.free))
+    makespan = int(final.t)
+    return (np.asarray(final.paths_pos[:makespan]),
+            np.asarray(final.paths_state[:makespan]), makespan)

@@ -1,0 +1,188 @@
+"""Batched parallel TSWAP step kernel.
+
+A parallel-consistent reformulation of the reference's sequential
+``tswap_step`` (src/algorithm/tswap.rs:174-286), per SURVEY §7 hard part 1.
+All agents act at once on dense (N,) tensors; conflicts resolve with
+deterministic lowest-agent-id priority.  Per-agent A* is gone: each agent's
+next hop is one gather from its goal's **direction field** (see
+``ops.distance``), and goal exchanges never recompute fields — they permute
+the ``slot`` indirection that maps agents to field rows.
+
+Step anatomy (one call = one timestep for all N agents):
+
+1. **Goal-swapping phase**, ``swap_rounds`` rounds of:
+   - Rule 3 (ref :197-202): agents blocked by a neighbor parked on its own
+     goal swap goals with it.  Multiple claimants on one blocker resolve to
+     the lowest agent id; applied as a gather permutation of (goal, slot).
+   - Rule 4 (ref :204-249): deadlock cycles in the blocking graph
+     ``f(i) = occupant of i's next hop`` are detected exactly up to
+     ``cycle_cap`` length by iterated composition, and every cycle rotates
+     goals "backward along the chain" simultaneously: goal/slot of ``x`` move
+     to ``f(x)`` — again a pure permutation.
+2. **Movement phase** (ref :257-285): mutual swaps (adjacent pairs that want
+   each other's cells) exchange positions; remaining agents cascade into
+   free-or-vacated cells over fixpoint rounds, lowest id winning contested
+   cells.  The cascade preserves vertex-disjointness and never lets two
+   agents cross an edge except via a mutual swap.
+
+Documented divergences from the sequential reference (validated empirically
+for makespan parity in tests):
+- swaps/rotations resolve per parallel round, not interleaved per agent;
+- an agent moves at most once per step (the reference's in-pass mutual swap
+  can move the partner again later in the same pass, tswap.rs:269-278);
+- the movement cascade lets an agent enter a cell vacated this step by ANY
+  mover, where the sequential pass only sees vacancies created by
+  lower-indexed agents — strictly more progress per step.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.ops.distance import apply_direction
+
+
+def next_hops(cfg: SolverConfig, dirs: jnp.ndarray, slot: jnp.ndarray,
+              pos: jnp.ndarray) -> jnp.ndarray:
+    """Desired next cell per agent: one gather from that agent's direction
+    field (row ``slot[i]``).  Equals ``pos`` for stay (at goal/unreachable)."""
+    code = dirs[slot, pos]
+    return apply_direction(pos, code, cfg.width)
+
+
+def _occupancy(cfg: SolverConfig, pos: jnp.ndarray) -> jnp.ndarray:
+    """(HW,) int32: agent id at each cell, -1 if empty."""
+    n = cfg.num_agents
+    return jnp.full(cfg.num_cells, -1, jnp.int32).at[pos].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
+def _blockers(occ, pos, u):
+    """Agent occupying each agent's desired next cell (-1 free / no move)."""
+    has_move = u != pos
+    return jnp.where(has_move, occ[u], -1), has_move
+
+
+def _apply_pair_swaps(goal, slot, sel, partner, n):
+    """Permute (goal, slot) by the disjoint transpositions {i <-> partner[i]}
+    for selected i.
+
+    Scatters go through a padded scratch slot at index ``n`` instead of
+    relying on mode="drop": XLA's CPU backend has been observed to *wrap*
+    out-of-bounds scatter rows for some shapes instead of dropping them.
+    """
+    idx = jnp.arange(n, dtype=jnp.int32)
+    p = jnp.arange(n + 1, dtype=jnp.int32)
+    p = p.at[jnp.where(sel, idx, n)].set(jnp.where(sel, partner, n))
+    p = p.at[jnp.where(sel, partner, n)].set(jnp.where(sel, idx, n))
+    p = p[:n]
+    return goal[p], slot[p]
+
+
+def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, dirs, occ):
+    n = cfg.num_agents
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    # ---- Rule 3: swap goals with a blocker parked on its own goal ----
+    at_goal = pos == goal
+    u = next_hops(cfg, dirs, slot, pos)
+    b, has_move = _blockers(occ, pos, u)
+    bc = jnp.clip(b, 0, n - 1)
+    cand = has_move & (b >= 0) & at_goal[bc]
+    # lowest claimant id per blocker wins
+    winner = jnp.full(n + 1, n, jnp.int32).at[jnp.where(cand, b, n)].min(idx)
+    sel3 = cand & (winner[bc] == idx)
+    goal, slot = _apply_pair_swaps(goal, slot, sel3, bc, n)
+
+    # ---- Rule 4: rotate goals around blocking cycles ----
+    at_goal = pos == goal
+    u = next_hops(cfg, dirs, slot, pos)
+    b, has_move = _blockers(occ, pos, u)
+    # blocking-graph successor; n = absorbing sentinel (chain breaks at
+    # at-goal agents automatically: they have no move, f = n)
+    f = jnp.where(has_move & (b >= 0), b, n)
+    f_ext = jnp.concatenate([f, jnp.array([n], jnp.int32)])
+    def cycle_scan(carry, _):
+        y, on_cycle = carry
+        y = f_ext[y]
+        return (y, on_cycle | (y == idx)), None
+    (_, on_cycle), _ = jax.lax.scan(
+        cycle_scan, (f, jnp.zeros(n, bool)), None, length=cfg.cycle_cap)
+    # each cycle member hands its goal to its successor: perm q[f[x]] = x
+    # (padded scratch slot n instead of mode="drop"; see _apply_pair_swaps)
+    q = jnp.arange(n + 1, dtype=jnp.int32)
+    q = q.at[jnp.where(on_cycle, f, n)].set(jnp.where(on_cycle, idx, n))
+    q = q[:n]
+    goal, slot = goal[q], slot[q]
+    return goal, slot
+
+
+def _movement_phase(cfg: SolverConfig, pos, goal, slot, dirs, occ):
+    n = cfg.num_agents
+    idx = jnp.arange(n, dtype=jnp.int32)
+    u = next_hops(cfg, dirs, slot, pos)
+    b, has_move = _blockers(occ, pos, u)
+    bc = jnp.clip(b, 0, n - 1)
+
+    # mutual position swap (ref :269-278): i and blocker want each other's cells
+    mutual = has_move & (b >= 0) & (u[bc] == pos) & (b != idx)
+    newpos = jnp.where(mutual, u, pos)
+    decided = ~has_move | mutual
+
+    def cond(state):
+        _, _, changed, r = state
+        return changed & (r < cfg.max_move_rounds)
+
+    def body(state):
+        decided, newpos, _, r = state
+        # final occupancy of decided agents only (padded scratch cell at
+        # index num_cells instead of mode="drop"; see _apply_pair_swaps)
+        occf = jnp.full(cfg.num_cells + 1, -1, jnp.int32).at[
+            jnp.where(decided, newpos, cfg.num_cells)].set(idx)
+        # target available: nobody finalized there, and its original occupant
+        # (if any) has finalized a move away
+        orig = b  # original occupant of u (from occ at step start)
+        orig_gone = (orig < 0) | (decided[bc] & (newpos[bc] != u))
+        open_cell = (occf[u] == -1) & orig_gone
+        claimant = ~decided & open_cell
+        win = jnp.full(cfg.num_cells + 1, n, jnp.int32).at[
+            jnp.where(claimant, u, cfg.num_cells)].min(idx)
+        mover = claimant & (win[u] == idx)
+        return (decided | mover, jnp.where(mover, u, newpos),
+                jnp.any(mover), r + 1)
+
+    decided, newpos, _, _ = jax.lax.while_loop(
+        cond, body, (decided, newpos, jnp.bool_(True), jnp.int32(0)))
+    return newpos
+
+
+def step_parallel(cfg: SolverConfig, pos: jnp.ndarray, goal: jnp.ndarray,
+                  slot: jnp.ndarray, dirs: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One TSWAP timestep for all agents.
+
+    Args:
+      cfg: static solver config.
+      pos:  (N,) int32 flat cell per agent (vertex-disjoint).
+      goal: (N,) int32 flat goal cell per agent.
+      slot: (N,) int32 agent -> direction-field row (a permutation).
+      dirs: (N, H*W) uint8 direction fields, row ``slot[i]`` is agent i's
+        field (invariant: row slot[i] encodes descent toward goal[i]).
+
+    Returns:
+      (pos, goal, slot) after the step; ``dirs`` is never modified (goal
+      exchange = slot permutation).
+    """
+    occ = _occupancy(cfg, pos)
+
+    def round_body(_, gs):
+        goal, slot = gs
+        return _swap_phase_round(cfg, pos, goal, slot, dirs, occ)
+
+    goal, slot = jax.lax.fori_loop(0, cfg.swap_rounds, round_body, (goal, slot))
+    pos = _movement_phase(cfg, pos, goal, slot, dirs, occ)
+    return pos, goal, slot

@@ -1,0 +1,116 @@
+"""Distance/direction field kernels vs a straightforward numpy BFS golden."""
+
+from collections import deque
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.ops.distance import (
+    DIR_DXDY,
+    DIR_STAY,
+    INF,
+    apply_direction,
+    direction_fields,
+    directions_from_distance,
+    distance_fields,
+)
+
+
+def bfs_numpy(free: np.ndarray, goal_idx: int) -> np.ndarray:
+    """Golden BFS distances (H, W), INF where unreachable."""
+    h, w = free.shape
+    dist = np.full((h, w), int(INF), dtype=np.int64)
+    gy, gx = divmod(goal_idx, w)
+    if not free[gy, gx]:
+        return dist
+    dist[gy, gx] = 0
+    q = deque([(gy, gx)])
+    while q:
+        y, x = q.popleft()
+        for dx, dy in DIR_DXDY:
+            ny, nx = y + dy, x + dx
+            if 0 <= ny < h and 0 <= nx < w and free[ny, nx] and dist[ny, nx] > dist[y, x] + 1:
+                dist[ny, nx] = dist[y, x] + 1
+                q.append((ny, nx))
+    return dist
+
+
+@pytest.mark.parametrize("grid,seed", [
+    (Grid.from_ascii("." * 20 + "\n" + "\n".join(["." * 20] * 19)), 0),  # empty 20x20
+    (Grid.random_obstacles(32, 48, 0.3, seed=5), 1),
+    (Grid.warehouse(40, 40), 2),
+])
+def test_distance_matches_bfs(grid, seed):
+    rng = np.random.default_rng(seed)
+    free_cells = grid.idx_array(grid.free_cells())
+    goals = rng.choice(free_cells, size=5, replace=False).astype(np.int32)
+    d = np.asarray(distance_fields(jnp.asarray(grid.free), jnp.asarray(goals)))
+    for k, g in enumerate(goals):
+        golden = bfs_numpy(grid.free, int(g))
+        np.testing.assert_array_equal(d[k], golden)
+
+
+def test_goal_on_obstacle_all_inf():
+    grid = Grid.from_ascii("..@.\n....\n....")
+    obstacle_idx = grid.idx((2, 0))
+    d = np.asarray(distance_fields(jnp.asarray(grid.free),
+                                   jnp.asarray([obstacle_idx], np.int32)))
+    assert (d >= int(INF)).all()
+
+
+def test_unreachable_region_inf():
+    # right column sealed off by a wall
+    grid = Grid.from_ascii("...@.\n...@.\n...@.")
+    goal = grid.idx((0, 0))
+    d = np.asarray(distance_fields(jnp.asarray(grid.free),
+                                   jnp.asarray([goal], np.int32)))[0]
+    assert d[0, 0] == 0 and d[2, 2] == 4
+    assert (d[:, 4] >= int(INF)).all()
+
+
+def test_directions_descend():
+    grid = Grid.random_obstacles(24, 24, 0.25, seed=3)
+    free_cells = grid.idx_array(grid.free_cells())
+    goals = free_cells[[10, 100]].astype(np.int32)
+    dist = distance_fields(jnp.asarray(grid.free), jnp.asarray(goals))
+    dirs = directions_from_distance(dist, jnp.asarray(grid.free))
+    d_np, dir_np = np.asarray(dist).astype(np.int64), np.asarray(dirs)
+    h, w = grid.height, grid.width
+    ks, ys, xs = np.meshgrid(np.arange(len(goals)), np.arange(h), np.arange(w),
+                             indexing="ij")
+    stay = dir_np == DIR_STAY
+    # stay only at goal, obstacle, or unreachable
+    assert ((d_np[stay] == 0) | (d_np[stay] >= int(INF))).all()
+    code = dir_np[~stay]
+    dxdy = np.array(DIR_DXDY)
+    ny = ys[~stay] + dxdy[code, 1]
+    nx = xs[~stay] + dxdy[code, 0]
+    np.testing.assert_array_equal(d_np[ks[~stay], ny, nx], d_np[~stay] - 1)
+
+
+def test_direction_tiebreak_is_first_min():
+    # empty 3x3, goal at center: cell (1,0) (above goal) must choose (0,1)=down
+    grid = Grid.from_ascii("...\n...\n...")
+    goal = grid.idx((1, 1))
+    dirs = np.asarray(direction_fields(jnp.asarray(grid.free),
+                                       jnp.asarray([goal], np.int32)))[0]
+    assert dirs[0, 1] == 0  # (0,1): step +y toward goal
+    assert dirs[2, 1] == 2  # (0,-1): step -y
+    assert dirs[1, 0] == 1  # (1,0): step +x
+    assert dirs[1, 2] == 3  # (-1,0): step -x
+    # corner (0,0): both (0,1) and (1,0) descend; first in order wins -> 0
+    assert dirs[0, 0] == 0
+
+
+def test_apply_direction_roundtrip():
+    grid = Grid.from_ascii("....\n....\n....")
+    goal = grid.idx((3, 2))
+    dirs = direction_fields(jnp.asarray(grid.free), jnp.asarray([goal], np.int32))
+    pos = jnp.asarray([grid.idx((0, 0))], jnp.int32)
+    flat_dirs = dirs.reshape(1, -1)
+    for _ in range(5):
+        code = jnp.take_along_axis(flat_dirs, pos[:, None], axis=1)[:, 0]
+        pos = apply_direction(pos, code, grid.width)
+    assert int(pos[0]) == goal  # manhattan distance 5 away
