@@ -1,0 +1,54 @@
+"""Benchmark entry: one JSON line for the driver.
+
+Headline metric: average wall-clock per MAPD timestep on the reference's own
+comfortable configuration — 50 agents on the built-in 100x100 empty grid —
+where the reference's centralized manager measured ~180 ms per planning step
+(src/bin/centralized/manager.rs:564-567, DECENTRALIZED_ISSUES.md:36-42; see
+BASELINE.md).  One timestep here includes everything the reference's step
+includes and more: task assignment, replanning, the full TSWAP swap/rotation
+conflict resolution, and movement for all agents.
+
+vs_baseline = reference_ms / our_ms (higher is better, >1 beats the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from p2p_distributed_tswap_tpu.models.scenarios import REFERENCE_DEMO
+from p2p_distributed_tswap_tpu.solver.mapd import _run_mapd_jit
+
+REFERENCE_STEP_MS = 180.0  # ~50 agents, 100x100 (BASELINE.md)
+
+
+def bench_reference_demo(seed: int = 0):
+    grid, starts, tasks, cfg = REFERENCE_DEMO.build(seed=seed)
+    args = (cfg, jnp.asarray(starts, jnp.int32), jnp.asarray(tasks, jnp.int32),
+            jnp.asarray(grid.free))
+    final = _run_mapd_jit(*args)          # compile + warm run
+    jax.block_until_ready(final)
+    t0 = time.perf_counter()
+    final = _run_mapd_jit(*args)
+    jax.block_until_ready(final)
+    elapsed = time.perf_counter() - t0
+    steps = int(final.t)
+    assert steps > 0
+    return 1000.0 * elapsed / steps, steps
+
+
+def main():
+    ms_per_step, steps = bench_reference_demo()
+    print(json.dumps({
+        "metric": "mapd_step_wallclock_50agents_100x100",
+        "value": round(ms_per_step, 4),
+        "unit": "ms/step",
+        "vs_baseline": round(REFERENCE_STEP_MS / ms_per_step, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Benchmark scenario ladder (BASELINE.json configs).
+
+The reference's scale axes are agent count and grid size (SURVEY §5); these
+are the configs the framework is benchmarked on, from the reference's comfort
+zone (tens of agents, 100x100 empty grid) to three orders of magnitude beyond
+(10k agents on a 1024^2 warehouse, 100k on 4096^2 sharded)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.core.sampling import start_positions_array
+from p2p_distributed_tswap_tpu.core.tasks import TaskGenerator
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    grid_fn: Callable[[], Grid]
+    num_agents: int
+    num_tasks: int
+    replan_chunk: int = 64
+
+    def build(self, seed: int = 0):
+        grid = self.grid_fn()
+        starts = start_positions_array(grid, self.num_agents, seed=seed)
+        tasks = TaskGenerator(grid, seed=seed + 1).generate_task_arrays(
+            self.num_tasks)
+        cfg = SolverConfig(height=grid.height, width=grid.width,
+                           num_agents=self.num_agents,
+                           replan_chunk=min(self.replan_chunk, self.num_agents))
+        return grid, starts, tasks, cfg
+
+
+# BASELINE.json config ladder
+REFERENCE_DEMO = Scenario(          # the reference's comfortable envelope
+    "ref-50x100x100", Grid.default, 50, 50, replan_chunk=50)
+SMALL = Scenario(
+    "100a-256-obstacles", lambda: Grid.random_obstacles(256, 256, 0.1, seed=0),
+    100, 100)
+MEDIUM = Scenario(
+    "1k-512", lambda: Grid.random_obstacles(512, 512, 0.1, seed=0), 1000, 1000,
+    replan_chunk=128)
+FLAGSHIP = Scenario(                # north-star config: 10k agents, 1024^2
+    "10k-1024-warehouse", lambda: Grid.warehouse(1024, 1024), 10_000, 10_000,
+    replan_chunk=256)
+EXTREME = Scenario(                 # v5e-16 territory, agent-axis sharded
+    "100k-4096", lambda: Grid.warehouse(4096, 4096), 100_000, 100_000,
+    replan_chunk=512)
+
+LADDER = [REFERENCE_DEMO, SMALL, MEDIUM, FLAGSHIP, EXTREME]

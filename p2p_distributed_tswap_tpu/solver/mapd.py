@@ -26,7 +26,10 @@ from p2p_distributed_tswap_tpu.core.agent import AgentPhase, AgentState
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
 from p2p_distributed_tswap_tpu.ops.distance import DIR_STAY, direction_fields
-from p2p_distributed_tswap_tpu.solver.step import step_parallel
+from p2p_distributed_tswap_tpu.solver.step import (
+    step_parallel,
+    step_with_next_hops,
+)
 
 _FAR = jnp.int32(1 << 20)  # > any grid manhattan distance
 
@@ -158,14 +161,23 @@ def _record(cfg: SolverConfig, s: MapdState) -> MapdState:
 
 
 def mapd_step(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray,
-              free: jnp.ndarray) -> MapdState:
+              free: jnp.ndarray, replan_fn=None, nh_factory=None) -> MapdState:
     """One full MAPD timestep: transitions -> assignment -> replan -> TSWAP
-    step -> record."""
+    step -> record.
+
+    ``replan_fn(cfg, s, free)`` and ``nh_factory(cfg, dirs) -> nh_fn`` let the
+    sharded solver (parallel/sharded.py) substitute its distributed field
+    machinery while the MAPD sequencing lives in exactly one place.
+    """
     s = _transitions(cfg, s, tasks)
     any_idle = jnp.any((s.phase == AgentPhase.IDLE) & ~jnp.all(s.task_used))
     s = jax.lax.cond(any_idle, lambda s: _assign(cfg, s, tasks), lambda s: s, s)
-    s = _replan(cfg, s, free)
-    pos, goal, slot = step_parallel(cfg, s.pos, s.goal, s.slot, s.dirs)
+    s = (replan_fn or _replan)(cfg, s, free)
+    if nh_factory is None:
+        pos, goal, slot = step_parallel(cfg, s.pos, s.goal, s.slot, s.dirs)
+    else:
+        pos, goal, slot = step_with_next_hops(
+            cfg, s.pos, s.goal, s.slot, nh_factory(cfg, s.dirs))
     return _record(cfg, s.replace(pos=pos, goal=goal, slot=slot))
 
 
@@ -173,6 +185,15 @@ def _finished(cfg: SolverConfig, s: MapdState) -> jnp.ndarray:
     """Ref tswap.rs:162-168: all tasks used and all agents idle, or horizon."""
     done = jnp.all(s.task_used) & jnp.all(s.phase == AgentPhase.IDLE)
     return done | (s.t > cfg.max_timesteps)
+
+
+def validate_starts(grid: Grid, starts_idx) -> None:
+    """Host-side input validation shared by every solver front door."""
+    starts_np = np.asarray(starts_idx)
+    if len(np.unique(starts_np)) != len(starts_np):
+        raise ValueError("duplicate start cells: agents must be vertex-disjoint")
+    if not grid.free.reshape(-1)[starts_np].all():
+        raise ValueError("start cell on an obstacle")
 
 
 def run_mapd(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
@@ -214,13 +235,9 @@ def solve_offline(grid: Grid, starts_idx: np.ndarray, tasks: np.ndarray,
     if cfg is None:
         cfg = SolverConfig(height=grid.height, width=grid.width,
                            num_agents=len(starts_idx))
-    starts_np = np.asarray(starts_idx)
-    if len(np.unique(starts_np)) != len(starts_np):
-        raise ValueError("duplicate start cells: agents must be vertex-disjoint")
-    if not grid.free.reshape(-1)[starts_np].all():
-        raise ValueError("start cell on an obstacle")
+    validate_starts(grid, starts_idx)
     if len(tasks) == 0:
-        n = len(starts_np)
+        n = len(starts_idx)
         return (np.zeros((0, n), np.int32), np.zeros((0, n), np.int8), 0)
     final = _run_mapd_jit(cfg, jnp.asarray(starts_idx, jnp.int32),
                           jnp.asarray(tasks, jnp.int32),

@@ -83,13 +83,13 @@ def _apply_pair_swaps(goal, slot, sel, partner, n):
     return goal[p], slot[p]
 
 
-def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, dirs, occ):
+def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
     n = cfg.num_agents
     idx = jnp.arange(n, dtype=jnp.int32)
 
     # ---- Rule 3: swap goals with a blocker parked on its own goal ----
     at_goal = pos == goal
-    u = next_hops(cfg, dirs, slot, pos)
+    u = nh_fn(slot, pos)
     b, has_move = _blockers(occ, pos, u)
     bc = jnp.clip(b, 0, n - 1)
     cand = has_move & (b >= 0) & at_goal[bc]
@@ -100,7 +100,7 @@ def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, dirs, occ):
 
     # ---- Rule 4: rotate goals around blocking cycles ----
     at_goal = pos == goal
-    u = next_hops(cfg, dirs, slot, pos)
+    u = nh_fn(slot, pos)
     b, has_move = _blockers(occ, pos, u)
     # blocking-graph successor; n = absorbing sentinel (chain breaks at
     # at-goal agents automatically: they have no move, f = n)
@@ -121,10 +121,10 @@ def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, dirs, occ):
     return goal, slot
 
 
-def _movement_phase(cfg: SolverConfig, pos, goal, slot, dirs, occ):
+def _movement_phase(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
     n = cfg.num_agents
     idx = jnp.arange(n, dtype=jnp.int32)
-    u = next_hops(cfg, dirs, slot, pos)
+    u = nh_fn(slot, pos)
     b, has_move = _blockers(occ, pos, u)
     bc = jnp.clip(b, 0, n - 1)
 
@@ -177,12 +177,20 @@ def step_parallel(cfg: SolverConfig, pos: jnp.ndarray, goal: jnp.ndarray,
       (pos, goal, slot) after the step; ``dirs`` is never modified (goal
       exchange = slot permutation).
     """
+    return step_with_next_hops(
+        cfg, pos, goal, slot, lambda sl, po: next_hops(cfg, dirs, sl, po))
+
+
+def step_with_next_hops(cfg: SolverConfig, pos, goal, slot, nh_fn):
+    """Step core parameterized by the next-hop lookup, so the sharded solver
+    (parallel/sharded.py) can swap in a distributed field gather while rule
+    semantics stay in exactly one place."""
     occ = _occupancy(cfg, pos)
 
     def round_body(_, gs):
         goal, slot = gs
-        return _swap_phase_round(cfg, pos, goal, slot, dirs, occ)
+        return _swap_phase_round(cfg, pos, goal, slot, nh_fn, occ)
 
     goal, slot = jax.lax.fori_loop(0, cfg.swap_rounds, round_body, (goal, slot))
-    pos = _movement_phase(cfg, pos, goal, slot, dirs, occ)
+    pos = _movement_phase(cfg, pos, goal, slot, nh_fn, occ)
     return pos, goal, slot

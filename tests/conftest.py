@@ -1,16 +1,28 @@
-"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+"""Test harness: force an 8-device virtual CPU mesh for the whole suite.
 
 Multi-chip TPU hardware is not available in CI; sharding tests run on
-xla_force_host_platform_device_count=8 CPU devices, which exercises the same
-SPMD partitioner and collectives as a real mesh.
+``xla_force_host_platform_device_count=8`` CPU devices, which exercises the
+same SPMD partitioner and collectives as a real mesh.
+
+This environment ships an `axon` PJRT plugin that sitecustomize registers at
+*interpreter startup* (importing jax before any test code runs) with
+``JAX_PLATFORMS=axon`` exported — so by the time pytest loads us, jax is
+already initialized with the single real TPU chip as the default backend and
+``jax.config.update("jax_platforms", ...)`` no longer takes effect.  The CPU
+client, however, is created lazily: setting XLA_FLAGS *before* the first
+``jax.devices("cpu")`` call still yields 8 virtual devices, and routing
+defaults through ``jax_default_device`` keeps every test off the TPU.
+``parallel.mesh.agent_mesh`` follows the default device's platform, so
+sharded tests pick up the 8-device CPU mesh automatically.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (after XLA_FLAGS, intentionally)
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
