@@ -165,6 +165,7 @@ def solve_offline_sharded(grid: Grid, starts_idx: np.ndarray,
         cfg = SolverConfig(height=grid.height, width=grid.width,
                            num_agents=len(starts_idx))
     mapd_mod.validate_starts(grid, starts_idx)
+    mapd_mod.validate_tasks(grid, tasks)
     run = make_sharded_runner(cfg, mesh)
     final = run(jnp.asarray(starts_idx, jnp.int32),
                 jnp.asarray(tasks, jnp.int32), jnp.asarray(grid.free))

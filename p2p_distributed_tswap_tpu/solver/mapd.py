@@ -196,6 +196,14 @@ def validate_starts(grid: Grid, starts_idx) -> None:
         raise ValueError("start cell on an obstacle")
 
 
+def validate_tasks(grid: Grid, tasks) -> None:
+    """Reject pickups/deliveries on obstacles — such tasks would otherwise
+    pin their agent on an all-INF field and burn the whole solve horizon."""
+    tasks_np = np.asarray(tasks)
+    if tasks_np.size and not grid.free.reshape(-1)[tasks_np.reshape(-1)].all():
+        raise ValueError("task pickup/delivery cell on an obstacle")
+
+
 def run_mapd(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
              free: jnp.ndarray) -> MapdState:
     """Jittable end-to-end MAPD solve. Returns the final state; makespan is
@@ -236,6 +244,7 @@ def solve_offline(grid: Grid, starts_idx: np.ndarray, tasks: np.ndarray,
         cfg = SolverConfig(height=grid.height, width=grid.width,
                            num_agents=len(starts_idx))
     validate_starts(grid, starts_idx)
+    validate_tasks(grid, tasks)
     if len(tasks) == 0:
         n = len(starts_idx)
         return (np.zeros((0, n), np.int32), np.zeros((0, n), np.int8), 0)

@@ -1,0 +1,113 @@
+"""Metrics subsystem: lifecycle semantics, CSV schema parity, and — the real
+contract — the REFERENCE's own pandas analysis scripts must consume our CSVs
+unchanged (SURVEY C16)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from p2p_distributed_tswap_tpu.metrics.task_metrics import (
+    NetworkMetrics,
+    PathComputationMetrics,
+    TaskMetric,
+    TaskMetricsCollector,
+    TaskStatus,
+)
+
+REF = "/root/reference"
+
+
+def _collector_with_history():
+    c = TaskMetricsCollector()
+    base = 1_700_000_000_000
+    for tid in range(20):
+        m = TaskMetric(task_id=tid, peer_id=f"12D3KooWpeer{tid % 4}",
+                       sent_time=base + tid * 1000)
+        c.add_metric(m)
+        c.update_received(tid, at_ms=base + tid * 1000 + 40)
+        c.update_started(tid, at_ms=base + tid * 1000 + 55)
+        if tid < 18:
+            c.update_completed(tid, at_ms=base + tid * 1000 + 55 + 2000 + tid * 300)
+    c.update_failed(19)
+    return c
+
+
+def test_lifecycle_and_statistics():
+    c = _collector_with_history()
+    stats = c.get_statistics()
+    assert stats.total_tasks == 20
+    assert stats.completed_tasks == 18
+    assert stats.failed_tasks == 1
+    assert stats.min_processing_time == 2000
+    assert stats.max_processing_time == 2000 + 17 * 300
+    assert stats.avg_startup_latency == 55
+    text = str(stats)
+    assert "Success Rate: 90.0%" in text
+
+
+def test_task_csv_schema_exact():
+    c = _collector_with_history()
+    csv = c.to_csv_string()
+    header = csv.splitlines()[0]
+    assert header == ("task_id,peer_id,sent_time_ms,received_time_ms,"
+                      "start_time_ms,completion_time_ms,total_time_ms,"
+                      "processing_time_ms,startup_latency_ms,status")
+    running = [l for l in csv.splitlines() if l.endswith(",running")]
+    # task 18 never completed: 0 completion, empty derived columns
+    assert len(running) == 1 and ",0,,," in running[0]
+
+
+def test_path_csv_schema():
+    p = PathComputationMetrics()
+    for i in range(5):
+        p.record_micros(1000 + i)
+    csv = p.to_csv_string()
+    assert csv.splitlines()[0] == "sample_index,duration_micros,duration_millis"
+    assert csv.splitlines()[1] == "0,1000,1.000"
+    stats = p.get_statistics()
+    assert stats.samples == 5 and stats.min_micros == 1000
+
+
+def test_network_metrics_counters():
+    n = NetworkMetrics()
+    n.record_sent(100)
+    n.record_sent(150)
+    n.record_received(1000)
+    assert n.messages_sent == 2 and n.bytes_sent == 250
+    assert n.messages_received == 1 and n.bytes_received == 1000
+    assert "Messages sent: 2" in str(n)
+
+
+def test_reference_analyze_metrics_consumes_our_csv(tmp_path):
+    """analyze_metrics.py --all must run cleanly on our task CSV."""
+    csv_path = tmp_path / "task_metrics.csv"
+    csv_path.write_text(_collector_with_history().to_csv_string())
+    out = subprocess.run(
+        [sys.executable, f"{REF}/analyze_metrics.py", str(csv_path), "--all"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "Success Rate" in out.stdout or "成功率" in out.stdout
+
+
+def test_reference_compare_path_metrics_consumes_our_csvs(tmp_path):
+    """compare_path_metrics.py must compare our centralized/decentralized
+    path CSVs (the decentralized one with timestamp_ms bucketing)."""
+    cent = PathComputationMetrics()
+    for i in range(50):
+        cent.record_micros(150_000 + 500 * i)       # ~150ms planning steps
+    dec = PathComputationMetrics()
+    base = 1_700_000_000_000
+    for step in range(25):
+        for agent in range(4):
+            dec.record_micros(500 + 10 * agent, timestamp_ms=base + step * 500)
+    c_path = tmp_path / "cent.csv"
+    d_path = tmp_path / "dec.csv"
+    c_path.write_text(cent.to_csv_string())
+    d_path.write_text(dec.to_csv_string())
+    out = subprocess.run(
+        [sys.executable, f"{REF}/compare_path_metrics.py",
+         str(c_path), str(d_path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "Centralized" in out.stdout
