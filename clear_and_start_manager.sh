@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Ops helper (capability of the reference's clear_and_start_manager.sh,
+# without its hardcoded developer path): kill any running fleet processes,
+# verify they are gone, then start bus + a fresh manager with --clean.
+#
+# Usage: ./clear_and_start_manager.sh [centralized|decentralized]
+set -u
+
+MODE=${1:-decentralized}
+PORT=${MAPD_BUS_PORT:-7400}
+ROOT="$(cd "$(dirname "$0")" && pwd)"
+BUILD="$ROOT/cpp/build"
+
+echo "🧹 stopping existing mapd processes..."
+pkill -f mapd_agent_ 2>/dev/null
+pkill -f mapd_manager_ 2>/dev/null
+pkill -f mapd_bus 2>/dev/null
+pkill -f "p2p_distributed_tswap_tpu.runtime.solverd" 2>/dev/null
+sleep 1
+
+REMAINING=$(pgrep -fc "mapd_(bus|agent_|manager_)" 2>/dev/null || true)
+if [ "${REMAINING:-0}" -gt 0 ] 2>/dev/null; then
+  echo "⚠️  ${REMAINING} processes still running; sending SIGKILL"
+  pkill -9 -f "mapd_(bus|agent_|manager_)" 2>/dev/null
+  sleep 1
+fi
+echo "✅ clean"
+
+cmake -S "$ROOT/cpp" -B "$BUILD" -G Ninja >/dev/null
+ninja -C "$BUILD" >/dev/null || { echo "build failed"; exit 1; }
+
+"$BUILD/mapd_bus" "$PORT" &
+sleep 0.3
+echo "🧠 starting $MODE manager (--clean) on bus port $PORT"
+exec "$BUILD/mapd_manager_$MODE" --port "$PORT" --clean

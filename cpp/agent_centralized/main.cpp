@@ -1,0 +1,165 @@
+// mapd_agent_centralized — "dumb telepresence body" (SURVEY C6).
+//
+// Native rebuild of src/bin/centralized/agent.rs: picks a random free cell,
+// broadcasts position_update three times at startup then at least every
+// second, obeys move_instruction messages addressed to its peer id (moves
+// and re-broadcasts immediately), accepts Tasks addressed to it with
+// task_metric_received/started emissions, and detects completion
+// positionally (current_pos == task.delivery) with task_metric_completed +
+// {"status":"done"}.
+//
+// Usage: mapd_agent_centralized [--port P] [--map FILE] [--seed S]
+
+#include <poll.h>
+#include <signal.h>
+
+#include <cstdio>
+#include <cstring>
+#include <optional>
+#include <random>
+#include <string>
+
+#include "../common/bus.hpp"
+#include "../common/grid.hpp"
+#include "../common/json.hpp"
+
+using namespace mapd;
+
+namespace {
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
+}  // namespace
+
+int main(int argc, char** argv) {
+  uint16_t port = 7400;
+  std::string map_file;
+  uint64_t seed = std::random_device{}();
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--port") && i + 1 < argc)
+      port = static_cast<uint16_t>(atoi(argv[++i]));
+    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
+      map_file = argv[++i];
+    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
+      seed = strtoull(argv[++i], nullptr, 10);
+  }
+  signal(SIGINT, handle_stop);
+  signal(SIGTERM, handle_stop);
+  signal(SIGPIPE, SIG_IGN);
+
+  Grid grid = Grid::default_grid();
+  if (!map_file.empty()) {
+    auto g = Grid::from_file(map_file);
+    if (!g) {
+      fprintf(stderr, "cannot load map %s\n", map_file.c_str());
+      return 1;
+    }
+    grid = *g;
+  }
+  std::mt19937_64 rng(seed);
+
+  BusClient bus;
+  std::string my_id = random_peer_id();
+  if (!bus.connect("127.0.0.1", port, my_id)) {
+    fprintf(stderr, "cannot connect to bus on port %u\n", port);
+    return 1;
+  }
+  bus.subscribe("mapd");
+
+  Cell my_pos = grid.random_free_cell(rng);
+  std::optional<Json> my_task;
+
+  auto point_json = [&](Cell c) {
+    Json p;
+    p.push_back(Json(grid.x_of(c)));
+    p.push_back(Json(grid.y_of(c)));
+    return p;
+  };
+  auto parse_point = [&](const Json& j) -> std::optional<Cell> {
+    const auto& arr = j.as_array();
+    if (arr.size() != 2) return std::nullopt;
+    int x = static_cast<int>(arr[0].as_int());
+    int y = static_cast<int>(arr[1].as_int());
+    if (!grid.in_bounds(x, y)) return std::nullopt;
+    return grid.cell(x, y);
+  };
+
+  auto broadcast_position = [&]() {
+    Json upd;
+    upd.set("type", "position_update")
+        .set("peer_id", my_id)
+        .set("position", point_json(my_pos));
+    bus.publish("mapd", upd);
+  };
+
+  auto task_metric = [&](const char* type) {
+    if (!my_task || (*my_task)["task_id"].is_null()) return;
+    Json m;
+    m.set("type", type)
+        .set("task_id", (*my_task)["task_id"])
+        .set("peer_id", my_id)
+        .set("timestamp_ms", unix_ms());
+    bus.publish("mapd", m);
+  };
+
+  auto completion_check = [&]() {  // positional done detection (ref :379-410)
+    if (!my_task) return;  // my_task.reset() below prevents duplicate done
+    auto dl = parse_point((*my_task)["delivery"]);
+    if (dl && my_pos == *dl) {
+      task_metric("task_metric_completed");
+      Json done;
+      done.set("status", "done").set("task_id", (*my_task)["task_id"]);
+      bus.publish("mapd", done);
+      printf("✅ Task %lld DONE\n",
+             static_cast<long long>((*my_task)["task_id"].as_int()));
+      my_task.reset();
+    }
+  };
+
+  printf("🤖 centralized agent %s at (%d, %d)\n", my_id.c_str(),
+         grid.x_of(my_pos), grid.y_of(my_pos));
+  fflush(stdout);
+
+  // 3x initial broadcast for startup robustness (ref :232-269)
+  for (int i = 0; i < 3; ++i) broadcast_position();
+
+  int64_t last_broadcast = mono_ms();
+  while (!g_stop && bus.connected()) {
+    pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
+    poll(&pfd, 1, 200);
+
+    bool alive = bus.pump([&](const BusClient::Msg& m) {
+      const Json& d = m.data;
+      const std::string& type = d["type"].as_str();
+      if (type == "move_instruction") {
+        if (d["peer_id"].as_str() != my_id) return;
+        if (auto np = parse_point(d["next_pos"])) {
+          my_pos = *np;  // obey and re-broadcast immediately (ref :312-330)
+          broadcast_position();
+          last_broadcast = mono_ms();
+          completion_check();
+        }
+      } else if (type.empty() && d.has("pickup") && d.has("delivery")) {
+        if (d["peer_id"].as_str() != my_id) return;
+        my_task = d;
+        task_metric("task_metric_received");
+        task_metric("task_metric_started");
+        printf("📦 [TASK RECEIVED] Task ID: %lld\n",
+               static_cast<long long>(d["task_id"].as_int()));
+        broadcast_position();
+        last_broadcast = mono_ms();
+        completion_check();  // degenerate tasks can complete in place
+      }
+      fflush(stdout);
+    });
+    if (!alive) break;
+
+    if (mono_ms() - last_broadcast >= 1000) {  // >=1 s heartbeat (ref :285-291)
+      broadcast_position();
+      last_broadcast = mono_ms();
+    }
+  }
+
+  printf("agent %s: shutting down\n", my_id.c_str());
+  bus.close();
+  return 0;
+}

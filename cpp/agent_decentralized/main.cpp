@@ -1,0 +1,424 @@
+// mapd_agent_decentralized — full decentralized peer (SURVEY C7).
+//
+// Native rebuild of src/bin/decentralized/agent.rs: distributed initial-
+// position protocol (occupied_request/response), NearbyAgents cache with TTL
+// age-out, radius eviction and caps, a 500 ms decision tick that broadcasts
+// position/position_update and runs one local TSWAP decision over neighbors
+// within Manhattan radius 15, wire coordination for goal swaps and target
+// rotations, the task state machine Idle -> MovingToPickup ->
+// MovingToDelivery, per-decision path_metric publishing, and periodic
+// NetworkMetrics prints.
+//
+// Usage: mapd_agent_decentralized [--port P] [--map FILE] [--radius R]
+//                                 [--seed S]
+
+#include <poll.h>
+#include <signal.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <optional>
+#include <random>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../common/bus.hpp"
+#include "../common/grid.hpp"
+#include "../common/json.hpp"
+#include "../common/tswap.hpp"
+
+using namespace mapd;
+
+namespace {
+
+constexpr int64_t kTickMs = 500;          // decision cadence (ref :730)
+constexpr int64_t kNeighborTtlMs = 10000; // cache age-out (ref :156-167)
+constexpr size_t kMaxPositions = 60;      // bounded caches (ref :800-804)
+constexpr size_t kMaxRequests = 50;
+constexpr int64_t kSwapTimeoutMs = 2000;  // pending swap/rotation retry window
+
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
+
+struct NearbyEntry {
+  Cell pos = 0;
+  Cell goal = 0;
+  int64_t last_seen_ms = 0;
+};
+
+struct Args {
+  uint16_t port = 7400;
+  std::string map_file;
+  int radius = 15;  // TSWAP_RADIUS (ref :796-801)
+  uint64_t seed = 0;
+};
+
+Json point_json(const Grid& grid, Cell c) {
+  Json p;
+  p.push_back(Json(grid.x_of(c)));
+  p.push_back(Json(grid.y_of(c)));
+  return p;
+}
+
+std::optional<Cell> parse_point(const Grid& grid, const Json& j) {
+  const auto& arr = j.as_array();
+  if (arr.size() != 2) return std::nullopt;
+  int x = static_cast<int>(arr[0].as_int());
+  int y = static_cast<int>(arr[1].as_int());
+  if (!grid.in_bounds(x, y)) return std::nullopt;
+  return grid.cell(x, y);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args args;
+  args.seed = std::random_device{}();
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--port") && i + 1 < argc)
+      args.port = static_cast<uint16_t>(atoi(argv[++i]));
+    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
+      args.map_file = argv[++i];
+    else if (!strcmp(argv[i], "--radius") && i + 1 < argc)
+      args.radius = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
+      args.seed = strtoull(argv[++i], nullptr, 10);
+  }
+  signal(SIGINT, handle_stop);
+  signal(SIGTERM, handle_stop);
+  signal(SIGPIPE, SIG_IGN);
+
+  Grid grid = Grid::default_grid();
+  if (!args.map_file.empty()) {
+    auto g = Grid::from_file(args.map_file);
+    if (!g) {
+      fprintf(stderr, "cannot load map %s\n", args.map_file.c_str());
+      return 1;
+    }
+    grid = *g;
+  }
+  DistanceCache dc(grid);
+  std::mt19937_64 rng(args.seed);
+
+  BusClient bus;
+  std::string my_id = random_peer_id();
+  if (!bus.connect("127.0.0.1", args.port, my_id)) {
+    fprintf(stderr, "cannot connect to bus on port %u\n", args.port);
+    return 1;
+  }
+  bus.subscribe("mapd");
+  printf("🤖 agent %s up (radius %d)\n", my_id.c_str(), args.radius);
+
+  // ---- initial position protocol (ref :518-650) ----
+  // Ask who is where; wait up to 2 s for answers; pick a random free cell
+  // not reported occupied.
+  std::set<Cell> occupied;
+  {
+    Json req;
+    req.set("type", "occupied_request").set("peer_id", my_id);
+    bus.publish("mapd", req);
+    int64_t deadline = mono_ms() + 2000;
+    while (mono_ms() < deadline && !g_stop) {
+      pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
+      poll(&pfd, 1, 100);
+      bus.pump([&](const BusClient::Msg& m) {
+        const Json& d = m.data;
+        if (d["type"].as_str() != "occupied_response") return;
+        // both field spellings occur on the wire (ref :602-606)
+        const Json& pts = d.has("occupied") ? d["occupied"] : d["points"];
+        for (const auto& p : pts.as_array())
+          if (auto c = parse_point(grid, p)) occupied.insert(*c);
+      });
+    }
+  }
+  Cell my_pos;
+  {
+    auto cells = grid.free_cells();
+    std::vector<Cell> avail;
+    for (Cell c : cells)
+      if (!occupied.count(c)) avail.push_back(c);
+    if (avail.empty()) avail = cells;
+    my_pos = avail[rng() % avail.size()];
+  }
+  Cell my_goal = my_pos;
+  printf("[Initial Position Decision] My position: (%d, %d)\n",
+         grid.x_of(my_pos), grid.y_of(my_pos));
+
+  // ---- task state ----
+  enum class TaskState { Idle, MovingToPickup, MovingToDelivery };
+  TaskState task_state = TaskState::Idle;
+  std::optional<Json> my_task;  // bare Task JSON (pickup/delivery/peer_id/task_id)
+  auto task_cell = [&](const char* field) -> std::optional<Cell> {
+    if (!my_task) return std::nullopt;
+    return parse_point(grid, (*my_task)[field]);
+  };
+
+  std::map<std::string, NearbyEntry> nearby;  // peer -> last known pos/goal
+  std::map<std::string, int64_t> pending_requests;  // request_id -> issued ms
+  std::optional<std::pair<std::string, int64_t>> pending_goal_swap;
+  std::optional<std::pair<std::string, int64_t>> pending_rotation;
+  PathComputationMetrics path_metrics;
+
+  auto publish_position = [&]() {
+    Json pos;
+    pos.set("type", "position")
+        .set("peer_id", my_id)
+        .set("pos", point_json(grid, my_pos))
+        .set("goal", point_json(grid, my_goal))
+        .set("timestamp", unix_ms() / 1000);
+    bus.publish("mapd", pos);
+    Json upd;
+    upd.set("type", "position_update")
+        .set("peer_id", my_id)
+        .set("position", point_json(grid, my_pos));
+    bus.publish("mapd", upd);
+  };
+
+  auto publish_task_metric = [&](const char* type) {
+    if (!my_task || (*my_task)["task_id"].is_null()) return;
+    Json m;
+    m.set("type", type)
+        .set("task_id", (*my_task)["task_id"])
+        .set("peer_id", my_id)
+        .set("timestamp_ms", unix_ms());
+    bus.publish("mapd", m);
+  };
+
+  auto arrive_check = [&]() {
+    if (my_pos != my_goal) return;
+    if (task_state == TaskState::MovingToPickup) {
+      if (auto d = task_cell("delivery")) {
+        my_goal = *d;
+        task_state = TaskState::MovingToDelivery;
+        printf("📦 Reached PICKUP, heading to DELIVERY (%d, %d)\n",
+               grid.x_of(*d), grid.y_of(*d));
+        publish_position();
+      }
+    } else if (task_state == TaskState::MovingToDelivery) {
+      publish_task_metric("task_metric_completed");
+      Json done;
+      done.set("status", "done").set("task_id", (*my_task)["task_id"]);
+      bus.publish("mapd", done);
+      printf("✅ Task %lld DONE\n",
+             static_cast<long long>((*my_task)["task_id"].as_int()));
+      my_task.reset();
+      task_state = TaskState::Idle;
+    }
+  };
+
+  int64_t last_tick = 0;
+  int64_t last_metrics_print = mono_ms();
+
+  while (!g_stop && bus.connected()) {
+    pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
+    int64_t now = mono_ms();
+    int timeout = static_cast<int>(
+        std::max<int64_t>(0, last_tick + kTickMs - now));
+    poll(&pfd, 1, std::min(timeout, 100));
+
+    bool alive = bus.pump([&](const BusClient::Msg& m) {
+      const Json& d = m.data;
+      const std::string& type = d["type"].as_str();
+
+      if (type == "position") {
+        const std::string& peer = d["peer_id"].as_str();
+        if (peer == my_id) return;
+        auto p = parse_point(grid, d["pos"]);
+        auto g = parse_point(grid, d["goal"]);
+        if (p && g) nearby[peer] = NearbyEntry{*p, *g, mono_ms()};
+      } else if (type == "occupied_request") {
+        Json resp;  // peers answer with their own point (ref :1007-1025)
+        Json pts;
+        pts.push_back(point_json(grid, my_pos));
+        resp.set("type", "occupied_response")
+            .set("points", pts)
+            .set("peer_id", d["peer_id"].is_null() ? Json(my_id)
+                                                   : d["peer_id"]);
+        bus.publish("mapd", resp);
+      } else if (type == "goal_swap_request") {
+        if (d["to_peer"].as_str() != my_id) return;
+        // always accept: reply with my old goal, take theirs (ref :1041-1072)
+        Json inner;
+        inner.set("request_id", d["request_id"])
+            .set("from_peer", my_id)
+            .set("to_peer", d["from_peer"])
+            .set("my_goal", point_json(grid, my_goal))
+            .set("accepted", true);
+        Json resp;  // response nests the serialized struct under "data"
+        resp.set("type", "goal_swap_response").set("data", inner.dump());
+        bus.publish("mapd", resp);
+        if (auto g = parse_point(grid, d["my_goal"])) {
+          printf("[GOAL_SWAP] accepted from %s\n",
+                 d["from_peer"].as_str().c_str());
+          my_goal = *g;
+        }
+      } else if (type == "goal_swap_response") {
+        auto inner = Json::parse(d["data"].as_str());
+        if (!inner) return;
+        if ((*inner)["to_peer"].as_str() != my_id ||
+            !(*inner)["accepted"].as_bool())
+          return;
+        if (auto g = parse_point(grid, (*inner)["my_goal"])) {
+          printf("[GOAL_SWAP] swap confirmed by %s\n",
+                 (*inner)["from_peer"].as_str().c_str());
+          my_goal = *g;
+        }
+        pending_goal_swap.reset();
+      } else if (type == "target_rotation_request") {
+        const auto& parts = d["participants"].as_array();
+        const auto& goals = d["goals"].as_array();
+        size_t my_index = parts.size();
+        for (size_t i = 0; i < parts.size(); ++i)
+          if (parts[i].as_str() == my_id) my_index = i;
+        if (my_index == parts.size()) return;
+        size_t next = (my_index + 1) % parts.size();
+        if (next < goals.size()) {  // take next participant's goal (ref :1090-1107)
+          if (auto g = parse_point(grid, goals[next])) {
+            printf("[ROTATION] rotating goal with %zu participants\n",
+                   parts.size());
+            my_goal = *g;
+          }
+        }
+      } else if (type == "swap_request") {
+        if (d["to_peer"].as_str() != my_id || !my_task) return;
+        Json resp;  // task swap: hand over my task, adopt theirs (ref :1110-1136)
+        resp.set("type", "swap_response")
+            .set("from_peer", my_id)
+            .set("to_peer", d["from_peer"])
+            .set("task", *my_task);
+        bus.publish("mapd", resp);
+        my_task = d["task"];
+        if (auto p = task_cell("pickup")) {  // adopt the incoming task fully
+          my_goal = *p;
+          task_state = TaskState::MovingToPickup;
+        }
+      } else if (type == "swap_response") {
+        if (d["to_peer"].as_str() != my_id) return;
+        my_task = d["task"];
+        if (auto p = task_cell("pickup")) {
+          my_goal = *p;
+          task_state = TaskState::MovingToPickup;
+        }
+      } else if (type.empty() && d.has("pickup") && d.has("delivery")) {
+        // bare Task JSON addressed by embedded peer_id (ref :1149-1216)
+        if (d["peer_id"].as_str() != my_id) return;
+        my_task = d;
+        publish_task_metric("task_metric_received");
+        if (auto p = task_cell("pickup")) {
+          printf("📦 [TASK RECEIVED] Task ID: %lld -> pickup (%d, %d)\n",
+                 static_cast<long long>(d["task_id"].as_int()),
+                 grid.x_of(*p), grid.y_of(*p));
+          my_goal = *p;
+          task_state = TaskState::MovingToPickup;
+          publish_position();
+          publish_task_metric("task_metric_started");
+        }
+      }
+    });
+    if (!alive) break;
+
+    now = mono_ms();
+    if (now - last_tick < kTickMs) continue;
+    last_tick = now;
+
+    // ---- cache hygiene (ref :792-836) ----
+    for (auto it = nearby.begin(); it != nearby.end();) {
+      bool stale = now - it->second.last_seen_ms > kNeighborTtlMs;
+      bool out_of_range =
+          grid.manhattan(it->second.pos, my_pos) > 2 * args.radius;
+      it = (stale || out_of_range) ? nearby.erase(it) : std::next(it);
+    }
+    while (nearby.size() > kMaxPositions) nearby.erase(nearby.begin());
+    for (auto it = pending_requests.begin(); it != pending_requests.end();)
+      it = (now - it->second > kSwapTimeoutMs) ? pending_requests.erase(it)
+                                               : std::next(it);
+    while (pending_requests.size() > kMaxRequests)
+      pending_requests.erase(pending_requests.begin());
+    if (pending_goal_swap && now - pending_goal_swap->second > kSwapTimeoutMs)
+      pending_goal_swap.reset();
+    if (pending_rotation && now - pending_rotation->second > kSwapTimeoutMs)
+      pending_rotation.reset();
+
+    publish_position();
+
+    // ---- one local TSWAP decision (ref :838-927) ----
+    if (my_task && my_pos != my_goal) {
+      auto t0 = std::chrono::steady_clock::now();
+      std::vector<Neighbor> view;
+      for (const auto& [peer, e] : nearby)
+        if (grid.manhattan(e.pos, my_pos) <= args.radius)
+          view.push_back(Neighbor{peer, e.pos, e.goal});
+      LocalDecision d = decide_local(my_pos, my_goal, my_id, view, dc);
+      int64_t us = std::chrono::duration_cast<std::chrono::microseconds>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+      path_metrics.record_micros(us, unix_ms());
+      Json pm;
+      pm.set("type", "path_metric")
+          .set("peer_id", my_id)
+          .set("duration_micros", us)
+          .set("timestamp_ms", unix_ms());
+      bus.publish("mapd", pm);
+
+      switch (d.kind) {
+        case LocalDecision::Kind::Move:
+          my_pos = d.next;
+          arrive_check();
+          break;
+        case LocalDecision::Kind::WaitForGoalSwap: {
+          if (!pending_goal_swap) {
+            std::string req_id = my_id + "_" + std::to_string(unix_ms());
+            Json req;
+            req.set("type", "goal_swap_request")
+                .set("request_id", req_id)
+                .set("from_peer", my_id)
+                .set("to_peer", d.swap_peer)
+                .set("my_goal", point_json(grid, my_goal));
+            bus.publish("mapd", req);
+            pending_goal_swap = {req_id, now};
+          }
+          break;
+        }
+        case LocalDecision::Kind::WaitForRotation: {
+          if (!pending_rotation) {
+            std::string req_id = my_id + "_" + std::to_string(unix_ms());
+            Json req;
+            Json parts, goals;
+            for (size_t i = 0; i < d.participants.size(); ++i) {
+              parts.push_back(Json(d.participants[i]));
+              goals.push_back(point_json(grid, d.goals[i]));
+            }
+            req.set("type", "target_rotation_request")
+                .set("request_id", req_id)
+                .set("initiator", my_id)
+                .set("participants", parts)
+                .set("goals", goals);
+            bus.publish("mapd", req);
+            pending_rotation = {req_id, now};
+            // The bus never echoes a publish back to its sender, so apply
+            // our own rotation locally: as participants[0] we take the next
+            // participant's goal, exactly as receivers do.
+            if (d.goals.size() > 1) my_goal = d.goals[1];
+          }
+          break;
+        }
+        case LocalDecision::Kind::Wait:
+          break;
+      }
+    }
+    dc.trim(256);
+
+    if (now - last_metrics_print > 10000) {  // ref :786-789
+      printf("%s\n", bus.net_metrics().to_string().c_str());
+      fflush(stdout);
+      last_metrics_print = now;
+    }
+  }
+
+  printf("agent %s: shutting down\n", my_id.c_str());
+  bus.close();
+  return 0;
+}

@@ -1,0 +1,167 @@
+// mapd_bus — the pub/sub message bus daemon.
+//
+// Host-runtime equivalent of the reference's libp2p gossipsub mesh + mDNS
+// discovery (SURVEY C9): roles connect over loopback TCP, subscribe to
+// topics, and published payloads fan out to every other subscriber (the
+// reference's flood-publish semantics, src/bin/*/: everything is physically
+// broadcast on topic "mapd").  peer_joined / peer_left events give managers
+// the discovered/expired capability of mDNS.
+//
+// Usage: mapd_bus [port]           (default 7400)
+
+#include <poll.h>
+#include <signal.h>
+
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../common/json.hpp"
+#include "../common/net.hpp"
+
+using namespace mapd;
+
+namespace {
+
+struct Client {
+  LineConn conn;
+  std::string peer_id;
+  std::set<std::string> topics;
+  explicit Client(int fd) : conn(fd) {}
+};
+
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  uint16_t port = argc > 1 ? static_cast<uint16_t>(atoi(argv[1])) : 7400;
+  signal(SIGINT, handle_stop);
+  signal(SIGTERM, handle_stop);
+  signal(SIGPIPE, SIG_IGN);
+
+  int listen_fd = tcp_listen(port);
+  if (listen_fd < 0) {
+    fprintf(stderr, "mapd_bus: cannot listen on 127.0.0.1:%u\n", port);
+    return 1;
+  }
+  set_nonblocking(listen_fd);
+  printf("mapd_bus listening on 127.0.0.1:%u\n", port);
+  fflush(stdout);
+
+  std::map<int, std::unique_ptr<Client>> clients;
+
+  auto broadcast = [&](const Json& frame, const std::string& topic,
+                       int except_fd) {
+    std::string line = frame.dump();
+    for (auto& [fd, c] : clients) {
+      if (fd == except_fd) continue;
+      if (!topic.empty() && !c->topics.count(topic)) continue;
+      if (c->peer_id.empty()) continue;  // not yet hello'd
+      c->conn.send_line(line);
+    }
+  };
+
+  while (!g_stop) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({listen_fd, POLLIN, 0});
+    for (auto& [fd, c] : clients) {
+      short ev = POLLIN;
+      if (c->conn.wants_write()) ev |= POLLOUT;
+      pfds.push_back({fd, ev, 0});
+    }
+    int rc = poll(pfds.data(), pfds.size(), 1000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+
+    // accept new connections
+    if (pfds[0].revents & POLLIN) {
+      while (true) {
+        int cfd = accept(listen_fd, nullptr, nullptr);
+        if (cfd < 0) break;
+        set_nonblocking(cfd);
+        clients.emplace(cfd, std::make_unique<Client>(cfd));
+      }
+    }
+
+    std::vector<int> dead;
+    for (size_t k = 1; k < pfds.size(); ++k) {
+      int fd = pfds[k].fd;
+      auto it = clients.find(fd);
+      if (it == clients.end()) continue;
+      Client& c = *it->second;
+      bool ok = true;
+      if (pfds[k].revents & (POLLERR | POLLHUP)) ok = false;
+      if (ok && (pfds[k].revents & POLLIN)) ok = c.conn.on_readable();
+      while (ok) {
+        auto line = c.conn.next_line();
+        if (!line) break;
+        auto parsed = Json::parse(*line);
+        if (!parsed || !parsed->is_object()) continue;
+        const Json& j = *parsed;
+        const std::string& op = j["op"].as_str();
+        if (op == "hello") {
+          c.peer_id = j["peer_id"].as_str();
+          Json welcome;
+          welcome.set("op", "welcome").set("peer_id", c.peer_id);
+          c.conn.send_line(welcome.dump());
+        } else if (op == "sub") {
+          const std::string& topic = j["topic"].as_str();
+          c.topics.insert(topic);
+          Json joined;  // discovery event, like an mDNS "discovered"
+          joined.set("op", "peer_joined")
+              .set("peer_id", c.peer_id)
+              .set("topic", topic);
+          broadcast(joined, topic, fd);
+        } else if (op == "unsub") {
+          c.topics.erase(j["topic"].as_str());
+        } else if (op == "pub") {
+          const std::string& topic = j["topic"].as_str();
+          Json msg;
+          msg.set("op", "msg")
+              .set("topic", topic)
+              .set("from", c.peer_id)
+              .set("data", j["data"]);
+          broadcast(msg, topic, fd);
+        } else if (op == "peers") {
+          const std::string& topic = j["topic"].as_str();
+          Json peers;
+          for (auto& [ofd, oc] : clients)
+            if (ofd != fd && oc->topics.count(topic) &&
+                !oc->peer_id.empty())
+              peers.push_back(Json(oc->peer_id));
+          if (peers.is_null()) peers = Json(JsonArray{});
+          Json reply;
+          reply.set("op", "peers").set("topic", topic).set("peers", peers);
+          c.conn.send_line(reply.dump());
+        }
+      }
+      if (ok && (c.conn.wants_write())) ok = c.conn.on_writable();
+      if (!ok) dead.push_back(fd);
+    }
+
+    for (int fd : dead) {
+      auto it = clients.find(fd);
+      if (it == clients.end()) continue;
+      std::string peer = it->second->peer_id;
+      it->second->conn.close_fd();
+      clients.erase(it);
+      if (!peer.empty()) {
+        Json left;  // discovery event, like an mDNS "expired"
+        left.set("op", "peer_left").set("peer_id", peer);
+        broadcast(left, "", -1);
+      }
+    }
+  }
+
+  for (auto& [fd, c] : clients) c->conn.close_fd();
+  close(listen_fd);
+  printf("mapd_bus: shut down\n");
+  return 0;
+}

@@ -1,0 +1,142 @@
+// Pub/sub bus client.
+//
+// The reference's communication backend is a libp2p gossipsub mesh with mDNS
+// LAN discovery (SURVEY C9); every runtime message is a broadcast on the
+// single topic "mapd" (C10).  The host-runtime equivalent is a lightweight
+// hub: roles connect to `busd` over loopback TCP, subscribe to topics, and
+// publish JSON payloads that fan out to all other subscribers.  Discovery
+// parity: the bus emits peer_joined / peer_left events (the capability of
+// mDNS discovered/expired), and answers peers queries (the capability of
+// gossipsub::all_peers the managers use for round-robin dispatch).
+//
+// Frame protocol (one JSON per line):
+//   client->bus: {"op":"hello","peer_id":s} | {"op":"sub","topic":s}
+//                | {"op":"unsub","topic":s} | {"op":"pub","topic":s,"data":v}
+//                | {"op":"peers","topic":s}
+//   bus->client: {"op":"msg","topic":s,"from":s,"data":v}
+//                | {"op":"peer_joined","peer_id":s,"topic":s}
+//                | {"op":"peer_left","peer_id":s}
+//                | {"op":"peers","topic":s,"peers":[s...]}
+#pragma once
+
+#include <poll.h>
+
+#include <chrono>
+#include <cstdio>
+#include <deque>
+#include <functional>
+#include <random>
+#include <string>
+
+#include "json.hpp"
+#include "metrics.hpp"
+#include "net.hpp"
+
+namespace mapd {
+
+inline int64_t unix_ms() {
+  using namespace std::chrono;
+  return duration_cast<milliseconds>(system_clock::now().time_since_epoch())
+      .count();
+}
+
+inline int64_t mono_ms() {
+  using namespace std::chrono;
+  return duration_cast<milliseconds>(steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Random peer id, shaped like a libp2p PeerId for log familiarity.
+inline std::string random_peer_id() {
+  static const char* alphabet =
+      "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz";
+  std::mt19937_64 rng(std::random_device{}());
+  std::string id = "12D3KooW";
+  for (int i = 0; i < 36; ++i) id += alphabet[rng() % 58];
+  return id;
+}
+
+class BusClient {
+ public:
+  // Received application message.
+  struct Msg {
+    std::string topic;
+    std::string from;
+    Json data;
+  };
+
+  BusClient() = default;
+
+  bool connect(const std::string& host, uint16_t port,
+               const std::string& peer_id) {
+    int fd = tcp_connect(host, port);
+    if (fd < 0) return false;
+    set_nonblocking(fd);
+    conn_ = LineConn(fd);
+    peer_id_ = peer_id;
+    Json hello;
+    hello.set("op", "hello").set("peer_id", peer_id);
+    conn_.send_line(hello.dump());
+    return true;
+  }
+
+  const std::string& peer_id() const { return peer_id_; }
+  int fd() const { return conn_.fd(); }
+  bool connected() const { return conn_.valid(); }
+  bool wants_write() const { return conn_.wants_write(); }
+  NetworkMetrics& net_metrics() { return net_; }
+
+  void subscribe(const std::string& topic) {
+    Json j;
+    j.set("op", "sub").set("topic", topic);
+    send_control(j);
+  }
+
+  void publish(const std::string& topic, const Json& data) {
+    Json j;
+    j.set("op", "pub").set("topic", topic).set("data", data);
+    std::string line = j.dump();
+    net_.record_sent(line.size());
+    conn_.send_line(line);
+  }
+
+  void query_peers(const std::string& topic) {
+    Json j;
+    j.set("op", "peers").set("topic", topic);
+    send_control(j);
+  }
+
+  // Pump socket events.  Returns false if the bus connection died.
+  // on_msg: application messages; on_event: peer_joined/peer_left/peers.
+  bool pump(const std::function<void(const Msg&)>& on_msg,
+            const std::function<void(const Json&)>& on_event = nullptr) {
+    if (!conn_.valid()) return false;
+    if (!conn_.on_readable()) return false;
+    while (auto line = conn_.next_line()) {
+      auto parsed = Json::parse(*line);
+      if (!parsed || !parsed->is_object()) continue;  // ignore garbage frames
+      const Json& j = *parsed;
+      const std::string& op = j["op"].as_str();
+      if (op == "msg") {
+        net_.record_received(line->size());
+        if (on_msg) on_msg(Msg{j["topic"].as_str(), j["from"].as_str(),
+                               j["data"]});
+      } else if (on_event) {
+        on_event(j);
+      }
+    }
+    return conn_.on_writable();
+  }
+
+  bool flush() { return conn_.on_writable(); }
+  void close() { conn_.close_fd(); }
+
+ private:
+  void send_control(const Json& j) { conn_.send_line(j.dump()); }
+
+  LineConn conn_;
+  std::string peer_id_;
+  NetworkMetrics net_;
+};
+
+}  // namespace mapd

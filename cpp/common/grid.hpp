@@ -1,0 +1,178 @@
+// Grid world + BFS pathfinding for the host runtime (native twin of
+// p2p_distributed_tswap_tpu/core/grid.py and ops/distance.py, providing the
+// capability of the reference's src/map/map.rs + per-binary parse_map /
+// graph building — collapsed into ONE implementation, fixing the
+// duplication SURVEY flags).
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <fstream>
+#include <optional>
+#include <random>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace mapd {
+
+using Cell = int32_t;  // flat row-major index, y * width + x
+constexpr int32_t kDistInf = 1 << 30;
+
+// Neighbor order of the reference (src/algorithm/tswap.rs:62): (dx, dy).
+constexpr int kDirDx[4] = {0, 1, 0, -1};
+constexpr int kDirDy[4] = {1, 0, -1, 0};
+
+class Grid {
+ public:
+  int width = 0, height = 0;
+  std::vector<uint8_t> free;  // 1 = traversable
+
+  static Grid default_grid() {  // reference 100x100 all-free map
+    Grid g;
+    g.width = g.height = 100;
+    g.free.assign(static_cast<size_t>(g.width) * g.height, 1);
+    return g;
+  }
+
+  // '.'/'@' rows; blank lines skipped (same convention as parse_map).
+  static std::optional<Grid> from_ascii(const std::string& text) {
+    Grid g;
+    std::istringstream in(text);
+    std::string line;
+    while (std::getline(in, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (line.empty()) continue;
+      if (g.width == 0) g.width = static_cast<int>(line.size());
+      if (static_cast<int>(line.size()) != g.width) return std::nullopt;
+      for (char c : line) g.free.push_back(c == '@' ? 0 : 1);
+      ++g.height;
+    }
+    if (g.width == 0) return std::nullopt;
+    return g;
+  }
+
+  static std::optional<Grid> from_file(const std::string& path) {
+    std::ifstream in(path);
+    if (!in) return std::nullopt;
+    std::stringstream ss;
+    ss << in.rdbuf();
+    std::string text = ss.str();
+    if (text.rfind("type", 0) == 0) {  // movingai .map header
+      std::istringstream hin(text);
+      std::string l, tok;
+      int h = 0, w = 0;
+      std::getline(hin, l);  // type ...
+      hin >> tok >> h >> tok >> w;
+      std::getline(hin, l);  // rest of width line
+      std::getline(hin, l);  // "map"
+      Grid g;
+      g.width = w;
+      g.height = h;
+      g.free.assign(static_cast<size_t>(w) * h, 0);
+      for (int y = 0; y < h && std::getline(hin, l); ++y)
+        for (int x = 0; x < w && x < static_cast<int>(l.size()); ++x)
+          g.free[static_cast<size_t>(y) * w + x] =
+              (l[x] == '.' || l[x] == 'G' || l[x] == 'S') ? 1 : 0;
+      return g;
+    }
+    return from_ascii(text);
+  }
+
+  size_t num_cells() const { return free.size(); }
+  bool is_free(Cell c) const {
+    return c >= 0 && c < static_cast<Cell>(free.size()) && free[c];
+  }
+  int x_of(Cell c) const { return c % width; }
+  int y_of(Cell c) const { return c / width; }
+  Cell cell(int x, int y) const { return y * width + x; }
+  bool in_bounds(int x, int y) const {
+    return x >= 0 && x < width && y >= 0 && y < height;
+  }
+
+  std::vector<Cell> free_cells() const {
+    std::vector<Cell> out;
+    for (Cell c = 0; c < static_cast<Cell>(free.size()); ++c)
+      if (free[c]) out.push_back(c);
+    return out;
+  }
+
+  Cell random_free_cell(std::mt19937_64& rng) const {
+    auto cells = free_cells();
+    return cells[rng() % cells.size()];
+  }
+
+  int manhattan(Cell a, Cell b) const {
+    return std::abs(x_of(a) - x_of(b)) + std::abs(y_of(a) - y_of(b));
+  }
+};
+
+// BFS distance fields from goals, memoized per goal (the native analog of
+// the TPU direction-field cache; goals persist across many steps).
+class DistanceCache {
+ public:
+  explicit DistanceCache(const Grid& grid) : grid_(grid) {}
+
+  const std::vector<int32_t>& field(Cell goal) {
+    auto it = cache_.find(goal);
+    if (it != cache_.end()) return it->second;
+    std::vector<int32_t> dist(grid_.num_cells(), kDistInf);
+    if (grid_.is_free(goal)) {
+      dist[goal] = 0;
+      std::deque<Cell> q{goal};
+      while (!q.empty()) {
+        Cell c = q.front();
+        q.pop_front();
+        int cx = grid_.x_of(c), cy = grid_.y_of(c);
+        for (int d = 0; d < 4; ++d) {
+          int nx = cx + kDirDx[d], ny = cy + kDirDy[d];
+          if (!grid_.in_bounds(nx, ny)) continue;
+          Cell nc = grid_.cell(nx, ny);
+          if (grid_.free[nc] && dist[nc] > dist[c] + 1) {
+            dist[nc] = dist[c] + 1;
+            q.push_back(nc);
+          }
+        }
+      }
+    }
+    auto [ins, _] = cache_.emplace(goal, std::move(dist));
+    return ins->second;
+  }
+
+  // First cell after `from` on a shortest path to `goal`; nullopt when at
+  // goal or unreachable.  Tie-break: first minimum in reference neighbor
+  // order — matches the Python oracle and the TPU direction fields.
+  std::optional<Cell> next_hop(Cell from, Cell goal) {
+    if (from == goal) return std::nullopt;
+    const auto& dist = field(goal);
+    if (dist[from] >= kDistInf) return std::nullopt;
+    int fx = grid_.x_of(from), fy = grid_.y_of(from);
+    int32_t best = dist[from];
+    std::optional<Cell> out;
+    for (int d = 0; d < 4; ++d) {
+      int nx = fx + kDirDx[d], ny = fy + kDirDy[d];
+      if (!grid_.in_bounds(nx, ny)) continue;
+      Cell nc = grid_.cell(nx, ny);
+      if (dist[nc] < best) {
+        best = dist[nc];
+        out = nc;
+      }
+    }
+    return out;
+  }
+
+  void clear() { cache_.clear(); }
+  size_t size() const { return cache_.size(); }
+  // Bound memory like the reference bounds its caches (SURVEY §5): drop all
+  // when over budget (goals churn slowly; refill is cheap).
+  void trim(size_t max_fields) {
+    if (cache_.size() > max_fields) cache_.clear();
+  }
+
+ private:
+  const Grid& grid_;
+  std::unordered_map<Cell, std::vector<int32_t>> cache_;
+};
+
+}  // namespace mapd

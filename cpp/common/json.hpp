@@ -1,0 +1,312 @@
+// Minimal JSON value, parser, and writer for the host runtime's wire
+// protocol (the ~15 ad-hoc message types of SURVEY C10).  No external
+// dependencies; numbers are stored as double (all protocol numbers — cell
+// coordinates, ids, unix-ms timestamps — fit exactly in a double's 53-bit
+// mantissa) and written back as integers when integral.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <optional>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace mapd {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(double n) : type_(Type::Number), num_(n) {}
+  Json(int n) : type_(Type::Number), num_(n) {}
+  Json(int64_t n) : type_(Type::Number), num_(static_cast<double>(n)) {}
+  Json(uint64_t n) : type_(Type::Number), num_(static_cast<double>(n)) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_num(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_str() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const JsonArray& as_array() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+
+  // object field access; returns Null json for missing keys
+  const Json& operator[](const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  Json& set(const std::string& key, Json v) {
+    type_ = Type::Object;
+    obj_[key] = std::move(v);
+    return *this;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+
+  void push_back(Json v) {
+    type_ = Type::Array;
+    arr_.push_back(std::move(v));
+  }
+
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+  void write(std::ostream& out) const {
+    switch (type_) {
+      case Type::Null: out << "null"; break;
+      case Type::Bool: out << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 9.0e15) {
+          out << static_cast<int64_t>(num_);
+        } else {
+          out << num_;
+        }
+        break;
+      }
+      case Type::String: write_escaped(out, str_); break;
+      case Type::Array: {
+        out << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out << ',';
+          arr_[i].write(out);
+        }
+        out << ']';
+        break;
+      }
+      case Type::Object: {
+        out << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) out << ',';
+          first = false;
+          write_escaped(out, k);
+          out << ':';
+          v.write(out);
+        }
+        out << '}';
+        break;
+      }
+    }
+  }
+
+  // Parse; returns nullopt on malformed input (protocol handlers must treat
+  // garbage frames as ignorable, like the reference's serde_json fallbacks).
+  static std::optional<Json> parse(const std::string& text) {
+    Parser p{text, 0};
+    auto v = p.parse_value();
+    if (!v) return std::nullopt;
+    p.skip_ws();
+    if (p.pos != text.size()) return std::nullopt;
+    return v;
+  }
+
+ private:
+  struct Parser {
+    const std::string& s;
+    size_t pos;
+
+    void skip_ws() {
+      while (pos < s.size() && (s[pos] == ' ' || s[pos] == '\t' ||
+                                s[pos] == '\n' || s[pos] == '\r'))
+        ++pos;
+    }
+    bool eat(char c) {
+      skip_ws();
+      if (pos < s.size() && s[pos] == c) {
+        ++pos;
+        return true;
+      }
+      return false;
+    }
+    bool lit(const char* word) {
+      size_t n = std::string(word).size();
+      if (s.compare(pos, n, word) == 0) {
+        pos += n;
+        return true;
+      }
+      return false;
+    }
+    std::optional<Json> parse_value() {
+      skip_ws();
+      if (pos >= s.size()) return std::nullopt;
+      char c = s[pos];
+      if (c == 'n') return lit("null") ? std::optional<Json>(Json()) : std::nullopt;
+      if (c == 't') return lit("true") ? std::optional<Json>(Json(true)) : std::nullopt;
+      if (c == 'f') return lit("false") ? std::optional<Json>(Json(false)) : std::nullopt;
+      if (c == '"') return parse_string();
+      if (c == '[') return parse_array();
+      if (c == '{') return parse_object();
+      return parse_number();
+    }
+    std::optional<Json> parse_string() {
+      if (!eat('"')) return std::nullopt;
+      std::string out;
+      while (pos < s.size()) {
+        char c = s[pos++];
+        if (c == '"') return Json(out);
+        if (c == '\\') {
+          if (pos >= s.size()) return std::nullopt;
+          char e = s[pos++];
+          switch (e) {
+            case '"': out += '"'; break;
+            case '\\': out += '\\'; break;
+            case '/': out += '/'; break;
+            case 'b': out += '\b'; break;
+            case 'f': out += '\f'; break;
+            case 'n': out += '\n'; break;
+            case 'r': out += '\r'; break;
+            case 't': out += '\t'; break;
+            case 'u': {
+              if (pos + 4 > s.size()) return std::nullopt;
+              unsigned code = 0;
+              for (int i = 0; i < 4; ++i) {
+                char h = s[pos++];
+                code <<= 4;
+                if (h >= '0' && h <= '9') code |= h - '0';
+                else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+                else return std::nullopt;
+              }
+              // utf-8 encode the BMP code point (surrogate pairs unneeded
+              // for this protocol, which is ASCII-heavy)
+              if (code < 0x80) {
+                out += static_cast<char>(code);
+              } else if (code < 0x800) {
+                out += static_cast<char>(0xC0 | (code >> 6));
+                out += static_cast<char>(0x80 | (code & 0x3F));
+              } else {
+                out += static_cast<char>(0xE0 | (code >> 12));
+                out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+                out += static_cast<char>(0x80 | (code & 0x3F));
+              }
+              break;
+            }
+            default: return std::nullopt;
+          }
+        } else {
+          out += c;
+        }
+      }
+      return std::nullopt;
+    }
+    std::optional<Json> parse_number() {
+      size_t start = pos;
+      if (pos < s.size() && (s[pos] == '-' || s[pos] == '+')) ++pos;
+      while (pos < s.size() &&
+             (isdigit(static_cast<unsigned char>(s[pos])) || s[pos] == '.' ||
+              s[pos] == 'e' || s[pos] == 'E' || s[pos] == '-' || s[pos] == '+'))
+        ++pos;
+      if (pos == start) return std::nullopt;
+      try {
+        return Json(std::stod(s.substr(start, pos - start)));
+      } catch (...) {
+        return std::nullopt;
+      }
+    }
+    std::optional<Json> parse_array() {
+      if (!eat('[')) return std::nullopt;
+      JsonArray out;
+      skip_ws();
+      if (eat(']')) return Json(std::move(out));
+      while (true) {
+        auto v = parse_value();
+        if (!v) return std::nullopt;
+        out.push_back(std::move(*v));
+        if (eat(']')) return Json(std::move(out));
+        if (!eat(',')) return std::nullopt;
+      }
+    }
+    std::optional<Json> parse_object() {
+      if (!eat('{')) return std::nullopt;
+      JsonObject out;
+      skip_ws();
+      if (eat('}')) return Json(std::move(out));
+      while (true) {
+        skip_ws();
+        auto k = parse_string();
+        if (!k) return std::nullopt;
+        if (!eat(':')) return std::nullopt;
+        auto v = parse_value();
+        if (!v) return std::nullopt;
+        out[k->as_str()] = std::move(*v);
+        if (eat('}')) return Json(std::move(out));
+        if (!eat(',')) return std::nullopt;
+      }
+    }
+  };
+
+  static void write_escaped(std::ostream& out, const std::string& s) {
+    out << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': out << "\\\""; break;
+        case '\\': out << "\\\\"; break;
+        case '\b': out << "\\b"; break;
+        case '\f': out << "\\f"; break;
+        case '\n': out << "\\n"; break;
+        case '\r': out << "\\r"; break;
+        case '\t': out << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out << buf;
+          } else {
+            out << c;
+          }
+      }
+    }
+    out << '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace mapd

@@ -1,0 +1,308 @@
+// Native metrics: task lifecycle, path computation, network counters —
+// behavior and CSV-schema parity with the reference's src/map/task_metrics.rs
+// (SURVEY C11) and with the Python twin
+// (p2p_distributed_tswap_tpu/metrics/task_metrics.py); the pandas analysis
+// layer consumes either side's CSVs unchanged.
+#pragma once
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <map>
+#include <numeric>
+#include <optional>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace mapd {
+
+enum class TaskStatus { Pending, Sent, Received, Running, Completed, Failed };
+
+inline const char* task_status_str(TaskStatus s) {
+  switch (s) {
+    case TaskStatus::Pending: return "pending";
+    case TaskStatus::Sent: return "sent";
+    case TaskStatus::Received: return "received";
+    case TaskStatus::Running: return "running";
+    case TaskStatus::Completed: return "completed";
+    case TaskStatus::Failed: return "failed";
+  }
+  return "?";
+}
+
+struct TaskMetric {
+  uint64_t task_id = 0;
+  std::string peer_id;
+  int64_t sent_time = 0;  // unix ms
+  std::optional<int64_t> received_time;
+  std::optional<int64_t> start_time;
+  std::optional<int64_t> completion_time;
+  TaskStatus status = TaskStatus::Sent;
+
+  std::optional<int64_t> total_time() const {
+    if (!completion_time) return std::nullopt;
+    return *completion_time - sent_time;
+  }
+  std::optional<int64_t> processing_time() const {
+    if (!start_time || !completion_time) return std::nullopt;
+    return *completion_time - *start_time;
+  }
+  std::optional<int64_t> startup_latency() const {
+    if (!start_time) return std::nullopt;
+    return *start_time - sent_time;
+  }
+};
+
+struct TaskStatistics {
+  size_t total_tasks = 0, completed_tasks = 0, failed_tasks = 0;
+  int64_t avg_total_time = 0, avg_processing_time = 0, avg_startup_latency = 0;
+  int64_t min_total_time = 0, max_total_time = 0;
+  int64_t min_processing_time = 0, max_processing_time = 0;
+
+  std::string to_string() const {
+    double rate = total_tasks
+                      ? 100.0 * static_cast<double>(completed_tasks) /
+                            static_cast<double>(total_tasks)
+                      : 0.0;
+    char buf[640];
+    snprintf(buf, sizeof(buf),
+             "\U0001F4CA Task Statistics:\n"
+             "├─ Total Tasks: %zu\n"
+             "├─ Completed: %zu (Success Rate: %.1f%%)\n"
+             "├─ Failed: %zu\n"
+             "├─ Avg Total Time: %lld ms\n"
+             "├─ Avg Processing Time: %lld ms\n"
+             "├─ Avg Startup Latency: %lld ms\n"
+             "├─ Min/Max Total Time: %lld ms / %lld ms\n"
+             "└─ Min/Max Processing Time: %lld ms / %lld ms",
+             total_tasks, completed_tasks, rate, failed_tasks,
+             static_cast<long long>(avg_total_time),
+             static_cast<long long>(avg_processing_time),
+             static_cast<long long>(avg_startup_latency),
+             static_cast<long long>(min_total_time),
+             static_cast<long long>(max_total_time),
+             static_cast<long long>(min_processing_time),
+             static_cast<long long>(max_processing_time));
+    return buf;
+  }
+};
+
+class TaskMetricsCollector {
+ public:
+  std::map<uint64_t, TaskMetric> metrics;
+
+  void add_metric(TaskMetric m) { metrics[m.task_id] = std::move(m); }
+
+  void update_received(uint64_t id, int64_t at_ms) {
+    auto it = metrics.find(id);
+    if (it != metrics.end()) {
+      it->second.received_time = at_ms;
+      it->second.status = TaskStatus::Received;
+    }
+  }
+  void update_started(uint64_t id, int64_t at_ms) {
+    auto it = metrics.find(id);
+    if (it != metrics.end()) {
+      it->second.start_time = at_ms;
+      it->second.status = TaskStatus::Running;
+    }
+  }
+  void update_completed(uint64_t id, int64_t at_ms) {
+    auto it = metrics.find(id);
+    if (it != metrics.end()) {
+      it->second.completion_time = at_ms;
+      it->second.status = TaskStatus::Completed;
+    }
+  }
+  void update_failed(uint64_t id) {
+    auto it = metrics.find(id);
+    if (it != metrics.end()) it->second.status = TaskStatus::Failed;
+  }
+  void clear() { metrics.clear(); }
+
+  TaskStatistics statistics() const {
+    TaskStatistics s;
+    s.total_tasks = metrics.size();
+    std::vector<int64_t> totals, procs, starts;
+    for (const auto& [id, m] : metrics) {
+      if (m.status == TaskStatus::Failed) ++s.failed_tasks;
+      if (m.status != TaskStatus::Completed) continue;
+      ++s.completed_tasks;
+      if (auto t = m.total_time()) totals.push_back(*t);
+      if (auto t = m.processing_time()) procs.push_back(*t);
+      if (auto t = m.startup_latency()) starts.push_back(*t);
+    }
+    auto avg = [](const std::vector<int64_t>& v) -> int64_t {
+      if (v.empty()) return 0;
+      return std::accumulate(v.begin(), v.end(), int64_t{0}) /
+             static_cast<int64_t>(v.size());
+    };
+    auto minv = [](const std::vector<int64_t>& v) {
+      return v.empty() ? int64_t{0} : *std::min_element(v.begin(), v.end());
+    };
+    auto maxv = [](const std::vector<int64_t>& v) {
+      return v.empty() ? int64_t{0} : *std::max_element(v.begin(), v.end());
+    };
+    s.avg_total_time = avg(totals);
+    s.avg_processing_time = avg(procs);
+    s.avg_startup_latency = avg(starts);
+    s.min_total_time = minv(totals);
+    s.max_total_time = maxv(totals);
+    s.min_processing_time = minv(procs);
+    s.max_processing_time = maxv(procs);
+    return s;
+  }
+
+  // Exact schema of task_metrics.rs:179-227: missing timestamps as 0,
+  // missing derived times as empty strings.
+  std::string to_csv_string() const {
+    std::ostringstream out;
+    out << "task_id,peer_id,sent_time_ms,received_time_ms,start_time_ms,"
+           "completion_time_ms,total_time_ms,processing_time_ms,"
+           "startup_latency_ms,status\n";
+    for (const auto& [id, m] : metrics) {  // std::map iterates id-sorted
+      auto opt = [](std::optional<int64_t> v) {
+        return v ? std::to_string(*v) : std::string();
+      };
+      out << m.task_id << ',' << m.peer_id << ',' << m.sent_time << ','
+          << m.received_time.value_or(0) << ',' << m.start_time.value_or(0)
+          << ',' << m.completion_time.value_or(0) << ','
+          << opt(m.total_time()) << ',' << opt(m.processing_time()) << ','
+          << opt(m.startup_latency()) << ',' << task_status_str(m.status)
+          << '\n';
+    }
+    return out.str();
+  }
+};
+
+class PathComputationMetrics {
+ public:
+  struct Stats {
+    size_t samples;
+    double avg_micros;
+    int64_t min_micros, max_micros;
+    std::string to_string() const {
+      char buf[256];
+      snprintf(buf, sizeof(buf),
+               "⏱️ Path Computation Stats:\n"
+               "├─ Samples: %zu\n├─ Avg: %.3f ms\n├─ Min: %.3f ms\n"
+               "└─ Max: %.3f ms",
+               samples, avg_micros / 1000.0,
+               static_cast<double>(min_micros) / 1000.0,
+               static_cast<double>(max_micros) / 1000.0);
+      return buf;
+    }
+  };
+
+  void record_micros(int64_t us, std::optional<int64_t> ts_ms = std::nullopt) {
+    samples_.push_back(us);
+    timestamps_.push_back(ts_ms);
+  }
+  void clear() {
+    samples_.clear();
+    timestamps_.clear();
+  }
+  size_t size() const { return samples_.size(); }
+  bool empty() const { return samples_.empty(); }
+
+  std::optional<Stats> statistics() const {
+    if (samples_.empty()) return std::nullopt;
+    Stats s;
+    s.samples = samples_.size();
+    s.min_micros = *std::min_element(samples_.begin(), samples_.end());
+    s.max_micros = *std::max_element(samples_.begin(), samples_.end());
+    s.avg_micros =
+        static_cast<double>(
+            std::accumulate(samples_.begin(), samples_.end(), int64_t{0})) /
+        static_cast<double>(samples_.size());
+    return s;
+  }
+
+  // Schema of task_metrics.rs:332-339 (+ optional trailing timestamp_ms
+  // column for compare_path_metrics.py's per-step bucketing).
+  std::string to_csv_string() const {
+    bool with_ts = false;
+    for (const auto& t : timestamps_) with_ts = with_ts || t.has_value();
+    std::ostringstream out;
+    out << "sample_index,duration_micros,duration_millis";
+    if (with_ts) out << ",timestamp_ms";
+    out << '\n';
+    for (size_t i = 0; i < samples_.size(); ++i) {
+      char ms[32];
+      snprintf(ms, sizeof(ms), "%.3f",
+               static_cast<double>(samples_[i]) / 1000.0);
+      out << i << ',' << samples_[i] << ',' << ms;
+      if (with_ts) {
+        out << ',';
+        if (timestamps_[i]) out << *timestamps_[i];
+      }
+      out << '\n';
+    }
+    return out.str();
+  }
+
+ private:
+  std::vector<int64_t> samples_;
+  std::vector<std::optional<int64_t>> timestamps_;
+};
+
+class NetworkMetrics {
+ public:
+  NetworkMetrics() : start_(std::chrono::steady_clock::now()) {}
+
+  void record_sent(size_t nbytes) {
+    ++messages_sent;
+    bytes_sent += nbytes;
+  }
+  void record_received(size_t nbytes) {
+    ++messages_received;
+    bytes_received += nbytes;
+  }
+  double elapsed_secs() const {
+    return std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                         start_)
+        .count();
+  }
+  double send_rate() const {
+    double e = elapsed_secs();
+    return e > 0 ? static_cast<double>(messages_sent) / e : 0;
+  }
+  double recv_rate() const {
+    double e = elapsed_secs();
+    return e > 0 ? static_cast<double>(messages_received) / e : 0;
+  }
+  double bandwidth_sent_kbps() const {
+    double e = elapsed_secs();
+    return e > 0 ? static_cast<double>(bytes_sent) * 8.0 / (e * 1000.0) : 0;
+  }
+  double bandwidth_recv_kbps() const {
+    double e = elapsed_secs();
+    return e > 0 ? static_cast<double>(bytes_received) * 8.0 / (e * 1000.0)
+                 : 0;
+  }
+  std::string to_string() const {
+    char buf[512];
+    snprintf(buf, sizeof(buf),
+             "\U0001F4E1 Network Communication Stats:\n"
+             "├─ Messages sent: %llu (%.1f msg/s)\n"
+             "├─ Messages received: %llu (%.1f msg/s)\n"
+             "├─ Bandwidth sent: %.2f KB (%.1f kbps)\n"
+             "├─ Bandwidth received: %.2f KB (%.1f kbps)\n"
+             "└─ Duration: %.1fs",
+             static_cast<unsigned long long>(messages_sent), send_rate(),
+             static_cast<unsigned long long>(messages_received), recv_rate(),
+             static_cast<double>(bytes_sent) / 1024.0, bandwidth_sent_kbps(),
+             static_cast<double>(bytes_received) / 1024.0,
+             bandwidth_recv_kbps(), elapsed_secs());
+    return buf;
+  }
+
+  uint64_t messages_sent = 0, messages_received = 0;
+  uint64_t bytes_sent = 0, bytes_received = 0;
+
+ private:
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace mapd

@@ -1,0 +1,132 @@
+// Line-framed TCP helpers for the host runtime: nonblocking sockets with
+// per-connection read/write buffering, driven by a poll() loop.  This is the
+// transport under the pub/sub bus — the TPU-native stand-in for the
+// reference's libp2p TCP + noise + yamux stack (SURVEY C9); framing is one
+// JSON document per '\n'-terminated line.
+#pragma once
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <deque>
+#include <optional>
+#include <string>
+
+namespace mapd {
+
+inline int set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+// Listening socket on 127.0.0.1:port; returns fd or -1.
+inline int tcp_listen(uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(fd, 128) < 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+// Blocking connect to host:port; returns fd or -1.
+inline int tcp_connect(const std::string& host, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// Buffered line-framed connection over a nonblocking fd.
+class LineConn {
+ public:
+  explicit LineConn(int fd = -1) : fd_(fd) {}
+
+  int fd() const { return fd_; }
+  bool valid() const { return fd_ >= 0; }
+  bool wants_write() const { return !outbuf_.empty(); }
+
+  // Append a frame to the write buffer (flushed by on_writable).
+  void send_line(const std::string& line) {
+    outbuf_ += line;
+    outbuf_ += '\n';
+  }
+
+  // Pump readable data; returns false when the peer closed or errored.
+  bool on_readable() {
+    char buf[65536];
+    while (true) {
+      ssize_t n = ::read(fd_, buf, sizeof(buf));
+      if (n > 0) {
+        inbuf_.append(buf, static_cast<size_t>(n));
+        if (inbuf_.size() > kMaxBuffer) return false;  // protocol abuse
+      } else if (n == 0) {
+        return false;
+      } else {
+        return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+      }
+    }
+  }
+
+  // Flush pending writes; returns false on error.
+  bool on_writable() {
+    while (!outbuf_.empty()) {
+      ssize_t n = ::write(fd_, outbuf_.data(), outbuf_.size());
+      if (n > 0) {
+        outbuf_.erase(0, static_cast<size_t>(n));
+      } else {
+        return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+      }
+    }
+    return true;
+  }
+
+  // Pop the next complete line, if any.
+  std::optional<std::string> next_line() {
+    auto nl = inbuf_.find('\n');
+    if (nl == std::string::npos) return std::nullopt;
+    std::string line = inbuf_.substr(0, nl);
+    inbuf_.erase(0, nl + 1);
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    return line;
+  }
+
+  void close_fd() {
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+  }
+
+ private:
+  static constexpr size_t kMaxBuffer = 16 * 1024 * 1024;
+  int fd_;
+  std::string inbuf_;
+  std::string outbuf_;
+};
+
+}  // namespace mapd

@@ -1,0 +1,191 @@
+// The ONE native sequential TSWAP implementation (the reference keeps three
+// near-identical copies — src/algorithm/tswap.rs:174-390 and verbatim clones
+// in both binaries; SURVEY explicitly asks for exactly one).
+//
+// Semantics transcribed from tswap_step (src/algorithm/tswap.rs:174-286):
+// Rule 1 stay at goal; Rule 3 swap goals with a blocker parked on its goal;
+// Rule 4 deadlock-chain walk with abort-on-revisit and backward goal
+// rotation; movement pass with mutual position swaps.  Next hops descend BFS
+// distance fields (DistanceCache) instead of per-call A* — same shortest
+// paths, deterministic tie-break, shared with the Python oracle and the TPU
+// kernels.
+//
+// Used by: the centralized manager's native planning tick (its --solver=cpu
+// mode) and, through decide_local below, the decentralized agent's local
+// radius-limited decision.
+#pragma once
+
+#include <algorithm>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "grid.hpp"
+
+namespace mapd {
+
+struct TswapAgent {
+  int id = 0;
+  Cell v = 0;  // current cell
+  Cell g = 0;  // goal cell
+};
+
+inline std::optional<size_t> occupant_of(const std::vector<TswapAgent>& agents,
+                                         Cell cell) {
+  for (size_t k = 0; k < agents.size(); ++k)
+    if (agents[k].v == cell) return k;  // first match, like iter().position
+  return std::nullopt;
+}
+
+// One sequential TSWAP step over all agents, in index order.
+inline void tswap_step(std::vector<TswapAgent>& agents, DistanceCache& dc) {
+  const size_t n = agents.size();
+
+  // --- goal-swapping phase (Rules 1, 3, 4) ---
+  for (size_t i = 0; i < n; ++i) {
+    if (agents[i].v == agents[i].g) continue;  // Rule 1
+    auto u = dc.next_hop(agents[i].v, agents[i].g);
+    if (!u) continue;
+    auto j = occupant_of(agents, *u);
+    if (!j || *j == i) continue;
+    if (agents[*j].v == agents[*j].g) {
+      std::swap(agents[i].g, agents[*j].g);  // Rule 3
+    } else {
+      // Rule 4: walk the blocking chain
+      std::vector<size_t> a_p{i};
+      size_t cur = *j;
+      bool deadlock = false;
+      while (true) {
+        if (agents[cur].v == agents[cur].g) break;
+        auto w = dc.next_hop(agents[cur].v, agents[cur].g);
+        if (!w) break;
+        auto c = occupant_of(agents, *w);
+        if (!c) break;
+        if (std::find(a_p.begin(), a_p.end(), cur) != a_p.end()) {
+          a_p.clear();
+          break;  // rho-shaped revisit not through i: abort
+        }
+        a_p.push_back(cur);
+        cur = *c;
+        if (cur == i) {
+          deadlock = true;
+          break;
+        }
+      }
+      if (deadlock && a_p.size() > 1) {
+        Cell last_goal = agents[a_p.back()].g;
+        for (size_t k = a_p.size() - 1; k >= 1; --k)
+          agents[a_p[k]].g = agents[a_p[k - 1]].g;
+        agents[a_p[0]].g = last_goal;
+      }
+    }
+  }
+
+  // --- movement phase (Rules 2, 5, mutual swap) ---
+  for (size_t i = 0; i < n; ++i) {
+    if (agents[i].v == agents[i].g) continue;
+    auto u = dc.next_hop(agents[i].v, agents[i].g);
+    if (!u) continue;
+    auto j = occupant_of(agents, *u);
+    if (j) {
+      if (*j != i) {
+        auto uj = dc.next_hop(agents[*j].v, agents[*j].g);
+        if (uj && *uj == agents[i].v)
+          std::swap(agents[i].v, agents[*j].v);  // mutual swap
+        // else Rule 5: stay
+      }
+    } else {
+      agents[i].v = *u;  // Rule 2
+    }
+  }
+}
+
+// ---------- decentralized local decision (SURVEY C7) ----------
+//
+// Transcribed semantics of compute_next_move_with_tswap
+// (src/bin/decentralized/agent.rs:329-462): one agent decides from its own
+// (pos, goal) and the cached positions/goals of neighbors within the
+// visibility radius; coordination (goal swap / rotation) happens over the
+// wire instead of by direct mutation.
+
+struct Neighbor {
+  std::string peer_id;
+  Cell pos = 0;
+  Cell goal = 0;
+};
+
+struct LocalDecision {
+  enum class Kind { Move, Wait, WaitForGoalSwap, WaitForRotation };
+  Kind kind = Kind::Wait;
+  Cell next = 0;                         // Move
+  std::string swap_peer;                 // WaitForGoalSwap
+  std::vector<std::string> participants; // WaitForRotation (peer ids, ring order)
+  std::vector<Cell> goals;               // WaitForRotation goals, same order
+};
+
+inline LocalDecision decide_local(Cell my_pos, Cell my_goal,
+                                  const std::string& my_id,
+                                  const std::vector<Neighbor>& nearby,
+                                  DistanceCache& dc) {
+  LocalDecision wait;
+  wait.kind = LocalDecision::Kind::Wait;
+  if (my_pos == my_goal) return wait;  // Rule 1
+  auto u = dc.next_hop(my_pos, my_goal);
+  if (!u) return wait;
+
+  auto occupant = [&](Cell c) -> const Neighbor* {
+    for (const auto& nb : nearby)
+      if (nb.pos == c) return &nb;
+    return nullptr;
+  };
+
+  const Neighbor* blocker = occupant(*u);
+  if (!blocker) {
+    LocalDecision d;
+    d.kind = LocalDecision::Kind::Move;  // Rule 2
+    d.next = *u;
+    return d;
+  }
+  if (blocker->pos == blocker->goal) {
+    LocalDecision d;
+    d.kind = LocalDecision::Kind::WaitForGoalSwap;
+    d.swap_peer = blocker->peer_id;  // Rule 3 via request/response
+    return d;
+  }
+  // Rule 4: chain walk over the local neighbor view
+  std::vector<const Neighbor*> chain;
+  const Neighbor* cur = blocker;
+  bool deadlock = false;
+  while (true) {
+    if (cur->pos == cur->goal) break;
+    auto w = dc.next_hop(cur->pos, cur->goal);
+    if (!w) break;
+    if (*w == my_pos) {
+      deadlock = true;  // chain closes back on us
+      break;
+    }
+    const Neighbor* nxt = occupant(*w);
+    if (!nxt) break;
+    bool seen = false;
+    for (auto* p : chain) seen = seen || p == cur;
+    if (seen) break;
+    chain.push_back(cur);
+    cur = nxt;
+  }
+  if (deadlock) {
+    if (std::find(chain.begin(), chain.end(), cur) == chain.end())
+      chain.push_back(cur);
+    LocalDecision d;
+    d.kind = LocalDecision::Kind::WaitForRotation;
+    d.participants.push_back(my_id);
+    d.goals.push_back(my_goal);
+    for (auto* p : chain) {
+      d.participants.push_back(p->peer_id);
+      d.goals.push_back(p->goal);
+    }
+    return d;
+  }
+  return wait;  // Rule 5
+}
+
+}  // namespace mapd

@@ -1,0 +1,462 @@
+// mapd_manager_centralized — "all pathfinding done centrally" (SURVEY C5).
+//
+// Native rebuild of src/bin/centralized/manager.rs: tracks per-peer
+// AgentState {current_pos, goal_pos, task, task_phase} from position_update
+// messages, runs a planning tick every 500 ms (one sequential TSWAP step over
+// all tracked agents), emits a move_instruction per agent, flips
+// pickup -> delivery goals when agents reach pickups, assigns tasks to idle
+// agents with a pending queue drained on position updates and completions,
+// auto-reassigns a fresh task on completion, bounded-cache cleanup every
+// 30 s, --clean to ignore re-discovered peers, and the stdin operator CLI.
+//
+// Planning backends:
+//   --solver=cpu  (default) native sequential TSWAP (common/tswap.hpp)
+//   --solver=tpu  delegate each tick to the JAX solver daemon
+//                 (runtime/solverd.py) over bus topic "solver" — the
+//                 BASELINE.json north-star deployment shape.
+//
+// Usage: mapd_manager_centralized [--port P] [--map FILE] [--seed S]
+//                                 [--clean] [--solver cpu|tpu]
+
+#include <poll.h>
+#include <signal.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <optional>
+#include <random>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../common/bus.hpp"
+#include "../common/grid.hpp"
+#include "../common/json.hpp"
+#include "../common/tswap.hpp"
+
+using namespace mapd;
+
+namespace {
+
+constexpr int64_t kPlanningMs = 500;   // ref :567
+constexpr int64_t kCleanupMs = 30000;  // ref :727
+constexpr size_t kMaxAgents = 500;     // ref :734
+constexpr size_t kMaxPeers = 1000;     // ref :752
+
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
+
+enum class Phase { None, ToPickup, ToDelivery };
+
+struct AgentInfo {
+  Cell pos = 0;
+  Cell goal = 0;
+  std::optional<Json> task;
+  Phase phase = Phase::None;
+  int64_t last_seen_ms = 0;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  uint16_t port = 7400;
+  std::string map_file, solver = "cpu";
+  uint64_t seed = std::random_device{}();
+  bool clean = false;
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--port") && i + 1 < argc)
+      port = static_cast<uint16_t>(atoi(argv[++i]));
+    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
+      map_file = argv[++i];
+    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
+      seed = strtoull(argv[++i], nullptr, 10);
+    else if (!strcmp(argv[i], "--clean"))
+      clean = true;
+    else if (!strcmp(argv[i], "--solver") && i + 1 < argc)
+      solver = argv[++i];
+    else if (!strncmp(argv[i], "--solver=", 9))
+      solver = argv[i] + 9;
+  }
+  signal(SIGINT, handle_stop);
+  signal(SIGTERM, handle_stop);
+  signal(SIGPIPE, SIG_IGN);
+
+  Grid grid = Grid::default_grid();
+  if (!map_file.empty()) {
+    auto g = Grid::from_file(map_file);
+    if (!g) {
+      fprintf(stderr, "cannot load map %s\n", map_file.c_str());
+      return 1;
+    }
+    grid = *g;
+  }
+  DistanceCache dc(grid);
+  std::mt19937_64 rng(seed);
+
+  BusClient bus;
+  std::string my_id = random_peer_id();
+  if (!bus.connect("127.0.0.1", port, my_id)) {
+    fprintf(stderr, "cannot connect to bus on port %u\n", port);
+    return 1;
+  }
+  bus.subscribe("mapd");
+  if (solver == "tpu") bus.subscribe("solver");
+  printf("🧠 centralized manager %s up (grid %dx%d, solver=%s%s)\n",
+         my_id.c_str(), grid.width, grid.height, solver.c_str(),
+         clean ? ", clean" : "");
+  printf("Commands: task | tasks N | metrics | save <file> | "
+         "save path <file> | reset | quit\n");
+  fflush(stdout);
+
+  std::map<std::string, AgentInfo> agents;
+  std::set<std::string> known_left;
+  std::deque<Json> pending_tasks;  // pending_task_requests (ref :367-436)
+  TaskMetricsCollector task_metrics;
+  PathComputationMetrics path_metrics;
+  uint64_t next_task_id = 1;
+  int64_t plan_seq = 0;
+
+  auto free_cells = grid.free_cells();
+  auto gen_point = [&]() { return free_cells[rng() % free_cells.size()]; };
+
+  auto point_json = [&](Cell c) {
+    Json p;
+    p.push_back(Json(grid.x_of(c)));
+    p.push_back(Json(grid.y_of(c)));
+    return p;
+  };
+  auto parse_point = [&](const Json& j) -> std::optional<Cell> {
+    const auto& arr = j.as_array();
+    if (arr.size() != 2) return std::nullopt;
+    int x = static_cast<int>(arr[0].as_int());
+    int y = static_cast<int>(arr[1].as_int());
+    if (!grid.in_bounds(x, y)) return std::nullopt;
+    return grid.cell(x, y);
+  };
+
+  auto make_task = [&]() {
+    Cell pickup = gen_point(), delivery = gen_point();
+    while (delivery == pickup) delivery = gen_point();
+    Json t;
+    t.set("pickup", point_json(pickup))
+        .set("delivery", point_json(delivery))
+        .set("peer_id", Json())
+        .set("task_id", next_task_id++);
+    return t;
+  };
+
+  auto assign_task = [&](const std::string& peer, Json task) {
+    task.set("peer_id", peer);
+    uint64_t id = static_cast<uint64_t>(task["task_id"].as_int());
+    TaskMetric m;
+    m.task_id = id;
+    m.peer_id = peer;
+    m.sent_time = unix_ms();
+    task_metrics.add_metric(m);
+    AgentInfo& a = agents[peer];
+    a.task = task;
+    a.phase = Phase::ToPickup;
+    if (auto p = parse_point(task["pickup"])) a.goal = *p;
+    bus.publish("mapd", task);
+    printf("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
+           peer.c_str());
+  };
+
+  // drain the pending queue onto idle tracked agents (ref :367-436)
+  auto try_assign_pending = [&]() {
+    while (!pending_tasks.empty()) {
+      std::string idle_peer;
+      for (auto& [peer, a] : agents)
+        if (!a.task) {
+          idle_peer = peer;
+          break;
+        }
+      if (idle_peer.empty()) return;
+      Json t = pending_tasks.front();
+      pending_tasks.pop_front();
+      assign_task(idle_peer, std::move(t));
+    }
+  };
+
+  auto emit_moves = [&](const std::vector<std::string>& ids,
+                        const std::vector<Cell>& next) {
+    for (size_t k = 0; k < ids.size(); ++k) {
+      auto it = agents.find(ids[k]);
+      if (it == agents.end()) continue;
+      if (next[k] == it->second.pos) continue;  // no-op moves not sent
+      Json mi;
+      mi.set("type", "move_instruction")
+          .set("peer_id", ids[k])
+          .set("next_pos", point_json(next[k]))
+          .set("timestamp", unix_ms());
+      bus.publish("mapd", mi);
+    }
+  };
+
+  // pickup-arrival phase transitions (ref :695-709): the MANAGER flips the
+  // goal to delivery in centralized mode
+  auto pickup_transitions = [&]() {
+    for (auto& [peer, a] : agents) {
+      if (a.phase == Phase::ToPickup && a.task) {
+        auto pk = parse_point((*a.task)["pickup"]);
+        if (pk && a.pos == *pk) {
+          if (auto dl = parse_point((*a.task)["delivery"])) {
+            a.goal = *dl;
+            a.phase = Phase::ToDelivery;
+            printf("📍 %s reached pickup, now -> delivery\n", peer.c_str());
+          }
+        }
+      }
+    }
+  };
+
+  auto plan_native = [&]() {
+    std::vector<std::string> ids;
+    std::vector<TswapAgent> ta;
+    for (auto& [peer, a] : agents) {
+      ids.push_back(peer);
+      ta.push_back(TswapAgent{static_cast<int>(ta.size()), a.pos, a.goal});
+    }
+    if (ta.empty()) return;
+    auto t0 = std::chrono::steady_clock::now();
+    tswap_step(ta, dc);
+    int64_t us = std::chrono::duration_cast<std::chrono::microseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+    path_metrics.record_micros(us, unix_ms());
+    // goals may have been swapped/rotated by TSWAP: adopt them
+    std::vector<Cell> next(ids.size());
+    for (size_t k = 0; k < ids.size(); ++k) {
+      agents[ids[k]].goal = ta[k].g;
+      next[k] = ta[k].v;
+    }
+    emit_moves(ids, next);
+  };
+
+  auto plan_request_tpu = [&]() {
+    Json req;
+    Json arr;
+    for (auto& [peer, a] : agents) {
+      Json e;
+      e.set("peer_id", peer)
+          .set("pos", point_json(a.pos))
+          .set("goal", point_json(a.goal));
+      arr.push_back(e);
+    }
+    if (arr.is_null()) return;
+    req.set("type", "plan_request").set("seq", ++plan_seq).set("agents", arr);
+    bus.publish("solver", req);
+  };
+
+  auto handle_plan_response = [&](const Json& d) {
+    if (d["seq"].as_int() != plan_seq) return;  // stale tick
+    int64_t us = d["duration_micros"].as_int();
+    path_metrics.record_micros(us, unix_ms());
+    std::vector<std::string> ids;
+    std::vector<Cell> next;
+    for (const auto& mv : d["moves"].as_array()) {
+      auto np = parse_point(mv["next_pos"]);
+      if (!np) continue;
+      const std::string& peer = mv["peer_id"].as_str();
+      auto it = agents.find(peer);
+      if (it == agents.end()) continue;
+      if (mv.has("goal")) {  // solver-side swaps/rotations update goals
+        if (auto g = parse_point(mv["goal"])) it->second.goal = *g;
+      }
+      ids.push_back(peer);
+      next.push_back(*np);
+    }
+    emit_moves(ids, next);
+  };
+
+  auto save_csv = [&](const std::string& path, const std::string& content) {
+    std::ofstream out(path);
+    if (!out) {
+      printf("⚠️  cannot write %s\n", path.c_str());
+      return;
+    }
+    out << content;
+    printf("💾 saved %s\n", path.c_str());
+  };
+
+  auto handle_command = [&](const std::string& line) -> bool {
+    std::istringstream in(line);
+    std::string cmd;
+    in >> cmd;
+    if (cmd == "quit" || cmd == "exit") return false;
+    if (cmd == "task") {
+      pending_tasks.push_back(make_task());
+      try_assign_pending();
+    } else if (cmd == "tasks") {
+      size_t n = 0;
+      in >> n;
+      if (!n) n = agents.size();
+      for (size_t k = 0; k < n; ++k) pending_tasks.push_back(make_task());
+      try_assign_pending();
+      printf("📦 queued %zu tasks (%zu pending)\n", n, pending_tasks.size());
+    } else if (cmd == "metrics") {
+      printf("%s\n", task_metrics.statistics().to_string().c_str());
+      if (auto ps = path_metrics.statistics())
+        printf("%s\n", ps->to_string().c_str());
+      printf("%s\n", bus.net_metrics().to_string().c_str());
+    } else if (cmd == "save") {
+      std::string a, b;
+      in >> a >> b;
+      if (a == "path")
+        save_csv(b.empty() ? "path_metrics.csv" : b,
+                 path_metrics.to_csv_string());
+      else
+        save_csv(a.empty() ? "task_metrics.csv" : a,
+                 task_metrics.to_csv_string());
+    } else if (cmd == "reset") {
+      task_metrics.clear();
+      path_metrics.clear();
+      pending_tasks.clear();
+      for (auto& [peer, a] : agents) {
+        a.task.reset();
+        a.phase = Phase::None;
+        a.goal = a.pos;
+      }
+      printf("🔄 state reset\n");
+    } else if (!cmd.empty()) {
+      Json raw;
+      raw.set("raw", line);
+      bus.publish("mapd", raw);
+    }
+    fflush(stdout);
+    return true;
+  };
+
+  int64_t last_plan = 0, last_cleanup = mono_ms();
+  std::string stdin_buf;
+  bool running = true;
+
+  while (running && !g_stop && bus.connected()) {
+    pollfd pfds[2] = {
+        {bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0},
+        {STDIN_FILENO, POLLIN, 0}};
+    poll(pfds, 2, 100);
+
+    if (pfds[1].revents & POLLIN) {
+      char buf[4096];
+      ssize_t n = read(STDIN_FILENO, buf, sizeof(buf));
+      if (n > 0) {
+        stdin_buf.append(buf, static_cast<size_t>(n));
+        size_t nl;
+        while ((nl = stdin_buf.find('\n')) != std::string::npos) {
+          std::string line = stdin_buf.substr(0, nl);
+          stdin_buf.erase(0, nl + 1);
+          if (!handle_command(line)) {
+            running = false;
+            break;
+          }
+        }
+      } else if (n == 0) {
+        running = false;
+      }
+    }
+
+    bool alive = bus.pump(
+        [&](const BusClient::Msg& m) {
+          const Json& d = m.data;
+          const std::string& type = d["type"].as_str();
+          if (type == "position_update") {
+            const std::string& peer = d["peer_id"].as_str();
+            if (clean && known_left.count(peer)) return;
+            auto p = parse_point(d["position"]);
+            if (!p) return;
+            auto it = agents.find(peer);
+            if (it == agents.end()) {
+              AgentInfo a;
+              a.pos = a.goal = *p;
+              a.last_seen_ms = mono_ms();
+              agents[peer] = a;
+              printf("🔍 tracking agent %s (%zu)\n", peer.c_str(),
+                     agents.size());
+              try_assign_pending();
+            } else {
+              it->second.pos = *p;
+              it->second.last_seen_ms = mono_ms();
+              if (!it->second.task) it->second.goal = *p;
+            }
+          } else if (type == "plan_response") {
+            handle_plan_response(d);
+          } else if (type == "task_metric_received") {
+            task_metrics.update_received(
+                static_cast<uint64_t>(d["task_id"].as_int()),
+                d["timestamp_ms"].as_int());
+          } else if (type == "task_metric_started") {
+            task_metrics.update_started(
+                static_cast<uint64_t>(d["task_id"].as_int()),
+                d["timestamp_ms"].as_int());
+          } else if (type == "task_metric_completed") {
+            task_metrics.update_completed(
+                static_cast<uint64_t>(d["task_id"].as_int()),
+                d["timestamp_ms"].as_int());
+          } else if (d["status"].as_str() == "done") {
+            const std::string& peer = m.from;
+            auto it = agents.find(peer);
+            if (it != agents.end()) {
+              it->second.task.reset();
+              it->second.phase = Phase::None;
+              it->second.goal = it->second.pos;
+            }
+            printf("🎉 %s finished task %lld\n", peer.c_str(),
+                   static_cast<long long>(d["task_id"].as_int()));
+            // auto-reassign: fresh task on completion (ref :908-950)
+            if (it != agents.end()) assign_task(peer, make_task());
+            try_assign_pending();
+          }
+          fflush(stdout);
+        },
+        [&](const Json& ev) {
+          if (ev["op"].as_str() == "peer_left") {
+            const std::string& peer = ev["peer_id"].as_str();
+            known_left.insert(peer);
+            agents.erase(peer);
+          }
+        });
+    if (!alive) break;
+
+    int64_t now = mono_ms();
+    if (now - last_plan >= kPlanningMs) {  // planning tick (ref :675-724)
+      last_plan = now;
+      pickup_transitions();
+      if (!agents.empty()) {
+        if (solver == "tpu")
+          plan_request_tpu();
+        else
+          plan_native();
+      }
+    }
+    if (now - last_cleanup > kCleanupMs) {
+      last_cleanup = now;
+      for (auto it = agents.begin(); it != agents.end();)
+        it = (now - it->second.last_seen_ms > 60000) ? agents.erase(it)
+                                                     : std::next(it);
+      while (agents.size() > kMaxAgents) agents.erase(agents.begin());
+      while (known_left.size() > kMaxPeers)
+        known_left.erase(known_left.begin());
+      dc.trim(512);
+      printf("🧹 [CLEANUP] agents=%zu pending=%zu\n", agents.size(),
+             pending_tasks.size());
+      fflush(stdout);
+    }
+  }
+
+  if (const char* p = getenv("TASK_CSV_PATH"))
+    save_csv(p, task_metrics.to_csv_string());
+  if (const char* p = getenv("PATH_CSV_PATH"))
+    save_csv(p, path_metrics.to_csv_string());
+  printf("%s\n", task_metrics.statistics().to_string().c_str());
+  printf("manager: bye\n");
+  bus.close();
+  return 0;
+}

@@ -1,0 +1,331 @@
+// mapd_manager_decentralized — task dispatcher + metrics sink (SURVEY C8).
+//
+// Native rebuild of src/bin/decentralized/manager.rs: no pathfinding — it
+// round-robins generated tasks over non-busy subscribed peers, answers
+// occupied_request with all known peer positions, ingests position updates,
+// task metrics and path metrics, auto-refills a fresh task when a peer
+// reports done, runs the operator CLI on stdin (task | tasks N | metrics |
+// save F | save path F | reset | quit; anything else is broadcast raw), does
+// periodic bounded-cache cleanup, and auto-saves CSVs on exit when
+// TASK_CSV_PATH / PATH_CSV_PATH are set.
+//
+// Usage: mapd_manager_decentralized [--port P] [--map FILE] [--seed S]
+//                                   [--clean]
+
+#include <poll.h>
+#include <signal.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <optional>
+#include <random>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../common/bus.hpp"
+#include "../common/grid.hpp"
+#include "../common/json.hpp"
+
+using namespace mapd;
+
+namespace {
+
+constexpr int64_t kCleanupMs = 30000;  // ref :158-194
+constexpr size_t kMaxPeers = 200;      // ref :173
+constexpr size_t kMaxPositions = 60;
+
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  uint16_t port = 7400;
+  std::string map_file;
+  uint64_t seed = std::random_device{}();
+  bool clean = false;
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--port") && i + 1 < argc)
+      port = static_cast<uint16_t>(atoi(argv[++i]));
+    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
+      map_file = argv[++i];
+    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
+      seed = strtoull(argv[++i], nullptr, 10);
+    else if (!strcmp(argv[i], "--clean"))
+      clean = true;  // ignore re-discovered peers (ref --clean)
+  }
+  signal(SIGINT, handle_stop);
+  signal(SIGTERM, handle_stop);
+  signal(SIGPIPE, SIG_IGN);
+
+  Grid grid = Grid::default_grid();
+  if (!map_file.empty()) {
+    auto g = Grid::from_file(map_file);
+    if (!g) {
+      fprintf(stderr, "cannot load map %s\n", map_file.c_str());
+      return 1;
+    }
+    grid = *g;
+  }
+  std::mt19937_64 rng(seed);
+
+  BusClient bus;
+  std::string my_id = random_peer_id();
+  if (!bus.connect("127.0.0.1", port, my_id)) {
+    fprintf(stderr, "cannot connect to bus on port %u\n", port);
+    return 1;
+  }
+  bus.subscribe("mapd");
+  printf("🧠 decentralized manager %s up (grid %dx%d)\n", my_id.c_str(),
+         grid.width, grid.height);
+  printf("Commands: task | tasks N | metrics | save <file> | "
+         "save path <file> | reset | quit\n");
+  fflush(stdout);
+
+  std::set<std::string> subscribed_peers;
+  std::set<std::string> known_left;  // --clean: never re-add these
+  std::map<std::string, Cell> peer_positions;
+  std::map<std::string, uint64_t> peer_busy;  // peer -> active task id
+  TaskMetricsCollector task_metrics;
+  PathComputationMetrics path_metrics;
+  uint64_t next_task_id = 1;
+
+  auto free_cells = grid.free_cells();
+  auto gen_point = [&]() { return free_cells[rng() % free_cells.size()]; };
+
+  auto send_task_to = [&](const std::string& peer) {
+    Cell pickup = gen_point(), delivery = gen_point();
+    while (delivery == pickup) delivery = gen_point();
+    uint64_t id = next_task_id++;
+    Json t;  // bare Task JSON, the one shared serde struct (ref C10)
+    Json pk, dl;
+    pk.push_back(Json(grid.x_of(pickup)));
+    pk.push_back(Json(grid.y_of(pickup)));
+    dl.push_back(Json(grid.x_of(delivery)));
+    dl.push_back(Json(grid.y_of(delivery)));
+    t.set("pickup", pk).set("delivery", dl).set("peer_id", peer)
+        .set("task_id", id);
+    TaskMetric m;
+    m.task_id = id;
+    m.peer_id = peer;
+    m.sent_time = unix_ms();
+    task_metrics.add_metric(m);
+    peer_busy[peer] = id;
+    bus.publish("mapd", t);
+    printf("📤 Task %llu -> %s  pickup(%d,%d) delivery(%d,%d)\n",
+           static_cast<unsigned long long>(id), peer.c_str(),
+           grid.x_of(pickup), grid.y_of(pickup), grid.x_of(delivery),
+           grid.y_of(delivery));
+  };
+
+  auto assign_round_robin = [&](size_t count) {
+    // ref :256-329: rounds over non-busy subscribed peers until count sent
+    if (subscribed_peers.empty()) {
+      printf("⚠️  no subscribed peers\n");
+      return;
+    }
+    size_t sent = 0;
+    while (sent < count) {
+      size_t sent_this_round = 0;
+      for (const auto& peer : subscribed_peers) {
+        if (sent >= count) break;
+        if (peer_busy.count(peer)) continue;
+        send_task_to(peer);
+        ++sent;
+        ++sent_this_round;
+      }
+      if (sent_this_round == 0) break;  // everyone busy
+    }
+    printf("📦 dispatched %zu/%zu tasks\n", sent, count);
+  };
+
+  auto save_csv = [&](const std::string& path, const std::string& content) {
+    std::ofstream out(path);
+    if (!out) {
+      printf("⚠️  cannot write %s\n", path.c_str());
+      return;
+    }
+    out << content;
+    printf("💾 saved %s\n", path.c_str());
+  };
+
+  auto handle_command = [&](const std::string& line) -> bool {
+    std::istringstream in(line);
+    std::string cmd;
+    in >> cmd;
+    if (cmd == "quit" || cmd == "exit") return false;
+    if (cmd == "task") {
+      for (const auto& peer : subscribed_peers)
+        if (!peer_busy.count(peer)) {
+          send_task_to(peer);
+          return true;
+        }
+      printf("⚠️  all peers busy\n");
+    } else if (cmd == "tasks") {
+      size_t n = 0;
+      in >> n;
+      assign_round_robin(n ? n : subscribed_peers.size());
+    } else if (cmd == "metrics") {
+      printf("%s\n", task_metrics.statistics().to_string().c_str());
+      if (auto ps = path_metrics.statistics())
+        printf("%s\n", ps->to_string().c_str());
+      printf("%s\n", bus.net_metrics().to_string().c_str());
+    } else if (cmd == "save") {
+      std::string a, b;
+      in >> a >> b;
+      if (a == "path")
+        save_csv(b.empty() ? "path_metrics.csv" : b,
+                 path_metrics.to_csv_string());
+      else
+        save_csv(a.empty() ? "task_metrics.csv" : a,
+                 task_metrics.to_csv_string());
+    } else if (cmd == "reset") {
+      task_metrics.clear();
+      path_metrics.clear();
+      peer_busy.clear();
+      printf("🔄 state reset\n");
+    } else if (!cmd.empty()) {
+      Json raw;  // unknown lines broadcast raw (ref :389-395)
+      raw.set("raw", line);
+      bus.publish("mapd", raw);
+    }
+    fflush(stdout);
+    return true;
+  };
+
+  bus.query_peers("mapd");
+  int64_t last_cleanup = mono_ms();
+  std::string stdin_buf;
+  bool running = true;
+
+  while (running && !g_stop && bus.connected()) {
+    pollfd pfds[2] = {
+        {bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0},
+        {STDIN_FILENO, POLLIN, 0}};
+    poll(pfds, 2, 200);
+
+    if (pfds[1].revents & POLLIN) {
+      char buf[4096];
+      ssize_t n = read(STDIN_FILENO, buf, sizeof(buf));
+      if (n > 0) {
+        stdin_buf.append(buf, static_cast<size_t>(n));
+        size_t nl;
+        while ((nl = stdin_buf.find('\n')) != std::string::npos) {
+          std::string line = stdin_buf.substr(0, nl);
+          stdin_buf.erase(0, nl + 1);
+          if (!handle_command(line)) {
+            running = false;
+            break;
+          }
+        }
+      } else if (n == 0) {
+        running = false;  // stdin closed: graceful exit like `quit`
+      }
+    }
+
+    bool alive = bus.pump(
+        [&](const BusClient::Msg& m) {
+          const Json& d = m.data;
+          const std::string& type = d["type"].as_str();
+          if (type == "position_update") {
+            const auto& p = d["position"].as_array();
+            if (p.size() == 2) {
+              int x = static_cast<int>(p[0].as_int());
+              int y = static_cast<int>(p[1].as_int());
+              if (grid.in_bounds(x, y))
+                peer_positions[d["peer_id"].as_str()] = grid.cell(x, y);
+            }
+            subscribed_peers.insert(d["peer_id"].as_str());
+          } else if (type == "occupied_request") {
+            // manager answers with ALL known positions (ref :441-468)
+            Json occ;
+            for (const auto& [peer, c] : peer_positions) {
+              Json p;
+              p.push_back(Json(grid.x_of(c)));
+              p.push_back(Json(grid.y_of(c)));
+              occ.push_back(p);
+            }
+            if (occ.is_null()) occ = Json(JsonArray{});
+            Json resp;
+            resp.set("type", "occupied_response")
+                .set("occupied", occ)
+                .set("timestamp", unix_ms())
+                .set("from_peer", my_id);
+            bus.publish("mapd", resp);
+          } else if (type == "task_metric_received") {
+            task_metrics.update_received(
+                static_cast<uint64_t>(d["task_id"].as_int()),
+                d["timestamp_ms"].as_int());
+          } else if (type == "task_metric_started") {
+            task_metrics.update_started(
+                static_cast<uint64_t>(d["task_id"].as_int()),
+                d["timestamp_ms"].as_int());
+          } else if (type == "task_metric_completed") {
+            task_metrics.update_completed(
+                static_cast<uint64_t>(d["task_id"].as_int()),
+                d["timestamp_ms"].as_int());
+          } else if (type == "path_metric") {
+            path_metrics.record_micros(d["duration_micros"].as_int(),
+                                       d["timestamp_ms"].as_int());
+          } else if (d["status"].as_str() == "done") {
+            // closed loop: fresh task for that peer immediately (ref :527-560)
+            const std::string& peer = m.from;
+            peer_busy.erase(peer);
+            printf("🎉 %s finished task %lld\n", peer.c_str(),
+                   static_cast<long long>(d["task_id"].as_int()));
+            if (subscribed_peers.count(peer)) send_task_to(peer);
+          }
+        },
+        [&](const Json& ev) {
+          const std::string& op = ev["op"].as_str();
+          if (op == "peer_joined") {
+            const std::string& peer = ev["peer_id"].as_str();
+            if (clean && known_left.count(peer)) return;
+            subscribed_peers.insert(peer);
+            printf("🔍 peer joined: %s (%zu peers)\n", peer.c_str(),
+                   subscribed_peers.size());
+          } else if (op == "peer_left") {
+            const std::string& peer = ev["peer_id"].as_str();
+            known_left.insert(peer);
+            subscribed_peers.erase(peer);
+            peer_positions.erase(peer);
+            peer_busy.erase(peer);  // note: its task is lost, like the ref
+            printf("👋 peer left: %s\n", peer.c_str());
+          } else if (op == "peers") {
+            for (const auto& p : ev["peers"].as_array())
+              subscribed_peers.insert(p.as_str());
+          }
+          fflush(stdout);
+        });
+    if (!alive) break;
+
+    int64_t now = mono_ms();
+    if (now - last_cleanup > kCleanupMs) {
+      last_cleanup = now;
+      while (subscribed_peers.size() > kMaxPeers)
+        subscribed_peers.erase(subscribed_peers.begin());
+      while (peer_positions.size() > kMaxPositions)
+        peer_positions.erase(peer_positions.begin());
+      printf("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu\n",
+             subscribed_peers.size(), peer_positions.size(),
+             peer_busy.size());
+      fflush(stdout);
+    }
+  }
+
+  // graceful exit: env-var CSV auto-save (ref :48-50, :570-584)
+  if (const char* p = getenv("TASK_CSV_PATH"))
+    save_csv(p, task_metrics.to_csv_string());
+  if (const char* p = getenv("PATH_CSV_PATH"))
+    save_csv(p, path_metrics.to_csv_string());
+  printf("%s\n", task_metrics.statistics().to_string().c_str());
+  printf("manager: bye\n");
+  bus.close();
+  return 0;
+}

@@ -26,8 +26,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-INF = jnp.int32(1 << 30)
+# numpy scalar, NOT a jnp array: a module-level device constant would
+# initialize the accelerator backend at import time, breaking CPU fallback
+# in processes where the TPU plugin fails to register.
+INF = np.int32(1 << 30)
 # (dx, dy) in the reference's neighbor order; index = direction code.
 DIR_DXDY = ((0, 1), (1, 0), (0, -1), (-1, 0))
 DIR_STAY = 4

@@ -1,0 +1,81 @@
+"""Python client for the host-runtime message bus (cpp/busd).
+
+Speaks the same line-framed JSON protocol as the C++ BusClient
+(cpp/common/bus.hpp); used by the solver daemon, the process-spawn test
+runner, and integration tests.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Callable, Iterator, Optional
+
+from p2p_distributed_tswap_tpu.metrics.task_metrics import NetworkMetrics
+
+
+class BusClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 7400,
+                 peer_id: Optional[str] = None, timeout: float = 5.0):
+        self.peer_id = peer_id or f"py-{int(time.time() * 1000) % 10 ** 10}"
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(timeout)
+        self._buf = b""
+        self.net = NetworkMetrics()
+        self._send({"op": "hello", "peer_id": self.peer_id})
+
+    def _send(self, obj: dict) -> None:
+        self.sock.sendall((json.dumps(obj) + "\n").encode())
+
+    def subscribe(self, topic: str) -> None:
+        self._send({"op": "sub", "topic": topic})
+
+    def publish(self, topic: str, data: dict) -> None:
+        frame = {"op": "pub", "topic": topic, "data": data}
+        line = json.dumps(frame)
+        self.net.record_sent(len(line))
+        self.sock.sendall((line + "\n").encode())
+
+    def query_peers(self, topic: str) -> None:
+        self._send({"op": "peers", "topic": topic})
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Next frame (any op) or None on timeout."""
+        self.sock.settimeout(timeout)
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl >= 0:
+                line = self._buf[:nl]
+                self._buf = self._buf[nl + 1:]
+                try:
+                    frame = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if frame.get("op") == "msg":
+                    self.net.record_received(len(line))
+                return frame
+            try:
+                chunk = self.sock.recv(65536)
+            except socket.timeout:
+                return None
+            if not chunk:
+                raise ConnectionError("bus closed")
+            self._buf += chunk
+
+    def messages(self, duration: float) -> Iterator[dict]:
+        """Application messages received within ``duration`` seconds."""
+        deadline = time.monotonic() + duration
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            frame = self.recv(timeout=remaining)
+            if frame and frame.get("op") == "msg":
+                yield frame
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

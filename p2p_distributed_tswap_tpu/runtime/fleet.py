@@ -1,0 +1,160 @@
+"""Process-spawn fleet runner.
+
+The working equivalent of the reference's bit-rotted ``task_test`` harness
+(src/test/run/task.rs:32-284, which spawns binary names that no longer exist
+— SURVEY C12): launches bus + manager + N agents as OS processes, forwards
+operator commands to the manager's stdin, and kills the whole fleet on exit.
+
+Library use (integration tests) and CLI:
+    python -m p2p_distributed_tswap_tpu.runtime.fleet \
+        --mode decentralized --agents 3 --duration 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BUILD_DIR = REPO_ROOT / "cpp" / "build"
+
+
+def ensure_built() -> Path:
+    """Build the C++ runtime if needed; returns the build dir."""
+    if not (BUILD_DIR / "mapd_bus").exists():
+        subprocess.run(["cmake", "-S", str(REPO_ROOT / "cpp"), "-B",
+                        str(BUILD_DIR), "-G", "Ninja"], check=True,
+                       capture_output=True)
+        subprocess.run(["ninja", "-C", str(BUILD_DIR)], check=True,
+                       capture_output=True)
+    return BUILD_DIR
+
+
+class Fleet:
+    """A managed fleet of runtime processes (killed on close/GC)."""
+
+    def __init__(self, mode: str = "decentralized", num_agents: int = 3,
+                 port: int = 7450, map_file: Optional[str] = None,
+                 solver: str = "cpu", log_dir: Optional[str] = None,
+                 env: Optional[dict] = None):
+        assert mode in ("centralized", "decentralized")
+        build = ensure_built()
+        self.procs: List[subprocess.Popen] = []
+        self.log_dir = Path(log_dir) if log_dir else None
+        if self.log_dir:
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+        penv = dict(os.environ)
+        if env:
+            penv.update(env)
+        self._logs: List = []
+
+        def spawn(name, cmd, stdin=None):
+            if self.log_dir:
+                out = open(self.log_dir / f"{name}.log", "w")
+                self._logs.append(out)
+            else:
+                out = subprocess.DEVNULL
+            p = subprocess.Popen(cmd, stdin=stdin, stdout=out,
+                                 stderr=subprocess.STDOUT, env=penv)
+            self.procs.append(p)
+            return p
+
+        map_args = ["--map", map_file] if map_file else []
+        spawn("bus", [str(build / "mapd_bus"), str(port)])
+        time.sleep(0.3)
+        if mode == "centralized" and solver == "tpu":
+            # --solver=tpu planning happens in the JAX solver daemon
+            spawn("solverd",
+                  [sys.executable, "-m",
+                   "p2p_distributed_tswap_tpu.runtime.solverd",
+                   "--port", str(port), *map_args])
+            time.sleep(8)  # accelerator init headroom
+        mgr_cmd = [str(build / f"mapd_manager_{mode}"), "--port", str(port),
+                   *map_args]
+        if mode == "centralized":
+            mgr_cmd += ["--solver", solver]
+        self.manager = spawn("manager", mgr_cmd, stdin=subprocess.PIPE)
+        time.sleep(0.3)
+        for i in range(1, num_agents + 1):
+            spawn(f"agent_{i}",
+                  [str(build / f"mapd_agent_{mode}"), "--port", str(port),
+                   "--seed", str(i), *map_args])
+            time.sleep(0.1)
+        self.port = port
+
+    def command(self, line: str) -> None:
+        """Send an operator CLI line to the manager (task | tasks N | ...)."""
+        assert self.manager.stdin is not None
+        self.manager.stdin.write((line + "\n").encode())
+        self.manager.stdin.flush()
+
+    def quit(self, timeout: float = 10.0) -> None:
+        try:
+            self.command("quit")
+            self.manager.wait(timeout=timeout)
+        except Exception:
+            pass
+        self.close()
+
+    def close(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs.clear()
+        for f in self._logs:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._logs.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="decentralized",
+                    choices=["centralized", "decentralized"])
+    ap.add_argument("--agents", type=int, default=3)
+    ap.add_argument("--duration", type=int, default=30)
+    ap.add_argument("--port", type=int, default=7450)
+    ap.add_argument("--map", default=None)
+    ap.add_argument("--solver", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--tasks-every", type=float, default=3.0)
+    ap.add_argument("--log-dir", default="results/fleet")
+    args = ap.parse_args(argv)
+
+    with Fleet(args.mode, args.agents, args.port, args.map, args.solver,
+               args.log_dir) as fleet:
+        print(f"fleet up: {args.mode}, {args.agents} agents, "
+              f"bus port {args.port}; logs in {args.log_dir}")
+        time.sleep(3 + args.agents * 0.2)
+        end = time.monotonic() + args.duration
+        while time.monotonic() < end:
+            fleet.command(f"tasks {args.agents}")
+            time.sleep(args.tasks_every)
+        fleet.command("metrics")
+        time.sleep(1)
+        fleet.quit()
+    print("fleet shut down")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""solverd — the TPU solver daemon behind the centralized manager's
+``--solver=tpu`` mode (the BASELINE.json north-star deployment shape).
+
+The C++ centralized manager ships global agent state over bus topic "solver"
+as a plan_request each planning tick; this daemon runs ONE batched TSWAP step
+on the accelerator and replies with per-agent next positions (and possibly
+swapped goals).  The manager stays the system of record — it converts moves
+to move_instruction messages exactly as with its native solver.
+
+Device-side design: fixed-capacity lanes (next power of two over the fleet
+size) with the step kernel's ``active`` mask, so fleet growth causes at most
+O(log N) recompiles; direction-field rows are cached per goal and recomputed
+only for goals not seen before (LRU eviction), since TSWAP goal exchange
+permutes goals far more often than the task lifecycle creates new ones.
+
+Wire: plan_request  {type, seq, agents:[{peer_id, pos:[x,y], goal:[x,y]}]}
+      plan_response {type, seq, duration_micros,
+                     moves:[{peer_id, next_pos:[x,y], goal:[x,y]}]}
+
+Usage: python -m p2p_distributed_tswap_tpu.runtime.solverd
+           [--port 7400] [--map FILE] [--capacity-min 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+from collections import OrderedDict
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.ops.distance import DIR_STAY, direction_fields
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+from p2p_distributed_tswap_tpu.solver.step import step_parallel
+
+
+class PlanService:
+    """Batched one-step planner with goal-field caching."""
+
+    def __init__(self, grid: Grid, capacity_min: int = 16,
+                 field_cache: int = 4096):
+        self.grid = grid
+        self.free = jnp.asarray(grid.free)
+        self.capacity_min = capacity_min
+        self.max_fields = field_cache
+        # goal cell -> row index into the dirs buffer
+        self.goal_rows: "OrderedDict[int, int]" = OrderedDict()
+        self.dirs: jnp.ndarray | None = None  # (rows, HW) uint8
+        self._step = functools.partial(jax.jit, static_argnums=0)(step_parallel)
+
+    def _capacity(self, n: int) -> int:
+        c = self.capacity_min
+        while c < n:
+            c *= 2
+        return c
+
+    def _ensure_fields(self, goals: List[int]) -> None:
+        missing = [g for g in dict.fromkeys(goals) if g not in self.goal_rows]
+        if self.dirs is None:
+            rows = max(self._capacity(len(missing)), self.capacity_min)
+            self.dirs = jnp.full((rows, self.grid.num_cells), DIR_STAY,
+                                 jnp.uint8)
+        needed = len(self.goal_rows) + len(missing)
+        if needed > self.dirs.shape[0]:
+            grow = self.dirs.shape[0]
+            while grow < needed:
+                grow *= 2
+            self.dirs = jnp.concatenate(
+                [self.dirs,
+                 jnp.full((grow - self.dirs.shape[0], self.grid.num_cells),
+                          DIR_STAY, jnp.uint8)])
+        if not missing:
+            return
+        # evict LRU rows when over budget — never a goal of the current
+        # request (they sit at the LRU tail because plan() touches them
+        # before calling us, and the budget is clamped to the request size)
+        budget = max(self.max_fields, len(goals))
+        while len(self.goal_rows) + len(missing) > budget:
+            self.goal_rows.popitem(last=False)
+        used = set(self.goal_rows.values())
+        free_rows = [r for r in range(self.dirs.shape[0]) if r not in used]
+        fields = direction_fields(self.free,
+                                  jnp.asarray(missing, jnp.int32))
+        fields = fields.reshape(len(missing), -1)
+        rows = free_rows[:len(missing)]
+        self.dirs = self.dirs.at[jnp.asarray(rows)].set(fields)
+        for g, r in zip(missing, rows):
+            self.goal_rows[g] = r
+
+    def plan(self, agents: List[Tuple[str, int, int]]
+             ) -> List[Tuple[str, int, int]]:
+        """agents: [(peer_id, pos_cell, goal_cell)] ->
+        [(peer_id, next_cell, goal_cell)] after one TSWAP step."""
+        n = len(agents)
+        goals = [g for _, _, g in agents]
+        # LRU-touch cached request goals FIRST so eviction inside
+        # _ensure_fields can only hit goals absent from this request
+        for g in goals:
+            if g in self.goal_rows:
+                self.goal_rows.move_to_end(g)
+        self._ensure_fields(goals)
+        cap = self._capacity(n)
+        cfg = SolverConfig(height=self.grid.height, width=self.grid.width,
+                           num_agents=cap)
+        pos = np.zeros(cap, np.int32)
+        goal = np.zeros(cap, np.int32)
+        slot = np.zeros(cap, np.int32)
+        active = np.zeros(cap, bool)
+        # agents map onto cached field rows via the slot indirection; padded
+        # lanes reuse row 0 but are masked inactive
+        for k, (_, p, g) in enumerate(agents):
+            pos[k], goal[k], slot[k] = p, g, self.goal_rows[g]
+            active[k] = True
+        new_pos, new_goal, _ = self._step(
+            cfg, jnp.asarray(pos), jnp.asarray(goal), jnp.asarray(slot),
+            self.dirs[:, :], jnp.asarray(active))
+        new_pos = np.asarray(new_pos)
+        new_goal = np.asarray(new_goal)
+        return [(agents[k][0], int(new_pos[k]), int(new_goal[k]))
+                for k in range(n)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=7400)
+    ap.add_argument("--map", default=None)
+    ap.add_argument("--capacity-min", type=int, default=16)
+    args = ap.parse_args(argv)
+
+    if args.map:
+        with open(args.map) as f:
+            text = f.read()
+        grid = (Grid.from_mapf_file(args.map) if text.startswith("type")
+                else Grid.from_ascii(text))
+    else:
+        grid = Grid.default()
+
+    try:
+        jax.devices()
+    except RuntimeError as e:  # accelerator plugin failed: fall back to CPU
+        print(f"⚠️ accelerator backend unavailable ({e}); falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+
+    service = PlanService(grid, capacity_min=args.capacity_min)
+    bus = BusClient(port=args.port, peer_id="solverd")
+    bus.subscribe("solver")
+    print(f"🧮 solverd up on port {args.port} "
+          f"(grid {grid.height}x{grid.width}, devices={jax.devices()})")
+    sys.stdout.flush()
+
+    while True:
+        frame = bus.recv(timeout=1.0)
+        if frame is None or frame.get("op") != "msg":
+            continue
+        data = frame.get("data") or {}
+        if data.get("type") != "plan_request":
+            continue
+        t0 = time.perf_counter()
+        agents = []
+        w = grid.width
+        for e in data.get("agents", []):
+            px, py = e["pos"]
+            gx, gy = e["goal"]
+            agents.append((e["peer_id"], py * w + px, gy * w + gx))
+        if not agents:
+            continue
+        moves = service.plan(agents)
+        us = int((time.perf_counter() - t0) * 1e6)
+        bus.publish("solver", {
+            "type": "plan_response",
+            "seq": data.get("seq"),
+            "duration_micros": us,
+            "moves": [{"peer_id": pid,
+                       "next_pos": [c % w, c // w],
+                       "goal": [g % w, g // w]}
+                      for pid, c, g in moves],
+        })
+
+
+if __name__ == "__main__":
+    sys.exit(main())

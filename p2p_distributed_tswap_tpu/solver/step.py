@@ -54,10 +54,13 @@ def next_hops(cfg: SolverConfig, dirs: jnp.ndarray, slot: jnp.ndarray,
     return apply_direction(pos, code, cfg.width)
 
 
-def _occupancy(cfg: SolverConfig, pos: jnp.ndarray) -> jnp.ndarray:
-    """(HW,) int32: agent id at each cell, -1 if empty."""
+def _occupancy(cfg: SolverConfig, pos: jnp.ndarray,
+               active: jnp.ndarray) -> jnp.ndarray:
+    """(HW+1,) int32: agent id at each cell, -1 if empty.  Inactive agents
+    scatter to the padded scratch cell and never occupy the grid."""
     n = cfg.num_agents
-    return jnp.full(cfg.num_cells, -1, jnp.int32).at[pos].set(
+    return jnp.full(cfg.num_cells + 1, -1, jnp.int32).at[
+        jnp.where(active, pos, cfg.num_cells)].set(
         jnp.arange(n, dtype=jnp.int32))
 
 
@@ -83,13 +86,13 @@ def _apply_pair_swaps(goal, slot, sel, partner, n):
     return goal[p], slot[p]
 
 
-def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
+def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ, active):
     n = cfg.num_agents
     idx = jnp.arange(n, dtype=jnp.int32)
 
     # ---- Rule 3: swap goals with a blocker parked on its own goal ----
     at_goal = pos == goal
-    u = nh_fn(slot, pos)
+    u = jnp.where(active, nh_fn(slot, pos), pos)
     b, has_move = _blockers(occ, pos, u)
     bc = jnp.clip(b, 0, n - 1)
     cand = has_move & (b >= 0) & at_goal[bc]
@@ -100,7 +103,7 @@ def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
 
     # ---- Rule 4: rotate goals around blocking cycles ----
     at_goal = pos == goal
-    u = nh_fn(slot, pos)
+    u = jnp.where(active, nh_fn(slot, pos), pos)
     b, has_move = _blockers(occ, pos, u)
     # blocking-graph successor; n = absorbing sentinel (chain breaks at
     # at-goal agents automatically: they have no move, f = n)
@@ -121,10 +124,10 @@ def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
     return goal, slot
 
 
-def _movement_phase(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
+def _movement_phase(cfg: SolverConfig, pos, goal, slot, nh_fn, occ, active):
     n = cfg.num_agents
     idx = jnp.arange(n, dtype=jnp.int32)
-    u = nh_fn(slot, pos)
+    u = jnp.where(active, nh_fn(slot, pos), pos)
     b, has_move = _blockers(occ, pos, u)
     bc = jnp.clip(b, 0, n - 1)
 
@@ -142,7 +145,7 @@ def _movement_phase(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
         # final occupancy of decided agents only (padded scratch cell at
         # index num_cells instead of mode="drop"; see _apply_pair_swaps)
         occf = jnp.full(cfg.num_cells + 1, -1, jnp.int32).at[
-            jnp.where(decided, newpos, cfg.num_cells)].set(idx)
+            jnp.where(decided & active, newpos, cfg.num_cells)].set(idx)
         # target available: nobody finalized there, and its original occupant
         # (if any) has finalized a move away
         orig = b  # original occupant of u (from occ at step start)
@@ -161,7 +164,8 @@ def _movement_phase(cfg: SolverConfig, pos, goal, slot, nh_fn, occ):
 
 
 def step_parallel(cfg: SolverConfig, pos: jnp.ndarray, goal: jnp.ndarray,
-                  slot: jnp.ndarray, dirs: jnp.ndarray
+                  slot: jnp.ndarray, dirs: jnp.ndarray,
+                  active: jnp.ndarray | None = None
                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One TSWAP timestep for all agents.
 
@@ -178,19 +182,30 @@ def step_parallel(cfg: SolverConfig, pos: jnp.ndarray, goal: jnp.ndarray,
       exchange = slot permutation).
     """
     return step_with_next_hops(
-        cfg, pos, goal, slot, lambda sl, po: next_hops(cfg, dirs, sl, po))
+        cfg, pos, goal, slot, lambda sl, po: next_hops(cfg, dirs, sl, po),
+        active)
 
 
-def step_with_next_hops(cfg: SolverConfig, pos, goal, slot, nh_fn):
+def step_with_next_hops(cfg: SolverConfig, pos, goal, slot, nh_fn,
+                        active=None):
     """Step core parameterized by the next-hop lookup, so the sharded solver
     (parallel/sharded.py) can swap in a distributed field gather while rule
-    semantics stay in exactly one place."""
-    occ = _occupancy(cfg, pos)
+    semantics stay in exactly one place.
+
+    ``active`` masks out padded/parked agent lanes entirely: inactive agents
+    never occupy grid cells, never move, and never participate in swaps —
+    the device-side mechanism behind fixed-capacity elastic populations
+    (SURVEY §7 hard part 4: join/leave is host bookkeeping over a padded
+    agent axis).
+    """
+    if active is None:
+        active = jnp.ones(cfg.num_agents, bool)
+    occ = _occupancy(cfg, pos, active)
 
     def round_body(_, gs):
         goal, slot = gs
-        return _swap_phase_round(cfg, pos, goal, slot, nh_fn, occ)
+        return _swap_phase_round(cfg, pos, goal, slot, nh_fn, occ, active)
 
     goal, slot = jax.lax.fori_loop(0, cfg.swap_rounds, round_body, (goal, slot))
-    pos = _movement_phase(cfg, pos, goal, slot, nh_fn, occ)
+    pos = _movement_phase(cfg, pos, goal, slot, nh_fn, occ, active)
     return pos, goal, slot

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Launch N agents against an already-running bus + manager.  (The reference's
+# README references a start_agents.sh that does not exist in its snapshot —
+# SURVEY C15; this provides the documented capability.)
+#
+# Usage: ./start_agents.sh [N] [centralized|decentralized]
+set -u
+
+N=${1:-3}
+MODE=${2:-decentralized}
+PORT=${MAPD_BUS_PORT:-7400}
+ROOT="$(cd "$(dirname "$0")" && pwd)"
+BUILD="$ROOT/cpp/build"
+
+ninja -C "$BUILD" >/dev/null 2>&1 || {
+  cmake -S "$ROOT/cpp" -B "$BUILD" -G Ninja >/dev/null
+  ninja -C "$BUILD" >/dev/null || { echo "build failed"; exit 1; }
+}
+
+for i in $(seq 1 "$N"); do
+  "$BUILD/mapd_agent_$MODE" --port "$PORT" --seed "$i" &
+  sleep 0.15
+done
+echo "🤖 started $N $MODE agents on bus port $PORT (PIDs: $(jobs -p | tr '\n' ' '))"
+wait

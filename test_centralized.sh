@@ -1,0 +1,90 @@
+#!/usr/bin/env bash
+# E2E test: centralized fleet (capability of the reference's
+# test_centralized.sh — build, FIFO-driven manager, N dumb agents, warmup,
+# task dispatch, CSV + summary harvest including avg task latency).
+#
+# Usage: ./test_centralized.sh [NUM_AGENTS] [DURATION_SECS]
+# Env:   MAPD_SOLVER=cpu|tpu  (tpu additionally launches the JAX solverd)
+set -u
+
+NUM_AGENTS=${1:-3}
+DURATION=${2:-60}
+PORT=${MAPD_BUS_PORT:-7422}
+SOLVER=${MAPD_SOLVER:-cpu}
+ROOT="$(cd "$(dirname "$0")" && pwd)"
+BUILD="$ROOT/cpp/build"
+OUT="$ROOT/results/centralized_$(date +%Y%m%d_%H%M%S)"
+mkdir -p "$OUT"
+
+cmake -S "$ROOT/cpp" -B "$BUILD" -G Ninja >/dev/null
+ninja -C "$BUILD" >/dev/null || { echo "build failed"; exit 1; }
+
+PIDS=()
+cleanup() {
+  for p in "${PIDS[@]:-}"; do kill "$p" 2>/dev/null; done
+  wait 2>/dev/null
+}
+trap cleanup EXIT
+
+"$BUILD/mapd_bus" "$PORT" >"$OUT/bus.log" 2>&1 &
+PIDS+=($!)
+sleep 0.3
+
+if [ "$SOLVER" = "tpu" ]; then
+  echo "🧮 launching solverd (JAX)..."
+  PYTHONPATH="$ROOT" python -m p2p_distributed_tswap_tpu.runtime.solverd \
+    --port "$PORT" >"$OUT/solverd.log" 2>&1 &
+  PIDS+=($!)
+  sleep 10   # accelerator init + first-compile headroom
+fi
+
+FIFO="$OUT/mgr_in"
+mkfifo "$FIFO"
+TASK_CSV_PATH="$OUT/task_metrics.csv" PATH_CSV_PATH="$OUT/path_metrics.csv" \
+  "$BUILD/mapd_manager_centralized" --port "$PORT" --solver "$SOLVER" \
+  >"$OUT/manager.log" 2>&1 <"$FIFO" &
+MGR_PID=$!
+PIDS+=($MGR_PID)
+exec 3>"$FIFO"
+sleep 0.5
+
+for i in $(seq 1 "$NUM_AGENTS"); do
+  "$BUILD/mapd_agent_centralized" --port "$PORT" --seed "$i" \
+    >"$OUT/agent_$i.log" 2>&1 &
+  PIDS+=($!)
+  sleep 0.2
+done
+
+WARMUP=$((5 + NUM_AGENTS / 5))
+echo "⏳ warmup ${WARMUP}s..."
+sleep "$WARMUP"
+
+echo "🚀 dispatching tasks for ${DURATION}s..."
+echo "tasks $NUM_AGENTS" >&3
+END=$(($(date +%s) + DURATION))
+while [ "$(date +%s)" -lt "$END" ]; do
+  echo "task" >&3
+  sleep 2
+done
+
+echo "metrics" >&3
+sleep 1
+echo "quit" >&3
+exec 3>&-
+for _ in $(seq 1 10); do kill -0 $MGR_PID 2>/dev/null || break; sleep 1; done
+
+SUMMARY="$OUT/test_summary.txt"
+{
+  echo "test: centralized solver=$SOLVER agents=$NUM_AGENTS duration=${DURATION}s"
+  if [ -f "$OUT/task_metrics.csv" ]; then
+    COMPLETED=$(awk -F, 'NR>1 && $10=="completed"' "$OUT/task_metrics.csv" | wc -l)
+    TOTAL=$(awk 'NR>1' "$OUT/task_metrics.csv" | wc -l)
+    echo "tasks_completed: $COMPLETED / $TOTAL"
+    echo "throughput_tasks_per_sec: $(awk -v c="$COMPLETED" -v d="$DURATION" 'BEGIN{printf "%.3f", c/d}')"
+    awk -F, 'NR>1 && $7!="" {s+=$7; n++} END{if(n) printf "avg_task_latency_s: %.2f\n", s/n/1000}' "$OUT/task_metrics.csv"
+  fi
+  if [ -f "$OUT/path_metrics.csv" ]; then
+    awk -F, 'NR>1 {s+=$2; n++} END{if(n) printf "avg_plan_time_ms: %.3f (n=%d)\n", s/n/1000, n}' "$OUT/path_metrics.csv"
+  fi
+} | tee "$SUMMARY"
+echo "📁 results in $OUT"

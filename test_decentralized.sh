@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# E2E test: decentralized fleet (capability of the reference's
+# test_decentralized.sh — build, FIFO-driven manager, N agents with staggered
+# startup, warmup, continuous task injection, CSV + summary harvest).
+#
+# Usage: ./test_decentralized.sh [NUM_AGENTS] [DURATION_SECS]
+set -u
+
+NUM_AGENTS=${1:-3}
+DURATION=${2:-60}
+PORT=${MAPD_BUS_PORT:-7421}
+ROOT="$(cd "$(dirname "$0")" && pwd)"
+BUILD="$ROOT/cpp/build"
+OUT="$ROOT/results/decentralized_$(date +%Y%m%d_%H%M%S)"
+mkdir -p "$OUT"
+
+# -- build ---------------------------------------------------------------
+cmake -S "$ROOT/cpp" -B "$BUILD" -G Ninja >/dev/null
+ninja -C "$BUILD" >/dev/null || { echo "build failed"; exit 1; }
+
+PIDS=()
+cleanup() {
+  for p in "${PIDS[@]:-}"; do kill "$p" 2>/dev/null; done
+  wait 2>/dev/null
+}
+trap cleanup EXIT
+
+# -- launch bus + manager (stdin held open through a FIFO) ---------------
+"$BUILD/mapd_bus" "$PORT" >"$OUT/bus.log" 2>&1 &
+PIDS+=($!)
+sleep 0.3
+
+FIFO="$OUT/mgr_in"
+mkfifo "$FIFO"
+TASK_CSV_PATH="$OUT/task_metrics.csv" PATH_CSV_PATH="$OUT/path_metrics.csv" \
+  "$BUILD/mapd_manager_decentralized" --port "$PORT" \
+  >"$OUT/manager.log" 2>&1 <"$FIFO" &
+MGR_PID=$!
+PIDS+=($MGR_PID)
+exec 3>"$FIFO"   # hold the write end so manager stdin stays open
+sleep 0.5
+
+# -- launch agents with staggered spacing --------------------------------
+for i in $(seq 1 "$NUM_AGENTS"); do
+  "$BUILD/mapd_agent_decentralized" --port "$PORT" --seed "$i" \
+    >"$OUT/agent_$i.log" 2>&1 &
+  PIDS+=($!)
+  sleep 0.2
+done
+
+WARMUP=$((5 + NUM_AGENTS / 5))
+echo "⏳ warmup ${WARMUP}s (mesh formation + initial positions)..."
+sleep "$WARMUP"
+
+# -- continuous task injection every 3 s ---------------------------------
+echo "🚀 injecting tasks for ${DURATION}s..."
+END=$(($(date +%s) + DURATION))
+while [ "$(date +%s)" -lt "$END" ]; do
+  echo "tasks $NUM_AGENTS" >&3
+  sleep 3
+done
+
+echo "metrics" >&3
+sleep 1
+echo "quit" >&3
+exec 3>&-
+for _ in $(seq 1 10); do kill -0 $MGR_PID 2>/dev/null || break; sleep 1; done
+
+# -- summary -------------------------------------------------------------
+SUMMARY="$OUT/test_summary.txt"
+{
+  echo "test: decentralized  agents=$NUM_AGENTS duration=${DURATION}s"
+  if [ -f "$OUT/task_metrics.csv" ]; then
+    COMPLETED=$(awk -F, 'NR>1 && $10=="completed"' "$OUT/task_metrics.csv" | wc -l)
+    TOTAL=$(awk 'NR>1' "$OUT/task_metrics.csv" | wc -l)
+    echo "tasks_completed: $COMPLETED / $TOTAL"
+    echo "throughput_tasks_per_sec: $(awk -v c="$COMPLETED" -v d="$DURATION" 'BEGIN{printf "%.3f", c/d}')"
+    awk -F, 'NR>1 && $7!="" {s+=$7; n++} END{if(n) printf "avg_task_latency_s: %.2f\n", s/n/1000}' "$OUT/task_metrics.csv"
+  fi
+  if [ -f "$OUT/path_metrics.csv" ]; then
+    awk -F, 'NR>1 {s+=$2; n++} END{if(n) printf "avg_plan_time_ms: %.3f (n=%d)\n", s/n/1000, n}' "$OUT/path_metrics.csv"
+  fi
+} | tee "$SUMMARY"
+echo "📁 results in $OUT"
