@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Offline task-metrics analysis (capability of the reference's
+analyze_metrics.py: success rate, total/processing/startup-latency
+distributions, per-agent fairness table, latency histogram, percentiles, and
+an executive summary with coefficient-of-variation interpretation, with
+--save report export).
+
+Usage: python analysis/analyze_metrics.py task_metrics.csv [--all] [--save R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import pandas as pd
+
+
+def banner(title: str) -> str:
+    return f"\n{'=' * 64}\n{title}\n{'=' * 64}"
+
+
+def basic_stats(df: pd.DataFrame) -> str:
+    out = [banner("TASK COMPLETION")]
+    total = len(df)
+    completed = int((df["status"] == "completed").sum())
+    failed = int((df["status"] == "failed").sum())
+    rate = 100.0 * completed / total if total else 0.0
+    out.append(f"tasks: {total}  completed: {completed}  failed: {failed}")
+    out.append(f"success rate: {rate:.1f}%")
+    for col, label in [("total_time_ms", "total latency"),
+                       ("processing_time_ms", "processing time"),
+                       ("startup_latency_ms", "startup latency")]:
+        if col not in df.columns:
+            continue
+        v = df[df[col] > 0][col]
+        if v.empty:
+            continue
+        out.append(f"{label}: mean {v.mean():.1f} ms  median {v.median():.1f}"
+                   f" ms  std {v.std():.1f} ms  min {v.min():.0f}"
+                   f" ms  max {v.max():.0f} ms")
+    return "\n".join(out)
+
+
+def per_agent(df: pd.DataFrame) -> str:
+    if "peer_id" not in df.columns:
+        return ""
+    out = [banner("PER-AGENT BREAKDOWN")]
+    done = df[df["status"] == "completed"]
+    if done.empty:
+        return "\n".join(out + ["no completed tasks"])
+    g = done.groupby("peer_id")["total_time_ms"].agg(
+        ["count", "mean", "min", "max", "std"])
+    out.append(f"{'agent':<16}{'tasks':>6}{'avg':>10}{'min':>10}"
+               f"{'max':>10}{'std':>10}")
+    for peer, row in g.iterrows():
+        out.append(f"{str(peer)[:14]:<16}{int(row['count']):>6}"
+                   f"{row['mean']:>10.1f}{row['min']:>10.1f}"
+                   f"{row['max']:>10.1f}{row['std'] if row['std'] == row['std'] else 0:>10.1f}")
+    return "\n".join(out)
+
+
+def histogram(df: pd.DataFrame) -> str:
+    if "total_time_ms" not in df.columns:
+        return ""
+    v = df[df["total_time_ms"] > 0]["total_time_ms"] / 1000.0
+    if v.empty:
+        return ""
+    out = [banner("LATENCY HISTOGRAM (s)")]
+    bins = [0, 1, 5, 10, 30, 60, float("inf")]
+    labels = ["<1s", "1-5s", "5-10s", "10-30s", "30-60s", ">60s"]
+    counts = pd.cut(v, bins=bins, labels=labels, right=False).value_counts()
+    for label in labels:
+        c = int(counts.get(label, 0))
+        bar = "#" * int(40 * c / max(1, counts.max()))
+        out.append(f"{label:>7} | {c:>5} {bar}")
+    return "\n".join(out)
+
+
+def percentiles(df: pd.DataFrame) -> str:
+    if "total_time_ms" not in df.columns:
+        return ""
+    v = df[df["total_time_ms"] > 0]["total_time_ms"]
+    if v.empty:
+        return ""
+    out = [banner("PERCENTILES (total latency, ms)")]
+    for p in (10, 25, 50, 75, 90, 95, 99):
+        out.append(f"P{p:<3} {v.quantile(p / 100):>12.1f}")
+    return "\n".join(out)
+
+
+def executive_summary(df: pd.DataFrame) -> str:
+    out = [banner("EXECUTIVE SUMMARY")]
+    total = len(df)
+    completed = int((df["status"] == "completed").sum())
+    rate = 100.0 * completed / total if total else 0.0
+    verdict = ("healthy" if rate >= 90 else
+               "degraded" if rate >= 50 else "unhealthy")
+    out.append(f"system completed {completed}/{total} tasks "
+               f"({rate:.1f}%) -> {verdict}")
+    if "total_time_ms" in df.columns:
+        v = df[df["total_time_ms"] > 0]["total_time_ms"]
+        if not v.empty and v.mean() > 0:
+            cv = v.std() / v.mean()
+            interp = ("consistent" if cv < 0.5 else
+                      "moderately variable" if cv < 1.0 else "highly variable")
+            out.append(f"latency avg {v.mean() / 1000:.1f}s, "
+                       f"CV {cv:.2f} -> {interp} performance")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("csv")
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--agents", action="store_true")
+    ap.add_argument("--histogram", action="store_true")
+    ap.add_argument("--percentiles", action="store_true")
+    ap.add_argument("--save", default=None)
+    args = ap.parse_args(argv)
+
+    try:
+        df = pd.read_csv(args.csv)
+    except Exception as e:
+        print(f"cannot read {args.csv}: {e}", file=sys.stderr)
+        return 1
+
+    sections = [basic_stats(df)]
+    if args.all or args.agents:
+        sections.append(per_agent(df))
+    if args.all or args.histogram:
+        sections.append(histogram(df))
+    if args.all or args.percentiles:
+        sections.append(percentiles(df))
+    sections.append(executive_summary(df))
+    report = "\n".join(s for s in sections if s)
+    print(report)
+    if args.save:
+        with open(args.save, "w") as f:
+            f.write(report + "\n")
+        print(f"\nreport saved to {args.save}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Compare centralized vs decentralized planning cost per step (capability of
+the reference's compare_path_metrics.py).
+
+Centralized: one sample = one whole planning call for all agents, so the
+per-step cost is the sample mean.  Decentralized: each agent reports its own
+decision time, so samples are grouped into 100 ms wall-clock buckets
+(timestamp_ms column) and one logical step costs the *max* over the parallel
+agents in the bucket.
+
+Usage: python analysis/compare_path_metrics.py centralized.csv decentralized.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import pandas as pd
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("centralized_csv")
+    ap.add_argument("decentralized_csv")
+    args = ap.parse_args(argv)
+
+    try:
+        cent = pd.read_csv(args.centralized_csv)
+        dec = pd.read_csv(args.decentralized_csv)
+    except Exception as e:
+        print(f"cannot read inputs: {e}", file=sys.stderr)
+        return 1
+
+    print("=" * 64)
+    print("PATH COMPUTATION: centralized vs decentralized")
+    print("=" * 64)
+
+    c = cent["duration_micros"]
+    print(f"\nCentralized ({len(c)} planning calls):")
+    print(f"  mean {c.mean() / 1000:.3f} ms   median {c.median() / 1000:.3f} ms"
+          f"   max {c.max() / 1000:.3f} ms")
+    cent_step = c.mean()
+
+    d = dec["duration_micros"]
+    print(f"\nDecentralized ({len(d)} per-agent decisions):")
+    print(f"  mean {d.mean() / 1000:.3f} ms   median {d.median() / 1000:.3f} ms"
+          f"   max {d.max() / 1000:.3f} ms")
+    if "timestamp_ms" in dec.columns and dec["timestamp_ms"].notna().any():
+        grouped = dec.dropna(subset=["timestamp_ms"]).copy()
+        grouped["bucket"] = (grouped["timestamp_ms"] // 100) * 100
+        per_step_max = grouped.groupby("bucket")["duration_micros"].max()
+        per_step_mean = grouped.groupby("bucket")["duration_micros"].mean()
+        print(f"  per-step (100 ms buckets, {len(per_step_max)} steps): "
+              f"max-mean {per_step_max.mean() / 1000:.3f} ms, "
+              f"mean-mean {per_step_mean.mean() / 1000:.3f} ms")
+        dec_step = per_step_max.mean()
+    else:
+        dec_step = d.mean()
+
+    print("\n" + "-" * 64)
+    if cent_step > 0:
+        ratio = dec_step / cent_step
+        print(f"one decentralized step costs {ratio:.4f}x "
+              f"one centralized step")
+        if ratio < 1:
+            print(f"-> decentralized per-step compute is "
+                  f"{1 / ratio:.1f}x cheaper (it parallelizes across agents)")
+        else:
+            print("-> centralized per-step compute is cheaper at this scale")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,18 @@ def _blockers(occ, pos, u):
     return jnp.where(has_move, occ[u], -1), has_move
 
 
+def _within_radius(cfg: SolverConfig, pos, i_idx, j_idx):
+    """Manhattan-visibility mask for agent pairs (decentralized mode,
+    ref TSWAP_RADIUS=15 at src/bin/decentralized/agent.rs:796-801).
+    Centralized mode (visibility_radius=None) sees everyone."""
+    if cfg.visibility_radius is None:
+        return jnp.ones_like(i_idx, bool)
+    w = cfg.width
+    a, b = pos[i_idx], pos[j_idx]
+    mh = (jnp.abs(a % w - b % w) + jnp.abs(a // w - b // w))
+    return mh <= cfg.visibility_radius
+
+
 def _apply_pair_swaps(goal, slot, sel, partner, n):
     """Permute (goal, slot) by the disjoint transpositions {i <-> partner[i]}
     for selected i.
@@ -95,7 +107,8 @@ def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ, active):
     u = jnp.where(active, nh_fn(slot, pos), pos)
     b, has_move = _blockers(occ, pos, u)
     bc = jnp.clip(b, 0, n - 1)
-    cand = has_move & (b >= 0) & at_goal[bc]
+    cand = (has_move & (b >= 0) & at_goal[bc]
+            & _within_radius(cfg, pos, idx, bc))
     # lowest claimant id per blocker wins
     winner = jnp.full(n + 1, n, jnp.int32).at[jnp.where(cand, b, n)].min(idx)
     sel3 = cand & (winner[bc] == idx)
@@ -106,8 +119,15 @@ def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ, active):
     u = jnp.where(active, nh_fn(slot, pos), pos)
     b, has_move = _blockers(occ, pos, u)
     # blocking-graph successor; n = absorbing sentinel (chain breaks at
-    # at-goal agents automatically: they have no move, f = n)
-    f = jnp.where(has_move & (b >= 0), b, n)
+    # at-goal agents automatically: they have no move, f = n).  In
+    # decentralized mode edges are limited to visible pairs, so detected
+    # cycles have every consecutive pair within radius (the reference
+    # requires the whole chain inside the *initiator's* radius,
+    # agent.rs:379-448 — a slightly stricter condition; divergence is
+    # validated empirically like the other parallel-ordering differences).
+    f = jnp.where(has_move & (b >= 0)
+                  & _within_radius(cfg, pos, idx, jnp.clip(b, 0, n - 1)),
+                  b, n)
     f_ext = jnp.concatenate([f, jnp.array([n], jnp.int32)])
     def cycle_scan(carry, _):
         y, on_cycle = carry
