@@ -14,14 +14,15 @@ client, however, is created lazily: setting XLA_FLAGS *before* the first
 defaults through ``jax_default_device`` keeps every test off the TPU.
 ``parallel.mesh.agent_mesh`` follows the default device's platform, so
 sharded tests pick up the 8-device CPU mesh automatically.
+
+The bootstrap logic is shared with __graft_entry__.dryrun_multichip via
+``parallel.virtual_mesh`` (which imports no jax at module level).
 """
 
-import os
+from p2p_distributed_tswap_tpu.parallel.virtual_mesh import (  # noqa: E402
+    force_virtual_cpu_devices)
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+force_virtual_cpu_devices(8)
 
 import jax  # noqa: E402  (after XLA_FLAGS, intentionally)
 
