@@ -37,7 +37,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
-from p2p_distributed_tswap_tpu.ops.distance import apply_direction, direction_fields
+from p2p_distributed_tswap_tpu.ops.distance import (
+    apply_direction,
+    direction_fields,
+    gather_packed,
+    pack_directions,
+    packed_cells,
+)
 from p2p_distributed_tswap_tpu.parallel.mesh import AGENTS_AXIS, agent_mesh
 from p2p_distributed_tswap_tpu.solver import mapd as mapd_mod
 from p2p_distributed_tswap_tpu.solver.mapd import MapdState, init_state
@@ -53,7 +59,7 @@ def _sharded_next_hops(cfg: SolverConfig, dirs_local: jnp.ndarray,
     inv = jnp.zeros(n, jnp.int32).at[slot].set(jnp.arange(n, dtype=jnp.int32))
     rows = jnp.arange(rows_local, dtype=jnp.int32)
     holders = inv[shard * rows_local + rows]          # (L,) agent per local row
-    vals = dirs_local[rows, pos[holders]]             # (L,) uint8 codes
+    vals = gather_packed(dirs_local, rows, pos[holders])  # (L,) uint8 codes
     contrib = jnp.zeros(n, jnp.int32).at[holders].set(vals.astype(jnp.int32))
     codes = jax.lax.psum(contrib, AGENTS_AXIS).astype(jnp.uint8)
     return apply_direction(pos, codes, cfg.width)
@@ -82,12 +88,13 @@ def _sharded_replan(cfg: SolverConfig, s: MapdState, free: jnp.ndarray
         selc = jnp.clip(sel, 0, n - 1)
         fields = direction_fields(free, s.goal[selc],
                                   max_rounds=cfg.max_sweep_rounds)
-        fields = fields.reshape(r, cfg.num_cells)
+        fields = pack_directions(fields.reshape(r, cfg.num_cells))
         # local row index; invalid lanes go to a scratch row (no OOB scatter)
         local_row = jnp.where(valid, s.slot[selc] - shard * rows_local,
                               rows_local)
         padded = jnp.concatenate(
-            [dirs_local, jnp.zeros((1, cfg.num_cells), dirs_local.dtype)])
+            [dirs_local,
+             jnp.zeros((1, packed_cells(cfg.num_cells)), dirs_local.dtype)])
         dirs_local = padded.at[local_row].set(fields)[:rows_local]
         cleared = jnp.zeros(n, bool).at[selc].max(valid)
         return dirs_local, own & ~cleared
@@ -170,5 +177,9 @@ def solve_offline_sharded(grid: Grid, starts_idx: np.ndarray,
     final = run(jnp.asarray(starts_idx, jnp.int32),
                 jnp.asarray(tasks, jnp.int32), jnp.asarray(grid.free))
     makespan = int(final.t)
+    if not cfg.record_paths:
+        n = len(starts_idx)
+        return (np.zeros((0, n), np.int32), np.zeros((0, n), np.int8),
+                makespan)
     return (np.asarray(final.paths_pos[:makespan]),
             np.asarray(final.paths_state[:makespan]), makespan)

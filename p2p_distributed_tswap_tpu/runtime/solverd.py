@@ -36,7 +36,12 @@ import numpy as np
 
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
-from p2p_distributed_tswap_tpu.ops.distance import DIR_STAY, direction_fields
+from p2p_distributed_tswap_tpu.ops.distance import (
+    PACKED_STAY,
+    direction_fields,
+    pack_directions,
+    packed_cells,
+)
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
 from p2p_distributed_tswap_tpu.solver.step import step_parallel
 
@@ -52,7 +57,7 @@ class PlanService:
         self.max_fields = field_cache
         # goal cell -> row index into the dirs buffer
         self.goal_rows: "OrderedDict[int, int]" = OrderedDict()
-        self.dirs: jnp.ndarray | None = None  # (rows, HW) uint8
+        self.dirs: jnp.ndarray | None = None  # (rows, ceil(HW/2)) packed uint8
         self._step = functools.partial(jax.jit, static_argnums=0)(step_parallel)
 
     def _capacity(self, n: int) -> int:
@@ -63,10 +68,10 @@ class PlanService:
 
     def _ensure_fields(self, goals: List[int]) -> None:
         missing = [g for g in dict.fromkeys(goals) if g not in self.goal_rows]
+        pc = packed_cells(self.grid.num_cells)
         if self.dirs is None:
             rows = max(self._capacity(len(missing)), self.capacity_min)
-            self.dirs = jnp.full((rows, self.grid.num_cells), DIR_STAY,
-                                 jnp.uint8)
+            self.dirs = jnp.full((rows, pc), PACKED_STAY, jnp.uint8)
         needed = len(self.goal_rows) + len(missing)
         if needed > self.dirs.shape[0]:
             grow = self.dirs.shape[0]
@@ -74,8 +79,8 @@ class PlanService:
                 grow *= 2
             self.dirs = jnp.concatenate(
                 [self.dirs,
-                 jnp.full((grow - self.dirs.shape[0], self.grid.num_cells),
-                          DIR_STAY, jnp.uint8)])
+                 jnp.full((grow - self.dirs.shape[0], pc), PACKED_STAY,
+                          jnp.uint8)])
         if not missing:
             return
         # evict LRU rows when over budget — never a goal of the current
@@ -88,7 +93,7 @@ class PlanService:
         free_rows = [r for r in range(self.dirs.shape[0]) if r not in used]
         fields = direction_fields(self.free,
                                   jnp.asarray(missing, jnp.int32))
-        fields = fields.reshape(len(missing), -1)
+        fields = pack_directions(fields.reshape(len(missing), -1))
         rows = free_rows[:len(missing)]
         self.dirs = self.dirs.at[jnp.asarray(rows)].set(fields)
         for g, r in zip(missing, rows):

@@ -25,7 +25,12 @@ from flax import struct
 from p2p_distributed_tswap_tpu.core.agent import AgentPhase, AgentState
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
-from p2p_distributed_tswap_tpu.ops.distance import DIR_STAY, direction_fields
+from p2p_distributed_tswap_tpu.ops.distance import (
+    PACKED_STAY,
+    direction_fields,
+    pack_directions,
+    packed_cells,
+)
 from p2p_distributed_tswap_tpu.solver.step import (
     step_parallel,
     step_with_next_hops,
@@ -39,7 +44,7 @@ class MapdState:
     pos: jnp.ndarray          # (N,) int32 flat cell
     goal: jnp.ndarray         # (N,) int32 flat cell
     slot: jnp.ndarray         # (N,) int32 agent -> field row
-    dirs: jnp.ndarray         # (N, HW) uint8 direction fields by row
+    dirs: jnp.ndarray         # (N, ceil(HW/2)) uint8 packed direction fields
     phase: jnp.ndarray        # (N,) int8 AgentPhase
     agent_task: jnp.ndarray   # (N,) int32 task index or -1
     task_used: jnp.ndarray    # (T,) bool
@@ -52,11 +57,13 @@ class MapdState:
 def init_state(cfg: SolverConfig, starts: jnp.ndarray,
                num_tasks: int) -> MapdState:
     n, hw, tmax = cfg.num_agents, cfg.num_cells, cfg.max_timesteps
+    # path buffers shrink to one dummy row when recording is off
+    tdim = tmax + 1 if cfg.record_paths else 1
     return MapdState(
         pos=jnp.asarray(starts, jnp.int32),
         goal=jnp.asarray(starts, jnp.int32),
         slot=jnp.arange(n, dtype=jnp.int32),
-        dirs=jnp.full((n, hw), DIR_STAY, jnp.uint8),
+        dirs=jnp.full((n, packed_cells(hw)), PACKED_STAY, jnp.uint8),
         phase=jnp.full(n, AgentPhase.IDLE, jnp.int8),
         agent_task=jnp.full(n, -1, jnp.int32),
         task_used=jnp.zeros(num_tasks, bool),
@@ -65,8 +72,8 @@ def init_state(cfg: SolverConfig, starts: jnp.ndarray,
         # to an agent elsewhere — so every field is computed on the first step.
         need_replan=jnp.ones(n, bool),
         t=jnp.int32(0),
-        paths_pos=jnp.zeros((tmax + 1, n), jnp.int32),
-        paths_state=jnp.zeros((tmax + 1, n), jnp.int8),
+        paths_pos=jnp.zeros((tdim, n), jnp.int32),
+        paths_state=jnp.zeros((tdim, n), jnp.int8),
     )
 
 
@@ -85,31 +92,88 @@ def _transitions(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray) -> MapdSta
                      need_replan=s.need_replan | tp)
 
 
-def _assign(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray) -> MapdState:
-    """Greedy nearest-pickup assignment in agent-id order (ref tswap.rs:123-138):
-    a sequential scan, because each claim removes a task from the pool.
-    Ties go to the lowest task index (Rust min_by_key keeps the first min)."""
+def _nearest_unused(cfg: SolverConfig, pos: jnp.ndarray,
+                    task_used: jnp.ndarray, tasks: jnp.ndarray):
+    """Per-agent (distance, index) of the nearest unused task pickup,
+    Manhattan metric, lowest task index on ties (the reference's
+    ``min_by_key`` keeps the first minimum).  Chunked over the task axis so
+    transient memory is (N, assign_chunk) int32, never the full (N, T)
+    matrix (400 MB at the FLAGSHIP rung, 40 GB at EXTREME)."""
     n, w = cfg.num_agents, cfg.width
-    px, py = tasks[:, 0] % w, tasks[:, 0] // w
+    t = tasks.shape[0]
+    c = min(cfg.assign_chunk, t)
+    nchunks = -(-t // c)
+    pad = nchunks * c - t
+    px = jnp.pad(tasks[:, 0] % w, (0, pad))
+    py = jnp.pad(tasks[:, 0] // w, (0, pad))
+    used = jnp.pad(task_used, (0, pad), constant_values=True)
+    ax, ay = pos % w, pos // w
 
-    def body(carry, i):
-        task_used, goal, phase, agent_task, need = carry
-        d = (jnp.abs(px - s.pos[i] % w) + jnp.abs(py - s.pos[i] // w)
-             + _FAR * task_used)
-        k = jnp.argmin(d).astype(jnp.int32)
-        do = (phase[i] == AgentPhase.IDLE) & ~task_used[k]
-        return (
-            task_used.at[k].set(task_used[k] | do),
-            goal.at[i].set(jnp.where(do, tasks[k, 0], goal[i])),
-            phase.at[i].set(jnp.where(do, AgentPhase.TO_PICKUP, phase[i])
-                            .astype(jnp.int8)),
-            agent_task.at[i].set(jnp.where(do, k, agent_task[i])),
-            need.at[i].set(need[i] | do),
-        ), None
+    def chunk(carry, ci):
+        best_d, best_k = carry
+        o = ci * c
+        cpx = jax.lax.dynamic_slice_in_dim(px, o, c)
+        cpy = jax.lax.dynamic_slice_in_dim(py, o, c)
+        cused = jax.lax.dynamic_slice_in_dim(used, o, c)
+        d = (jnp.abs(cpx[None, :] - ax[:, None])
+             + jnp.abs(cpy[None, :] - ay[:, None]))
+        d = jnp.where(cused[None, :], _FAR, d)
+        k = jnp.argmin(d, axis=1).astype(jnp.int32)  # first min in chunk
+        dk = jnp.take_along_axis(d, k[:, None], axis=1)[:, 0]
+        better = dk < best_d  # strict: ties keep the earlier chunk's index
+        return (jnp.where(better, dk, best_d),
+                jnp.where(better, o + k, best_k)), None
 
-    init = (s.task_used, s.goal, s.phase, s.agent_task, s.need_replan)
-    (task_used, goal, phase, agent_task, need), _ = jax.lax.scan(
-        body, init, jnp.arange(n, dtype=jnp.int32))
+    init = (jnp.full(n, _FAR, jnp.int32), jnp.zeros(n, jnp.int32))
+    (bd, bk), _ = jax.lax.scan(chunk, init,
+                               jnp.arange(nchunks, dtype=jnp.int32))
+    return bd, bk
+
+
+def _assign(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray) -> MapdState:
+    """Greedy nearest-pickup assignment (ref tswap.rs:123-138), parallelized.
+
+    The reference assigns in agent-id order — a serial chain of N argmins
+    over T tasks, O(N*T) sequential work (the round-1 scaling wall).  Here
+    every idle agent proposes its nearest unused task at once; contested
+    tasks go to the lowest proposing agent id; losers re-propose next round
+    over the shrunken pool, until no proposal succeeds.  Each round claims
+    >=1 task, so rounds <= min(#idle, #unused) — in practice a handful.
+
+    Documented approximation (validated for makespan parity like the other
+    parallel-ordering divergences, tests/test_solver.py): the result can
+    differ from the sequential greedy when agent j (j > i) wins task B in an
+    early round while agent i — having lost its first choice A — would have
+    claimed B before j in the sequential id-order scan.  The oracle
+    (solver/oracle.py) keeps the exact sequential semantics."""
+    n = cfg.num_agents
+    t = tasks.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    def cond(carry):
+        return carry[-1]
+
+    def body(carry):
+        task_used, goal, phase, agent_task, need, _ = carry
+        idle = phase == AgentPhase.IDLE
+        bd, bk = _nearest_unused(cfg, s.pos, task_used, tasks)
+        want = idle & (bd < _FAR)
+        # lowest claimant id per task wins (scratch slot t: no OOB scatter)
+        winner = jnp.full(t + 1, n, jnp.int32).at[
+            jnp.where(want, bk, t)].min(idx)
+        win = want & (winner[bk] == idx)
+        claimed = jnp.zeros(t + 1, bool).at[jnp.where(win, bk, t)].set(True)
+        return (task_used | claimed[:t],
+                jnp.where(win, tasks[bk, 0], goal),
+                jnp.where(win, AgentPhase.TO_PICKUP, phase).astype(jnp.int8),
+                jnp.where(win, bk, agent_task),
+                need | win,
+                jnp.any(win))
+
+    init = (s.task_used, s.goal, s.phase, s.agent_task, s.need_replan,
+            jnp.bool_(True))
+    task_used, goal, phase, agent_task, need, _ = jax.lax.while_loop(
+        cond, body, init)
     return s.replace(task_used=task_used, goal=goal, phase=phase,
                      agent_task=agent_task, need_replan=need)
 
@@ -132,7 +196,7 @@ def _replan(cfg: SolverConfig, s: MapdState, free: jnp.ndarray) -> MapdState:
         selc = jnp.clip(sel, 0, n - 1)
         fields = direction_fields(free, s.goal[selc],
                                   max_rounds=cfg.max_sweep_rounds)
-        fields = fields.reshape(r, cfg.num_cells)
+        fields = pack_directions(fields.reshape(r, cfg.num_cells))
         # Invalid lanes clip to agent n-1, whose (goal, slot) pair is still
         # consistent — so their writes are redundant but *correct*, and no
         # out-of-bounds scatter index is ever needed (XLA CPU has been seen
@@ -146,7 +210,10 @@ def _replan(cfg: SolverConfig, s: MapdState, free: jnp.ndarray) -> MapdState:
 
 
 def _record(cfg: SolverConfig, s: MapdState) -> MapdState:
-    """Path recording (ref tswap.rs:143-158)."""
+    """Path recording (ref tswap.rs:143-158); compile-time no-op (beyond the
+    timestep increment) when ``cfg.record_paths`` is off."""
+    if not cfg.record_paths:
+        return s.replace(t=s.t + 1)
     state = jnp.where(
         s.phase == AgentPhase.IDLE, AgentState.IDLE,
         jnp.where(s.phase == AgentPhase.TO_PICKUP, AgentState.PICKING,
@@ -252,5 +319,9 @@ def solve_offline(grid: Grid, starts_idx: np.ndarray, tasks: np.ndarray,
                           jnp.asarray(tasks, jnp.int32),
                           jnp.asarray(grid.free))
     makespan = int(final.t)
+    if not cfg.record_paths:
+        n = len(starts_idx)
+        return (np.zeros((0, n), np.int32), np.zeros((0, n), np.int8),
+                makespan)
     return (np.asarray(final.paths_pos[:makespan]),
             np.asarray(final.paths_state[:makespan]), makespan)

@@ -43,14 +43,19 @@ import jax
 import jax.numpy as jnp
 
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
-from p2p_distributed_tswap_tpu.ops.distance import apply_direction
+from p2p_distributed_tswap_tpu.ops.distance import (
+    apply_direction,
+    gather_packed,
+)
 
 
 def next_hops(cfg: SolverConfig, dirs: jnp.ndarray, slot: jnp.ndarray,
               pos: jnp.ndarray) -> jnp.ndarray:
-    """Desired next cell per agent: one gather from that agent's direction
-    field (row ``slot[i]``).  Equals ``pos`` for stay (at goal/unreachable)."""
-    code = dirs[slot, pos]
+    """Desired next cell per agent: one byte gather from that agent's
+    nibble-packed direction field (row ``slot[i]``; see
+    ``ops.distance.pack_directions``).  Equals ``pos`` for stay (at
+    goal/unreachable)."""
+    code = gather_packed(dirs, slot, pos)
     return apply_direction(pos, code, cfg.width)
 
 
@@ -194,8 +199,9 @@ def step_parallel(cfg: SolverConfig, pos: jnp.ndarray, goal: jnp.ndarray,
       pos:  (N,) int32 flat cell per agent (vertex-disjoint).
       goal: (N,) int32 flat goal cell per agent.
       slot: (N,) int32 agent -> direction-field row (a permutation).
-      dirs: (N, H*W) uint8 direction fields, row ``slot[i]`` is agent i's
-        field (invariant: row slot[i] encodes descent toward goal[i]).
+      dirs: (N, ceil(H*W/2)) uint8 nibble-packed direction fields
+        (ops.distance.pack_directions), row ``slot[i]`` is agent i's field
+        (invariant: row slot[i] encodes descent toward goal[i]).
 
     Returns:
       (pos, goal, slot) after the step; ``dirs`` is never modified (goal

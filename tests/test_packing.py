@@ -1,0 +1,123 @@
+"""Packed direction fields + parallel assignment unit tests."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from p2p_distributed_tswap_tpu.core.agent import AgentPhase
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.ops.distance import (
+    DIR_STAY,
+    direction_fields,
+    gather_packed,
+    pack_directions,
+    packed_cells,
+)
+from p2p_distributed_tswap_tpu.solver.mapd import (
+    _assign,
+    init_state,
+    solve_offline,
+)
+
+
+def test_pack_gather_roundtrip_even_and_odd():
+    rng = np.random.default_rng(0)
+    for hw in (10, 11, 64, 101):
+        fields = rng.integers(0, 5, size=(3, hw)).astype(np.uint8)
+        packed = pack_directions(jnp.asarray(fields))
+        assert packed.shape == (3, packed_cells(hw))
+        rows = jnp.asarray(np.repeat(np.arange(3), hw).astype(np.int32))
+        pos = jnp.asarray(np.tile(np.arange(hw), 3).astype(np.int32))
+        got = np.asarray(gather_packed(packed, rows, pos)).reshape(3, hw)
+        np.testing.assert_array_equal(got, fields)
+
+
+def test_pack_odd_tail_is_stay():
+    fields = jnp.zeros((1, 5), jnp.uint8)  # odd cell count
+    packed = pack_directions(fields)
+    # high nibble of last byte is the DIR_STAY pad
+    assert int(packed[0, -1]) >> 4 == DIR_STAY
+
+
+def test_packed_fields_match_unpacked_semantics():
+    grid = Grid.random_obstacles(12, 12, 0.2, seed=4)
+    goals = jnp.asarray([5, 17, 100], jnp.int32)
+    fields = direction_fields(jnp.asarray(grid.free), goals).reshape(3, -1)
+    packed = pack_directions(fields)
+    pos = jnp.asarray(np.arange(grid.num_cells, dtype=np.int32))
+    for r in range(3):
+        rows = jnp.full(grid.num_cells, r, jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(gather_packed(packed, rows, pos)),
+            np.asarray(fields[r]))
+
+
+def _np_parallel_assign(pos, phase, task_used, tasks, w):
+    """Literal numpy model of the round-based parallel assignment."""
+    n, t = len(pos), len(tasks)
+    task_used = task_used.copy()
+    goal = np.full(n, -1, np.int64)
+    agent_task = np.full(n, -1, np.int64)
+    phase = phase.copy()
+    while True:
+        proposals = {}
+        for i in range(n):
+            if phase[i] != AgentPhase.IDLE or agent_task[i] >= 0:
+                continue
+            best, bk = None, -1
+            for k in range(t):
+                if task_used[k]:
+                    continue
+                d = (abs(tasks[k, 0] % w - pos[i] % w)
+                     + abs(tasks[k, 0] // w - pos[i] // w))
+                if best is None or d < best:
+                    best, bk = d, k
+            if bk >= 0:
+                proposals.setdefault(bk, []).append(i)
+        if not proposals:
+            return goal, agent_task, task_used
+        for k, claimants in proposals.items():
+            i = min(claimants)
+            task_used[k] = True
+            goal[i] = tasks[k, 0]
+            agent_task[i] = k
+            phase[i] = AgentPhase.TO_PICKUP
+
+
+def test_parallel_assignment_matches_round_model():
+    rng = np.random.default_rng(1)
+    grid = Grid.from_ascii("\n".join(["." * 16] * 16))
+    n, t = 9, 7
+    pos = rng.choice(grid.num_cells, size=n, replace=False).astype(np.int32)
+    tasks = rng.choice(grid.num_cells, size=(t, 2)).astype(np.int32)
+    cfg = SolverConfig(height=16, width=16, num_agents=n, assign_chunk=3)
+    s = init_state(cfg, jnp.asarray(pos), t)
+    out = _assign(cfg, s, jnp.asarray(tasks))
+    g_np, at_np, used_np = _np_parallel_assign(
+        pos, np.asarray(s.phase), np.zeros(t, bool), tasks, 16)
+    assigned = at_np >= 0
+    np.testing.assert_array_equal(np.asarray(out.agent_task), at_np)
+    np.testing.assert_array_equal(np.asarray(out.task_used), used_np)
+    np.testing.assert_array_equal(
+        np.asarray(out.goal)[assigned], g_np[assigned])
+    # unassigned agents keep their previous (start) goal
+    np.testing.assert_array_equal(
+        np.asarray(out.goal)[~assigned], pos[~assigned])
+    assert used_np.sum() == min(n, t)
+
+
+def test_record_paths_off_solves_identically():
+    from p2p_distributed_tswap_tpu.core.sampling import start_positions_array
+    from p2p_distributed_tswap_tpu.core.tasks import TaskGenerator
+
+    grid = Grid.random_obstacles(14, 14, 0.15, seed=2)
+    starts = start_positions_array(grid, 4, seed=3)
+    tasks = TaskGenerator(grid, seed=4).generate_task_arrays(3)
+    cfg_on = SolverConfig(height=14, width=14, num_agents=4)
+    cfg_off = SolverConfig(height=14, width=14, num_agents=4,
+                           record_paths=False)
+    p_on, s_on, mk_on = solve_offline(grid, starts, tasks, cfg=cfg_on)
+    p_off, s_off, mk_off = solve_offline(grid, starts, tasks, cfg=cfg_off)
+    assert mk_on == mk_off
+    assert p_off.shape == (0, 4) and s_off.shape == (0, 4)
+    assert p_on.shape == (mk_on, 4)
