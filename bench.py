@@ -1,6 +1,10 @@
-"""Benchmark entry: one JSON line for the driver.
+"""Benchmark entry: the BASELINE.json config ladder.
 
-Headline metric: average wall-clock per MAPD timestep on the reference's own
+Prints one informational JSON line per rung (stdout, one per line) and the
+headline metric as the FINAL line — the driver parses one JSON line
+(BENCH_r*.json); earlier lines are valid JSON too.
+
+Headline: average wall-clock per MAPD timestep at the reference's own
 comfortable configuration — 50 agents on the built-in 100x100 empty grid —
 where the reference's centralized manager measured ~180 ms per planning step
 (src/bin/centralized/manager.rs:564-567, DECENTRALIZED_ISSUES.md:36-42; see
@@ -8,31 +12,71 @@ BASELINE.md).  One timestep here includes everything the reference's step
 includes and more: task assignment, replanning, the full TSWAP swap/rotation
 conflict resolution, and movement for all agents.
 
-vs_baseline = reference_ms / our_ms (higher is better, >1 beats the baseline).
+Ladder rungs (models/scenarios.py): small rungs run the FULL solve
+(ms/step = total/steps, makespan reported); large rungs measure a
+steady-state window — a compiled K-step program run after a warmup program
+that absorbs compilation and the initial field-computation burst.  The north
+star (BASELINE.md): 10k agents on 1024^2, < 1 s/step on one chip.
+
+Robustness: every rung runs in a FRESH SUBPROCESS with retries.  The axon
+TPU tunnel in this environment nondeterministically kills large compiled
+programs ("UNAVAILABLE: TPU device error — often a kernel fault"; ~50% of
+runs at the 512^2 rung are hit) and can leave a process in a degraded
+~20 ms/dispatch mode; process isolation + retry is the reliable recipe.
+
+vs_baseline = reference_ms / our_ms for the reference rung (higher is
+better); for other rungs it is target_ms / our_ms against the 1 s/step
+north-star budget.
+
+Env knobs: BENCH_RUNGS=comma list (default "ref,small,medium,flagship"),
+BENCH_FULL=1 to also run large rungs to completion for makespan,
+BENCH_TRIES=retries per rung (default 3).
 """
 
 from __future__ import annotations
 
+import functools
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+REFERENCE_STEP_MS = 180.0   # ~50 agents, 100x100 (BASELINE.md)
+TARGET_STEP_MS = 1000.0     # north-star budget at scale (BASELINE.md)
 
-from p2p_distributed_tswap_tpu.models.scenarios import REFERENCE_DEMO
-from p2p_distributed_tswap_tpu.solver.mapd import _run_mapd_jit
+# rungs measured by full solve (cheap) vs steady-state step window
+FULL_SOLVE = {"ref", "small"}
+WARMUP_STEPS = 12
+MEASURE_STEPS = 25
 
-REFERENCE_STEP_MS = 180.0  # ~50 agents, 100x100 (BASELINE.md)
+
+def _rungs():
+    from p2p_distributed_tswap_tpu.models import scenarios
+
+    return {
+        "ref": scenarios.REFERENCE_DEMO,
+        "small": scenarios.SMALL,
+        "medium": scenarios.MEDIUM,
+        "flagship": scenarios.FLAGSHIP,
+        "extreme": scenarios.EXTREME,
+    }
 
 
-def bench_reference_demo(seed: int = 0):
-    grid, starts, tasks, cfg = REFERENCE_DEMO.build(seed=seed)
+def bench_full_solve(scn, seed: int = 0):
+    """Full MAPD solve; ms/step averaged over the whole run."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_distributed_tswap_tpu.solver import mapd
+
+    grid, starts, tasks, cfg = scn.build(seed=seed)
     args = (cfg, jnp.asarray(starts, jnp.int32), jnp.asarray(tasks, jnp.int32),
             jnp.asarray(grid.free))
-    final = _run_mapd_jit(*args)          # compile + warm run
+    final = mapd._run_mapd_jit(*args)     # compile + warm run
     jax.block_until_ready(final)
     t0 = time.perf_counter()
-    final = _run_mapd_jit(*args)
+    final = mapd._run_mapd_jit(*args)
     jax.block_until_ready(final)
     elapsed = time.perf_counter() - t0
     steps = int(final.t)
@@ -40,14 +84,119 @@ def bench_reference_demo(seed: int = 0):
     return 1000.0 * elapsed / steps, steps
 
 
-def main():
-    ms_per_step, steps = bench_reference_demo()
-    print(json.dumps({
-        "metric": "mapd_step_wallclock_50agents_100x100",
-        "value": round(ms_per_step, 4),
+def bench_step_window(scn, seed: int = 0):
+    """Steady-state per-step time: a compiled WARMUP_STEPS program (absorbs
+    the initial replan burst), then a timed compiled MEASURE_STEPS program.
+    Path recording off — pure throughput (BASELINE.md measures step time).
+
+    NB: constant-bound lax.while_loop over the step body; k is a static
+    argument.  Buffer donation and dynamic loop bounds both trip axon
+    backend errors, so neither is used."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_distributed_tswap_tpu.solver import mapd
+
+    grid, starts, tasks, cfg = scn.build(seed=seed)
+    cfg = dataclasses.replace(cfg, record_paths=False)
+    tasks_j = jnp.asarray(tasks, jnp.int32)
+    free_j = jnp.asarray(grid.free)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def run_k(s, k):
+        def body(c):
+            s, i = c
+            return mapd.mapd_step(cfg, s, tasks_j, free_j), i + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < k, body,
+                                  (s, jnp.int32(0)))[0]
+
+    s = mapd.init_state(cfg, jnp.asarray(starts, jnp.int32), tasks.shape[0])
+    s = run_k(s, WARMUP_STEPS)
+    jax.block_until_ready(s)
+    run_k(s, MEASURE_STEPS)  # compile the measured program off the clock
+    t0 = time.perf_counter()
+    s = run_k(s, MEASURE_STEPS)
+    jax.block_until_ready(s)
+    elapsed = time.perf_counter() - t0
+    makespan = None
+    if os.environ.get("BENCH_FULL") == "1":
+        final = mapd._run_mapd_jit(
+            cfg, jnp.asarray(starts, jnp.int32), tasks_j, free_j)
+        jax.block_until_ready(final)
+        makespan = int(final.t)
+    return 1000.0 * elapsed / MEASURE_STEPS, makespan
+
+
+def run_rung(name: str) -> dict:
+    scn = _rungs()[name]
+    if name in FULL_SOLVE:
+        ms, steps = bench_full_solve(scn)
+        makespan = steps
+    else:
+        ms, makespan = bench_step_window(scn)
+    grid = scn.grid_fn()
+    baseline = REFERENCE_STEP_MS if name == "ref" else TARGET_STEP_MS
+    return {
+        "metric": f"mapd_step_wallclock_{scn.name}",
+        "value": round(ms, 4),
         "unit": "ms/step",
-        "vs_baseline": round(REFERENCE_STEP_MS / ms_per_step, 2),
-    }))
+        "vs_baseline": round(baseline / ms, 2),
+        "makespan": makespan,
+        "agents": scn.num_agents,
+        "grid": f"{grid.height}x{grid.width}",
+    }
+
+
+def run_rung_subprocess(name: str, tries: int) -> dict:
+    """Run one rung isolated in a fresh process, retrying on the tunnel's
+    nondeterministic kernel faults."""
+    err = ""
+    for attempt in range(tries):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rung", name],
+            capture_output=True, text=True, timeout=3600,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                out = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in out:
+                return out
+        err = (proc.stderr or proc.stdout or "")[-400:]
+        print(json.dumps({"rung": name, "attempt": attempt + 1,
+                          "transient_failure": err.splitlines()[-1] if err
+                          else "no output"}), file=sys.stderr, flush=True)
+        time.sleep(15)  # give the tunnel a moment to recover
+    return {"metric": f"mapd_step_wallclock_{name}", "value": None,
+            "unit": "ms/step", "vs_baseline": None, "error": err}
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
+        print(json.dumps(run_rung(sys.argv[2])), flush=True)
+        return
+    tries = int(os.environ.get("BENCH_TRIES", "3"))
+    rungs = os.environ.get("BENCH_RUNGS", "ref,small,medium,flagship")
+    results = {}
+    for name in [r.strip() for r in rungs.split(",") if r.strip()]:
+        res = run_rung_subprocess(name, tries)
+        results[name] = res
+        print(json.dumps(res), flush=True)
+    # Headline LAST (the driver parses one JSON line): the reference rung,
+    # with the flagship number attached when measured.
+    ok = {k: v for k, v in results.items() if v.get("value") is not None}
+    head = dict(ok.get("ref") or (next(iter(ok.values())) if ok else
+                                  {"metric": "bench_failed", "value": None,
+                                   "unit": "ms/step", "vs_baseline": None}))
+    if results.get("flagship", {}).get("value") is not None:
+        head["flagship_ms_per_step"] = results["flagship"]["value"]
+        head["flagship_under_1s_target"] = (
+            results["flagship"]["value"] < TARGET_STEP_MS)
+    print(json.dumps(head), flush=True)
 
 
 if __name__ == "__main__":
