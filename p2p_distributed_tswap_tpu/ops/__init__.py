@@ -1,0 +1,18 @@
+"""Batched grid ops: BFS distance/direction fields (the production planner
+primitive) and reserved space-time A* (the prioritized-planning primitive,
+ref src/algorithm/a_star.rs)."""
+
+from p2p_distributed_tswap_tpu.ops import distance
+from p2p_distributed_tswap_tpu.ops.distance import (
+    direction_fields,
+    directions_from_distance,
+    distance_fields,
+    gather_packed,
+    pack_directions,
+)
+from p2p_distributed_tswap_tpu.ops.reserved_astar import (
+    empty_reservations,
+    plan_prioritized,
+    reserve_path,
+    reserved_astar,
+)

@@ -1,0 +1,230 @@
+"""Batched space-time shortest paths under node/edge reservations.
+
+TPU-native capability match for the reference's ``astar_with_reservation``
+(src/algorithm/a_star.rs:32-112) — the unused-but-provided prioritized
+planning primitive: find a shortest path on the 4-connected grid from start
+to goal, allowed to WAIT in place, where a shared reservation table forbids
+being at a cell at a time (node reservation) or crossing an edge at a time
+(edge reservation).
+
+Instead of one binary-heap A* per agent, the whole batch is solved at once by
+**time-expanded breadth-first wavefronts**: ``reach[t]`` is a dense
+``(B, H, W)`` boolean layer, and one ``lax.scan`` step expands it to
+``reach[t+1]`` with five shifted/masked AND-OR updates (4 moves + WAIT).
+Unit edge costs make layer-order expansion exact — the first time layer in
+which the goal lights up is the optimal arrival time, so no priority queue
+and no heuristic are needed (the reference's Manhattan ``heuristic`` only
+accelerates its sequential search; it never changes the result).  The scan
+records a parent-direction layer per step, and a reverse scan reconstructs
+all paths.  Everything is fixed-shape, fully vectorized over the batch and
+the grid — MXU/VPU-friendly, jit/vmap/shard_map-safe.
+
+Blocking semantics match the reference exactly (a_star.rs:80-96), including
+its quirk that a move out of ``pos`` is *also* blocked when ``pos`` itself is
+node-reserved at the arrival time (the ``node_res.contains(&(pos, next_time))``
+arm of a_star.rs:90) — that rule is what prevents trailing an agent through
+its own reserved slot one step behind.  The reference's fourth check
+(a_star.rs:92-95) is subsumed by its second (the same
+``edge_res ((pos,np), next_time)`` term appears in both) and adds nothing.
+
+Reservations are dense time-major boolean tables shared by the whole batch:
+
+* ``node_res``: ``(T+1, H*W)`` — cell occupied at absolute time ``t``.
+* ``edge_res``: ``(T+1, H*W, 4)`` — directed edge ``cell -> cell+DIR_DXDY[d]``
+  crossed *arriving* at absolute time ``t``.  The symmetric reference check
+  (either direction blocks) is applied internally, so reserving one direction
+  of an edge is enough — exactly like inserting one ``((a, b), t)`` tuple
+  into the reference's ``EdgeReservation`` set.
+
+Ties between equal-length paths are broken differently from the reference's
+heap order (we prefer DIR_DXDY order then WAIT); arrival times are identical.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_distributed_tswap_tpu.ops.distance import DIR_DXDY, DIR_STAY
+
+NO_PARENT = np.uint8(0xF)
+# opposite direction code under DIR_DXDY's (0,1),(1,0),(0,-1),(-1,0) order
+OPP = (2, 3, 0, 1)
+
+
+def empty_reservations(horizon: int, num_cells: int) -> Tuple[jnp.ndarray,
+                                                              jnp.ndarray]:
+    """All-clear ``(node_res, edge_res)`` tables for absolute times
+    ``0..horizon`` (equivalent of the reference's two empty HashSets)."""
+    return (jnp.zeros((horizon + 1, num_cells), bool),
+            jnp.zeros((horizon + 1, num_cells, 4), bool))
+
+
+def _shift(a: jnp.ndarray, dx: int, dy: int) -> jnp.ndarray:
+    """Value of ``a`` at (x-dx, y-dy): a True source cell lights up the cell
+    it moves *into*.  Off-grid sources read as False."""
+    z = jnp.zeros_like(a)
+    h, w = a.shape[-2], a.shape[-1]
+    if dy:
+        a = jax.lax.concatenate(
+            [z[..., :dy, :], a[..., :h - dy, :]] if dy > 0 else
+            [a[..., -dy:, :], z[..., h + dy:, :]], a.ndim - 2)
+    if dx:
+        a = jax.lax.concatenate(
+            [z[..., :, :dx], a[..., :, :w - dx]] if dx > 0 else
+            [a[..., :, -dx:], z[..., :, w + dx:]], a.ndim - 1)
+    return a
+
+
+@functools.partial(jax.jit, static_argnames=("start_time",))
+def reserved_astar(free: jnp.ndarray, starts: jnp.ndarray, goals: jnp.ndarray,
+                   node_res: jnp.ndarray, edge_res: jnp.ndarray,
+                   start_time: int = 0):
+    """Batched reserved space-time shortest paths (ref a_star.rs:32-112).
+
+    Args:
+      free: (H, W) bool, True where traversable.
+      starts: (B,) int32 flat start cells (occupied from ``start_time``).
+      goals: (B,) int32 flat goal cells.
+      node_res: (T+1, H*W) bool — cell reserved at absolute time t.
+      edge_res: (T+1, H*W, 4) bool — directed edge reserved at arrival time t
+        (symmetric blocking applied internally).
+      start_time: absolute time the agents sit on ``starts``; the search runs
+        over arrival times ``start_time+1 .. T``.
+
+    Returns:
+      ``(paths, arrival)`` — paths (B, T+1) int32 flat cells: ``paths[b, t]``
+      is agent b's cell at absolute time t (start before/at ``start_time``,
+      goal held after arrival); arrival (B,) int32 absolute arrival times,
+      ``-1`` where the goal is unreachable within the table horizon (the
+      reference's ``None``).
+    """
+    h, w = free.shape
+    hw = h * w
+    horizon = node_res.shape[0] - 1
+    nsteps = horizon - start_time
+    b = starts.shape[0]
+
+    node_g = node_res.reshape(horizon + 1, h, w)
+    edge_g = edge_res.reshape(horizon + 1, h, w, 4)
+
+    cell = jnp.arange(hw, dtype=jnp.int32).reshape(1, h, w)
+    reach0 = (cell == starts.reshape(b, 1, 1)) & free[None]
+
+    def expand(reach, layers):
+        node_t, edge_t = layers  # (H, W), (H, W, 4) at the arrival time
+        # a_star.rs:90 — both the target AND the source cell must be free of
+        # node reservations at the arrival time
+        can_leave = reach & ~node_t[None]
+        cands = []
+        for d, (dx, dy) in enumerate(DIR_DXDY):
+            src_ok = can_leave & ~edge_t[None, :, :, d]          # (pos->np, t)
+            arr = _shift(src_ok, dx, dy) & ~edge_t[None, :, :, OPP[d]]
+            cands.append(arr & free[None] & ~node_t[None])
+        cands.append(can_leave & free[None])                     # WAIT
+        stacked = jnp.stack(cands)                               # (5, B, H, W)
+        parent = jnp.argmax(stacked, axis=0).astype(jnp.uint8)
+        new_reach = jnp.any(stacked, axis=0)
+        parent = jnp.where(new_reach, parent, NO_PARENT)
+        return new_reach, parent
+
+    _, parents = jax.lax.scan(
+        expand, reach0,
+        (node_g[start_time + 1:], edge_g[start_time + 1:]))  # (nsteps, B, H, W)
+
+    parents_flat = parents.reshape(nsteps, b, hw)
+    bidx = jnp.arange(b)
+    at_goal = parents_flat[:, bidx, goals] != NO_PARENT          # (nsteps, B)
+    trivially_done = starts == goals                             # ref :53 pop
+    any_arrival = jnp.any(at_goal, axis=0) | trivially_done
+    first = jnp.argmax(at_goal, axis=0).astype(jnp.int32)        # first True
+    arrival = jnp.where(
+        trivially_done, start_time,
+        jnp.where(any_arrival, start_time + 1 + first, -1))
+
+    # Reverse walk: carry the current cell; before arrival the carry follows
+    # parent pointers, after it the path holds the goal, and unreachable
+    # agents just sit on start.
+    dxs = jnp.array([d[0] for d in DIR_DXDY] + [0], jnp.int32)
+    dys = jnp.array([d[1] for d in DIR_DXDY] + [0], jnp.int32)
+
+    def walk(cur, layer_i):
+        pf, t_abs = layer_i                                      # (B, HW), ()
+        on_path = (arrival >= 0) & (t_abs <= arrival) & (t_abs > start_time)
+        here = jnp.where(on_path, cur, jnp.where(arrival >= 0, goals, starts))
+        here = jnp.where(t_abs <= start_time, starts, here)
+        here = jnp.where((arrival >= 0) & (t_abs > arrival), goals, here)
+        p = jnp.minimum(pf[bidx, cur], DIR_STAY).astype(jnp.int32)
+        prev = cur - dys[p] * w - dxs[p]
+        return jnp.where(on_path, prev, cur), here
+
+    times = jnp.arange(start_time + 1, horizon + 1, dtype=jnp.int32)
+    cur0 = jnp.where(arrival >= 0, goals, starts)
+    _, path_tail = jax.lax.scan(walk, cur0, (parents_flat, times),
+                                reverse=True)                    # (nsteps, B)
+    head = jnp.broadcast_to(starts, (start_time + 1, b))
+    return jnp.concatenate([head, path_tail], axis=0).T, arrival
+
+
+def reserve_path(node_res: jnp.ndarray, edge_res: jnp.ndarray,
+                 path: jnp.ndarray, arrival: jnp.ndarray,
+                 width: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Insert one agent's path into the reservation tables (what the
+    reference's caller would do between sequential ``astar_with_reservation``
+    calls): node-reserve ``path[t]`` for every t up to the horizon (the agent
+    keeps occupying its goal — per the reference's blocking model a parked
+    agent is a permanent node reservation), and edge-reserve each traversal
+    arriving at time t.
+
+    Args:
+      node_res/edge_res: tables as in :func:`reserved_astar`.
+      path: (T+1,) int32 flat cells for absolute times 0..T.
+      arrival: () int32 — ignored beyond documentation; the whole row is
+        reserved since the path already holds start/goal outside the motion.
+      width: grid width (direction decoding).
+    """
+    horizon = node_res.shape[0] - 1
+    t = jnp.arange(horizon + 1)
+    node_res = node_res.at[t, path].set(True)
+    move = path[1:] - path[:-1]
+    # map the signed flat delta to a direction code; STAY contributes no edge
+    codes = jnp.full(horizon, DIR_STAY, jnp.int32)
+    for d, (dx, dy) in enumerate(DIR_DXDY):
+        codes = jnp.where(move == dy * width + dx, d, codes)
+    valid = codes != DIR_STAY
+    edge_res = edge_res.at[
+        jnp.where(valid, t[1:], 0),
+        jnp.where(valid, path[:-1], 0),
+        jnp.where(valid, codes, 0)].max(valid)
+    return node_res, edge_res
+
+
+def plan_prioritized(free: jnp.ndarray, starts: jnp.ndarray,
+                     goals: jnp.ndarray, horizon: int):
+    """Sequential prioritized planning on top of the batched primitive:
+    plan agents in index order, each reserving its path for the next — the
+    workflow ``astar_with_reservation``'s signature exists to serve.  Returns
+    ``(paths (B, T+1), arrival (B,))``; an agent that cannot reach its goal
+    under the accumulated reservations gets arrival ``-1`` and parks on its
+    start (which stays reserved).
+
+    This is a host-side loop (one compiled single-agent solve per agent) —
+    a debugging/validation tool, not the production path; the production
+    solver is the reservation-free TSWAP core (solver/step.py).
+    """
+    h, w = free.shape
+    node_res, edge_res = empty_reservations(horizon, h * w)
+    paths, arrivals = [], []
+    for i in range(int(starts.shape[0])):
+        p, a = reserved_astar(free, starts[i:i + 1], goals[i:i + 1],
+                              node_res, edge_res)
+        path = jnp.where(a[0] >= 0, p[0],
+                         jnp.full_like(p[0], starts[i]))
+        node_res, edge_res = reserve_path(node_res, edge_res, path, a[0], w)
+        paths.append(path)
+        arrivals.append(a[0])
+    return jnp.stack(paths), jnp.stack(arrivals)

@@ -1,0 +1,238 @@
+"""Golden tests for ops.reserved_astar against a numpy transcription of the
+reference's ``astar_with_reservation`` (src/algorithm/a_star.rs:32-112)."""
+
+import heapq
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.ops.distance import (DIR_DXDY, distance_fields)
+from p2p_distributed_tswap_tpu.ops.reserved_astar import (
+    empty_reservations, plan_prioritized, reserve_path, reserved_astar)
+
+DIRS5 = list(DIR_DXDY) + [(0, 0)]
+
+
+def np_astar(free, start, goal, node_res, edge_res, start_time, horizon):
+    """Reference-faithful A*: heap on (f, g), WAIT moves, the four blocking
+    rules of a_star.rs:80-96 (including the source-cell node check), bounded
+    by ``horizon`` (the dense tables' extent).  Cells are flat indices;
+    ``node_res`` is a set of (cell, t); ``edge_res`` a set of ((a, b), t).
+    Returns the arrival time or -1."""
+    h, w = free.shape
+    man = lambda c: abs(c % w - goal % w) + abs(c // w - goal // w)
+    open_ = [(start_time + man(start), start_time, start)]
+    g_score = {(start, start_time): start_time}
+    while open_:
+        f, g, pos = heapq.heappop(open_)
+        if pos == goal:
+            return g
+        if g >= horizon:
+            continue
+        x, y = pos % w, pos // w
+        for dx, dy in DIRS5:
+            nx, ny = x + dx, y + dy
+            if not (0 <= nx < w and 0 <= ny < h):
+                continue
+            np_ = ny * w + nx
+            if not free[ny, nx]:
+                continue
+            nt = g + 1
+            if (np_, nt) in node_res:
+                continue
+            if ((pos, np_), nt) in edge_res or ((np_, pos), nt) in edge_res:
+                continue
+            if (pos, nt) in node_res:          # the a_star.rs:90 source arm
+                continue
+            if g_score.get((np_, nt), 1 << 30) > nt:
+                g_score[(np_, nt)] = nt
+                heapq.heappush(open_, (nt + man(np_), nt, np_))
+    return -1
+
+
+def dense_tables(horizon, hw, node_pairs, edge_triples, w):
+    """Build dense (T+1, HW) / (T+1, HW, 4) tables from sparse tuples.
+    ``edge_triples`` are (cell_from, cell_to, t) — one direction only, like
+    inserting one tuple into the reference's EdgeReservation."""
+    node = np.zeros((horizon + 1, hw), bool)
+    for c, t in node_pairs:
+        node[t, c] = True
+    edge = np.zeros((horizon + 1, hw, 4), bool)
+    for a, b, t in edge_triples:
+        d = next(i for i, (dx, dy) in enumerate(DIR_DXDY)
+                 if b - a == dy * w + dx)
+        edge[t, a, d] = True
+    return jnp.asarray(node), jnp.asarray(edge)
+
+
+def check_path_valid(free, path, arrival, start, goal, node_set, edge_set,
+                     start_time, w):
+    """Path obeys grid adjacency, holds start before start_time and goal
+    after arrival, and violates no reservation rule along the way."""
+    path = np.asarray(path)
+    assert all(path[t] == start for t in range(start_time + 1))
+    if arrival < 0:
+        return
+    assert path[arrival] == goal
+    assert all(path[t] == goal for t in range(arrival, len(path)))
+    for t in range(start_time, arrival):
+        a, b = int(path[t]), int(path[t + 1])
+        delta = (b % w - a % w, b // w - a // w)
+        assert delta in DIRS5
+        assert free[b // w, b % w]
+        nt = t + 1
+        assert (b, nt) not in node_set
+        assert (a, nt) not in node_set
+        assert ((a, b), nt) not in edge_set and ((b, a), nt) not in edge_set
+
+
+class TestUnreserved:
+    def test_matches_bfs_distance_on_obstacles(self):
+        grid = Grid.random_obstacles(16, 16, 0.25, seed=3)
+        free = np.asarray(grid.free)
+        rng = np.random.default_rng(0)
+        cells = np.flatnonzero(free.reshape(-1))
+        starts = rng.choice(cells, 12, replace=False).astype(np.int32)
+        goals = rng.choice(cells, 12, replace=False).astype(np.int32)
+        horizon = 80
+        node, edge = empty_reservations(horizon, 256)
+        paths, arr = reserved_astar(jnp.asarray(free), jnp.asarray(starts),
+                                    jnp.asarray(goals), node, edge)
+        dists = np.asarray(distance_fields(jnp.asarray(free),
+                                           jnp.asarray(goals))).reshape(12, -1)
+        for i in range(12):
+            d = dists[i, starts[i]]
+            expect = -1 if d >= (1 << 30) or d > horizon else d
+            assert int(arr[i]) == expect
+            check_path_valid(free, paths[i], int(arr[i]), starts[i], goals[i],
+                             set(), set(), 0, 16)
+
+    def test_start_equals_goal(self):
+        free = np.ones((4, 4), bool)
+        node, edge = empty_reservations(5, 16)
+        paths, arr = reserved_astar(jnp.asarray(free), jnp.asarray([5]),
+                                    jnp.asarray([5]), node, edge)
+        assert int(arr[0]) == 0 and np.all(np.asarray(paths[0]) == 5)
+
+    def test_unreachable_is_minus_one(self):
+        g = Grid.from_ascii(".@.\n.@.\n.@.")
+        node, edge = empty_reservations(10, 9)
+        _, arr = reserved_astar(jnp.asarray(np.asarray(g.free)),
+                                jnp.asarray([0]), jnp.asarray([2]), node, edge)
+        assert int(arr[0]) == -1
+
+
+class TestReservations:
+    def test_node_reservation_forces_wait(self):
+        # corridor 1x5, cell 2 reserved at t=2: direct arrival there is t=2,
+        # so the agent waits once and arrives at the goal at t=5 instead of 4.
+        free = np.ones((1, 5), bool)
+        node, edge = dense_tables(10, 5, [(2, 2)], [], 5)
+        paths, arr = reserved_astar(jnp.asarray(free), jnp.asarray([0]),
+                                    jnp.asarray([4]), node, edge)
+        assert int(arr[0]) == 5
+        check_path_valid(free, paths[0], 5, 0, 4, {(2, 2)}, set(), 0, 5)
+
+    def test_source_cell_quirk_blocks_departure(self):
+        # a_star.rs:90: you may not *leave* a cell that is node-reserved at
+        # the arrival time.  Reserve the START at t=1: every first move
+        # (including WAIT) is blocked, so a 1-step trip takes... the agent is
+        # stuck at t=1 entirely — no (pos, 1) state is reachable — and the
+        # wavefront restarts from nothing: unreachable.
+        free = np.ones((1, 3), bool)
+        node, edge = dense_tables(6, 3, [(0, 1)], [], 3)
+        _, arr = reserved_astar(jnp.asarray(free), jnp.asarray([0]),
+                                jnp.asarray([1]), node, edge)
+        assert int(arr[0]) == -1
+        # sanity: the numpy reference model agrees
+        assert np_astar(free, 0, 1, {(0, 1)}, set(), 0, 6) == -1
+
+    def test_edge_reservation_blocks_both_directions(self):
+        free = np.ones((1, 3), bool)
+        for a, b in [(0, 1), (1, 0)]:  # reserve either direction of 0<->1 @t=1
+            node, edge = dense_tables(6, 3, [], [(a, b, 1)], 3)
+            paths, arr = reserved_astar(jnp.asarray(free), jnp.asarray([0]),
+                                        jnp.asarray([2]), node, edge)
+            # direct would cross 0->1 at t=1; must wait once: arrive t=3
+            assert int(arr[0]) == 3
+            assert int(paths[0][1]) == 0  # waited
+
+    def test_start_time_offset(self):
+        free = np.ones((1, 4), bool)
+        node, edge = empty_reservations(8, 4)
+        paths, arr = reserved_astar(jnp.asarray(free), jnp.asarray([0]),
+                                    jnp.asarray([3]), node, edge, start_time=2)
+        assert int(arr[0]) == 5
+        assert np.all(np.asarray(paths[0][:3]) == 0)
+
+
+class TestGoldenFuzz:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_matches_reference_astar(self, seed):
+        rng = np.random.default_rng(seed)
+        grid = Grid.random_obstacles(10, 10, 0.2, seed=seed)
+        free = np.asarray(grid.free)
+        cells = np.flatnonzero(free.reshape(-1))
+        horizon, w = 60, 10
+        nb = 8
+        starts = rng.choice(cells, nb, replace=False).astype(np.int32)
+        goals = rng.choice(cells, nb, replace=False).astype(np.int32)
+        # random sparse reservations (shared by the batch, like the ref's)
+        node_pairs = [(int(rng.choice(cells)), int(rng.integers(1, 25)))
+                      for _ in range(15)]
+        edge_triples = []
+        for _ in range(10):
+            a = int(rng.choice(cells))
+            for d, (dx, dy) in enumerate(DIR_DXDY):
+                b = a + dy * w + dx
+                x, y = a % w + dx, a // w + dy
+                if 0 <= x < w and 0 <= y < 10 and free[y, x]:
+                    edge_triples.append((a, b, int(rng.integers(1, 25))))
+                    break
+        node, edge = dense_tables(horizon, 100, node_pairs, edge_triples, w)
+        paths, arr = reserved_astar(jnp.asarray(free), jnp.asarray(starts),
+                                    jnp.asarray(goals), node, edge)
+        node_set = set(node_pairs)
+        edge_set = {((a, b), t) for a, b, t in edge_triples}
+        for i in range(nb):
+            expect = np_astar(free, int(starts[i]), int(goals[i]),
+                              node_set, edge_set, 0, horizon)
+            assert int(arr[i]) == expect, f"agent {i}"
+            check_path_valid(free, paths[i], int(arr[i]), int(starts[i]),
+                             int(goals[i]), node_set, edge_set, 0, w)
+
+
+class TestPrioritized:
+    def test_plans_are_mutually_collision_free(self):
+        grid = Grid.random_obstacles(12, 12, 0.15, seed=7)
+        free = np.asarray(grid.free)
+        rng = np.random.default_rng(1)
+        cells = np.flatnonzero(free.reshape(-1))
+        nb = 6
+        starts = rng.choice(cells, nb, replace=False).astype(np.int32)
+        goals = rng.choice(cells, nb, replace=False).astype(np.int32)
+        paths, arr = plan_prioritized(jnp.asarray(free), jnp.asarray(starts),
+                                      jnp.asarray(goals), horizon=80)
+        paths = np.asarray(paths)
+        assert np.all(np.asarray(arr) >= 0)  # sparse enough to all succeed
+        for t in range(paths.shape[1]):
+            assert len(np.unique(paths[:, t])) == nb  # no vertex conflict
+        for t in range(paths.shape[1] - 1):
+            for i in range(nb):
+                for j in range(i + 1, nb):  # no swap (edge) conflict
+                    assert not (paths[i, t] == paths[j, t + 1]
+                                and paths[j, t] == paths[i, t + 1])
+
+    def test_reserve_path_roundtrip_blocks_reuse(self):
+        free = np.ones((1, 5), bool)
+        node, edge = empty_reservations(10, 5)
+        p, a = reserved_astar(jnp.asarray(free), jnp.asarray([0]),
+                              jnp.asarray([4]), node, edge)
+        node, edge = reserve_path(node, edge, p[0], a[0], 5)
+        # same trip again: every cell of the corridor is now permanently
+        # node-reserved (the first agent parks on its goal), so no path.
+        _, a2 = reserved_astar(jnp.asarray(free), jnp.asarray([0]),
+                               jnp.asarray([4]), node, edge)
+        assert int(a2[0]) == -1
