@@ -85,13 +85,19 @@ def bench_full_solve(scn, seed: int = 0):
 
 
 def bench_step_window(scn, seed: int = 0):
-    """Steady-state per-step time: a compiled WARMUP_STEPS program (absorbs
-    the initial replan burst), then a timed compiled MEASURE_STEPS program.
-    Path recording off — pure throughput (BASELINE.md measures step time).
+    """Steady-state per-step time: one jitted ``mapd_step`` dispatched from a
+    Python loop; WARMUP_STEPS absorb compilation and the initial
+    field-computation burst, then MEASURE_STEPS are timed individually and
+    averaged.  Path recording off — pure throughput (BASELINE.md measures
+    step time).
 
-    NB: constant-bound lax.while_loop over the step body; k is a static
-    argument.  Buffer donation and dynamic loop bounds both trip axon
-    backend errors, so neither is used."""
+    Why per-step dispatch, not one fused K-step program: through the axon
+    tunnel, fused multi-step programs at the big rungs hit a data-dependent
+    backend kernel fault once replan traffic ramps (k<=4 fine, k=8 faults at
+    FLAGSHIP, same data), and buffer donation raises INVALID_ARGUMENT — so
+    the state crosses the jit boundary undonated each step (two field
+    buffers resident: 2 x 4.9 GB at FLAGSHIP, fits a 16 GB chip) and
+    dispatch overhead (~1 ms) is accepted in the reported number."""
     import dataclasses
 
     import jax
@@ -101,30 +107,25 @@ def bench_step_window(scn, seed: int = 0):
 
     grid, starts, tasks, cfg = scn.build(seed=seed)
     cfg = dataclasses.replace(cfg, record_paths=False)
+    starts_j = jnp.asarray(starts, jnp.int32)
     tasks_j = jnp.asarray(tasks, jnp.int32)
     free_j = jnp.asarray(grid.free)
 
-    @functools.partial(jax.jit, static_argnums=1)
-    def run_k(s, k):
-        def body(c):
-            s, i = c
-            return mapd.mapd_step(cfg, s, tasks_j, free_j), i + 1
-
-        return jax.lax.while_loop(lambda c: c[1] < k, body,
-                                  (s, jnp.int32(0)))[0]
-
-    s = mapd.init_state(cfg, jnp.asarray(starts, jnp.int32), tasks.shape[0])
-    s = run_k(s, WARMUP_STEPS)
-    jax.block_until_ready(s)
-    run_k(s, MEASURE_STEPS)  # compile the measured program off the clock
+    step = jax.jit(functools.partial(mapd.mapd_step, cfg))
+    # initial assignment + wide-chunk field burst, off the clock
+    s, tasks_j = jax.jit(functools.partial(mapd.prepare_state, cfg))(
+        starts_j, tasks_j, free_j)
+    for _ in range(WARMUP_STEPS):
+        s = step(s, tasks_j, free_j)
+    int(s.t)  # force: block_until_ready does not reliably block on axon
     t0 = time.perf_counter()
-    s = run_k(s, MEASURE_STEPS)
-    jax.block_until_ready(s)
+    for _ in range(MEASURE_STEPS):
+        s = step(s, tasks_j, free_j)
+    int(s.t)
     elapsed = time.perf_counter() - t0
     makespan = None
     if os.environ.get("BENCH_FULL") == "1":
-        final = mapd._run_mapd_jit(
-            cfg, jnp.asarray(starts, jnp.int32), tasks_j, free_j)
+        final = mapd._run_mapd_jit(cfg, starts_j, tasks_j, free_j)
         jax.block_until_ready(final)
         makespan = int(final.t)
     return 1000.0 * elapsed / MEASURE_STEPS, makespan

@@ -22,6 +22,7 @@
 #include "../common/bus.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
+#include "../common/knobs.hpp"
 
 using namespace mapd;
 
@@ -31,17 +32,18 @@ void handle_stop(int) { g_stop = 1; }
 }  // namespace
 
 int main(int argc, char** argv) {
-  uint16_t port = 7400;
-  std::string map_file;
-  uint64_t seed = std::random_device{}();
-  for (int i = 1; i < argc; ++i) {
-    if (!strcmp(argv[i], "--port") && i + 1 < argc)
-      port = static_cast<uint16_t>(atoi(argv[++i]));
-    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
-      map_file = argv[++i];
-    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
-      seed = strtoull(argv[++i], nullptr, 10);
-  }
+  Knobs knobs(argc, argv);
+  const std::string bus_host = knobs.get_str("--host", "MAPD_BUS_HOST",
+                                             "127.0.0.1");
+  const uint16_t port = static_cast<uint16_t>(
+      knobs.get_int("--port", "MAPD_BUS_PORT", 7400));
+  const std::string map_file = knobs.get_str("--map", "MAPD_MAP", "");
+  const uint64_t seed = static_cast<uint64_t>(knobs.get_int(
+      "--seed", "MAPD_SEED",
+      static_cast<int64_t>(std::random_device{}())));
+  // >=1 s position heartbeat (ref :285-291), settable like every knob.
+  const int64_t heartbeat_ms =
+      knobs.get_int("--heartbeat-ms", "MAPD_HEARTBEAT_MS", 1000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -59,7 +61,7 @@ int main(int argc, char** argv) {
 
   BusClient bus;
   std::string my_id = random_peer_id();
-  if (!bus.connect("127.0.0.1", port, my_id)) {
+  if (!bus.connect(bus_host, port, my_id)) {
     fprintf(stderr, "cannot connect to bus on port %u\n", port);
     return 1;
   }
@@ -153,7 +155,7 @@ int main(int argc, char** argv) {
     });
     if (!alive) break;
 
-    if (mono_ms() - last_broadcast >= 1000) {  // >=1 s heartbeat (ref :285-291)
+    if (mono_ms() - last_broadcast >= heartbeat_ms) {  // ref :285-291
       broadcast_position();
       last_broadcast = mono_ms();
     }

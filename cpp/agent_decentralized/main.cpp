@@ -28,17 +28,12 @@
 #include "../common/bus.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
+#include "../common/knobs.hpp"
 #include "../common/tswap.hpp"
 
 using namespace mapd;
 
 namespace {
-
-constexpr int64_t kTickMs = 500;          // decision cadence (ref :730)
-constexpr int64_t kNeighborTtlMs = 10000; // cache age-out (ref :156-167)
-constexpr size_t kMaxPositions = 60;      // bounded caches (ref :800-804)
-constexpr size_t kMaxRequests = 50;
-constexpr int64_t kSwapTimeoutMs = 2000;  // pending swap/rotation retry window
 
 volatile sig_atomic_t g_stop = 0;
 void handle_stop(int) { g_stop = 1; }
@@ -50,10 +45,17 @@ struct NearbyEntry {
 };
 
 struct Args {
+  std::string host = "127.0.0.1";
   uint16_t port = 7400;
   std::string map_file;
-  int radius = 15;  // TSWAP_RADIUS (ref :796-801)
+  int radius = 15;            // TSWAP_RADIUS (ref :796-801)
   uint64_t seed = 0;
+  // RuntimeConfig knobs, reference-parity defaults (core/config.py).
+  int64_t tick_ms = 500;           // decision cadence (ref :730)
+  int64_t neighbor_ttl_ms = 10000; // cache age-out (ref :156-167)
+  size_t max_positions = 60;       // bounded caches (ref :800-804)
+  size_t max_requests = 50;
+  int64_t swap_timeout_ms = 2000;  // pending swap/rotation retry window
 };
 
 Json point_json(const Grid& grid, Cell c) {
@@ -75,18 +77,32 @@ std::optional<Cell> parse_point(const Grid& grid, const Json& j) {
 }  // namespace
 
 int main(int argc, char** argv) {
+  Knobs knobs(argc, argv);
   Args args;
-  args.seed = std::random_device{}();
-  for (int i = 1; i < argc; ++i) {
-    if (!strcmp(argv[i], "--port") && i + 1 < argc)
-      args.port = static_cast<uint16_t>(atoi(argv[++i]));
-    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
-      args.map_file = argv[++i];
-    else if (!strcmp(argv[i], "--radius") && i + 1 < argc)
-      args.radius = atoi(argv[++i]);
-    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
-      args.seed = strtoull(argv[++i], nullptr, 10);
-  }
+  args.host = knobs.get_str("--host", "MAPD_BUS_HOST", "127.0.0.1");
+  args.port = static_cast<uint16_t>(
+      knobs.get_int("--port", "MAPD_BUS_PORT", 7400));
+  args.map_file = knobs.get_str("--map", "MAPD_MAP", "");
+  args.radius = static_cast<int>(
+      knobs.get_int("--radius", "MAPD_VISIBILITY_RADIUS", 15));
+  args.seed = static_cast<uint64_t>(knobs.get_int(
+      "--seed", "MAPD_SEED",
+      static_cast<int64_t>(std::random_device{}())));
+  args.tick_ms =
+      knobs.get_int("--decision-interval-ms", "MAPD_DECISION_INTERVAL_MS",
+                    args.tick_ms);
+  args.neighbor_ttl_ms =
+      knobs.get_int("--neighbor-ttl-ms", "MAPD_NEIGHBOR_TTL_MS",
+                    args.neighbor_ttl_ms);
+  args.max_positions = static_cast<size_t>(
+      knobs.get_int("--max-cached-positions", "MAPD_MAX_CACHED_POSITIONS",
+                    static_cast<int64_t>(args.max_positions)));
+  args.max_requests = static_cast<size_t>(
+      knobs.get_int("--max-cached-requests", "MAPD_MAX_CACHED_REQUESTS",
+                    static_cast<int64_t>(args.max_requests)));
+  args.swap_timeout_ms =
+      knobs.get_int("--swap-timeout-ms", "MAPD_SWAP_TIMEOUT_MS",
+                    args.swap_timeout_ms);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -105,7 +121,7 @@ int main(int argc, char** argv) {
 
   BusClient bus;
   std::string my_id = random_peer_id();
-  if (!bus.connect("127.0.0.1", args.port, my_id)) {
+  if (!bus.connect(args.host, args.port, my_id)) {
     fprintf(stderr, "cannot connect to bus on port %u\n", args.port);
     return 1;
   }
@@ -216,7 +232,7 @@ int main(int argc, char** argv) {
     pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
     int64_t now = mono_ms();
     int timeout = static_cast<int>(
-        std::max<int64_t>(0, last_tick + kTickMs - now));
+        std::max<int64_t>(0, last_tick + args.tick_ms - now));
     poll(&pfd, 1, std::min(timeout, 100));
 
     bool alive = bus.pump([&](const BusClient::Msg& m) {
@@ -321,25 +337,25 @@ int main(int argc, char** argv) {
     if (!alive) break;
 
     now = mono_ms();
-    if (now - last_tick < kTickMs) continue;
+    if (now - last_tick < args.tick_ms) continue;
     last_tick = now;
 
     // ---- cache hygiene (ref :792-836) ----
     for (auto it = nearby.begin(); it != nearby.end();) {
-      bool stale = now - it->second.last_seen_ms > kNeighborTtlMs;
+      bool stale = now - it->second.last_seen_ms > args.neighbor_ttl_ms;
       bool out_of_range =
           grid.manhattan(it->second.pos, my_pos) > 2 * args.radius;
       it = (stale || out_of_range) ? nearby.erase(it) : std::next(it);
     }
-    while (nearby.size() > kMaxPositions) nearby.erase(nearby.begin());
+    while (nearby.size() > args.max_positions) nearby.erase(nearby.begin());
     for (auto it = pending_requests.begin(); it != pending_requests.end();)
-      it = (now - it->second > kSwapTimeoutMs) ? pending_requests.erase(it)
+      it = (now - it->second > args.swap_timeout_ms) ? pending_requests.erase(it)
                                                : std::next(it);
-    while (pending_requests.size() > kMaxRequests)
+    while (pending_requests.size() > args.max_requests)
       pending_requests.erase(pending_requests.begin());
-    if (pending_goal_swap && now - pending_goal_swap->second > kSwapTimeoutMs)
+    if (pending_goal_swap && now - pending_goal_swap->second > args.swap_timeout_ms)
       pending_goal_swap.reset();
-    if (pending_rotation && now - pending_rotation->second > kSwapTimeoutMs)
+    if (pending_rotation && now - pending_rotation->second > args.swap_timeout_ms)
       pending_rotation.reset();
 
     publish_position();

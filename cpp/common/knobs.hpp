@@ -1,0 +1,74 @@
+// Unified runtime-knob lookup (SURVEY §5 "real config system").
+//
+// The reference scatters its knobs across compile-time constants, one CLI
+// flag and two env vars (SURVEY §5; e.g. planning interval 500 ms hardcoded
+// at src/bin/centralized/manager.rs:567, TSWAP_RADIUS=15 duplicated at
+// src/bin/decentralized/agent.rs:796,801).  Here every knob of the Python
+// ``RuntimeConfig`` (p2p_distributed_tswap_tpu/core/config.py) is settable
+// end-to-end on each binary, with one precedence rule:
+//
+//   CLI flag  (--planning-interval-ms 400  or  --planning-interval-ms=400)
+//   beats env (MAPD_PLANNING_INTERVAL_MS=400)
+//   beats the reference-parity default.
+//
+// ``runtime/fleet.py`` passes a RuntimeConfig through as env vars so one
+// Python dataclass configures a whole fleet.
+
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+namespace mapd {
+
+class Knobs {
+ public:
+  Knobs(int argc, char** argv) : argc_(argc), argv_(argv) {}
+
+  // "--flag value" / "--flag=value", else $env, else def.
+  std::string get_str(const char* flag, const char* env,
+                      const std::string& def) const {
+    size_t flen = strlen(flag);
+    for (int i = 1; i < argc_; ++i) {
+      if (!strcmp(argv_[i], flag) && i + 1 < argc_) return argv_[i + 1];
+      if (!strncmp(argv_[i], flag, flen) && argv_[i][flen] == '=')
+        return argv_[i] + flen + 1;
+    }
+    if (env && *env)
+      if (const char* v = getenv(env)) return v;
+    return def;
+  }
+
+  int64_t get_int(const char* flag, const char* env, int64_t def) const {
+    std::string s = get_str(flag, env, "");
+    if (s.empty()) return def;
+    char* end = nullptr;
+    int64_t v = strtoll(s.c_str(), &end, 10);
+    if (end == s.c_str() || *end != '\0') {
+      // unparsable value: keep the documented default rather than a silent 0
+      fprintf(stderr, "knobs: ignoring non-numeric value \"%s\" for %s\n",
+              s.c_str(), flag);
+      return def;
+    }
+    return v;
+  }
+
+  // Bare boolean flag (--clean); env counts as true unless "0"/"false"/"".
+  bool get_bool(const char* flag, const char* env) const {
+    for (int i = 1; i < argc_; ++i)
+      if (!strcmp(argv_[i], flag)) return true;
+    if (env && *env)
+      if (const char* v = getenv(env))
+        return *v && strcmp(v, "0") && strcmp(v, "false");
+    return false;
+  }
+
+ private:
+  int argc_;
+  char** argv_;
+};
+
+}  // namespace mapd

@@ -39,16 +39,12 @@
 #include "../common/bus.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
+#include "../common/knobs.hpp"
 #include "../common/tswap.hpp"
 
 using namespace mapd;
 
 namespace {
-
-constexpr int64_t kPlanningMs = 500;   // ref :567
-constexpr int64_t kCleanupMs = 30000;  // ref :727
-constexpr size_t kMaxAgents = 500;     // ref :734
-constexpr size_t kMaxPeers = 1000;     // ref :752
 
 volatile sig_atomic_t g_stop = 0;
 void handle_stop(int) { g_stop = 1; }
@@ -66,24 +62,32 @@ struct AgentInfo {
 }  // namespace
 
 int main(int argc, char** argv) {
-  uint16_t port = 7400;
-  std::string map_file, solver = "cpu";
-  uint64_t seed = std::random_device{}();
-  bool clean = false;
-  for (int i = 1; i < argc; ++i) {
-    if (!strcmp(argv[i], "--port") && i + 1 < argc)
-      port = static_cast<uint16_t>(atoi(argv[++i]));
-    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
-      map_file = argv[++i];
-    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
-      seed = strtoull(argv[++i], nullptr, 10);
-    else if (!strcmp(argv[i], "--clean"))
-      clean = true;
-    else if (!strcmp(argv[i], "--solver") && i + 1 < argc)
-      solver = argv[++i];
-    else if (!strncmp(argv[i], "--solver=", 9))
-      solver = argv[i] + 9;
-  }
+  Knobs knobs(argc, argv);
+  const std::string bus_host = knobs.get_str("--host", "MAPD_BUS_HOST",
+                                             "127.0.0.1");
+  const uint16_t port = static_cast<uint16_t>(
+      knobs.get_int("--port", "MAPD_BUS_PORT", 7400));
+  const std::string map_file = knobs.get_str("--map", "MAPD_MAP", "");
+  const std::string solver = knobs.get_str("--solver", "MAPD_SOLVER", "cpu");
+  const bool clean = knobs.get_bool("--clean", "MAPD_CLEAN");
+  const uint64_t seed = static_cast<uint64_t>(knobs.get_int(
+      "--seed", "MAPD_SEED",
+      static_cast<int64_t>(std::random_device{}())));
+  // RuntimeConfig knobs, reference-parity defaults (core/config.py).
+  const int64_t planning_ms =
+      knobs.get_int("--planning-interval-ms", "MAPD_PLANNING_INTERVAL_MS",
+                    500);                                      // ref :567
+  const int64_t cleanup_ms =
+      knobs.get_int("--cleanup-interval-ms", "MAPD_CLEANUP_INTERVAL_MS",
+                    30000);                                    // ref :727
+  const size_t max_agents = static_cast<size_t>(
+      knobs.get_int("--max-tracked-agents", "MAPD_MAX_TRACKED_AGENTS",
+                    500));                                     // ref :734
+  const size_t max_known_peers = static_cast<size_t>(
+      knobs.get_int("--max-known-peers", "MAPD_MAX_KNOWN_PEERS",
+                    1000));                                    // ref :752
+  const int64_t agent_stale_ms =
+      knobs.get_int("--agent-stale-ms", "MAPD_AGENT_STALE_MS", 60000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -102,7 +106,7 @@ int main(int argc, char** argv) {
 
   BusClient bus;
   std::string my_id = random_peer_id();
-  if (!bus.connect("127.0.0.1", port, my_id)) {
+  if (!bus.connect(bus_host, port, my_id)) {
     fprintf(stderr, "cannot connect to bus on port %u\n", port);
     return 1;
   }
@@ -167,6 +171,20 @@ int main(int argc, char** argv) {
     bus.publish("mapd", task);
     printf("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
            peer.c_str());
+  };
+
+  // Push an agent's in-flight task back onto the pending queue (front: it
+  // was already dispatched once and should not starve behind fresh tasks).
+  // Used when an agent dies (peer_left) or ages out silently — the
+  // reference loses such tasks (decentralized/manager.rs:185-189).
+  auto requeue_task = [&](const std::string& peer, const AgentInfo& a,
+                          const char* why) {
+    if (!a.task) return;
+    Json t = *a.task;
+    printf("♻️  %s %s, re-queueing task %lld\n", why, peer.c_str(),
+           static_cast<long long>(t["task_id"].as_int()));
+    t.set("peer_id", Json());
+    pending_tasks.push_front(std::move(t));
   };
 
   // drain the pending queue onto idle tracked agents (ref :367-436)
@@ -410,8 +428,11 @@ int main(int argc, char** argv) {
             }
             printf("🎉 %s finished task %lld\n", peer.c_str(),
                    static_cast<long long>(d["task_id"].as_int()));
-            // auto-reassign: fresh task on completion (ref :908-950)
-            if (it != agents.end()) assign_task(peer, make_task());
+            // auto-reassign on completion (ref :908-950): queued tasks
+            // (incl. ones re-queued from dead agents) drain before a fresh
+            // task is generated, so orphans cannot starve behind auto-refill
+            if (it != agents.end() && pending_tasks.empty())
+              assign_task(peer, make_task());
             try_assign_pending();
           }
           fflush(stdout);
@@ -420,13 +441,20 @@ int main(int argc, char** argv) {
           if (ev["op"].as_str() == "peer_left") {
             const std::string& peer = ev["peer_id"].as_str();
             known_left.insert(peer);
-            agents.erase(peer);
+            auto it = agents.find(peer);
+            if (it != agents.end()) {
+              // The task restarts from pickup on the next idle agent.
+              requeue_task(peer, it->second, "agent died:");
+              agents.erase(it);
+              try_assign_pending();
+              fflush(stdout);
+            }
           }
         });
     if (!alive) break;
 
     int64_t now = mono_ms();
-    if (now - last_plan >= kPlanningMs) {  // planning tick (ref :675-724)
+    if (now - last_plan >= planning_ms) {  // planning tick (ref :675-724)
       last_plan = now;
       pickup_transitions();
       if (!agents.empty()) {
@@ -436,14 +464,32 @@ int main(int argc, char** argv) {
           plan_native();
       }
     }
-    if (now - last_cleanup > kCleanupMs) {
+    if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;
-      for (auto it = agents.begin(); it != agents.end();)
-        it = (now - it->second.last_seen_ms > 60000) ? agents.erase(it)
-                                                     : std::next(it);
-      while (agents.size() > kMaxAgents) agents.erase(agents.begin());
-      while (known_left.size() > kMaxPeers)
+      // Stale age-out re-queues in-flight tasks just like peer_left does: a
+      // live-but-silent agent never emits peer_left, and its task must not
+      // be lost on this path either.  The cap trim below deliberately does
+      // NOT re-queue — it evicts agents that are still live and working, so
+      // re-dispatching their task would run it twice.
+      for (auto it = agents.begin(); it != agents.end();) {
+        if (now - it->second.last_seen_ms > agent_stale_ms) {
+          requeue_task(it->first, it->second, "evicting stale agent");
+          it = agents.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      while (agents.size() > max_agents) {
+        // trim the least-recently-seen live agent; its task stays with it
+        auto oldest = agents.begin();
+        for (auto it = agents.begin(); it != agents.end(); ++it)
+          if (it->second.last_seen_ms < oldest->second.last_seen_ms)
+            oldest = it;
+        agents.erase(oldest);
+      }
+      while (known_left.size() > max_known_peers)
         known_left.erase(known_left.begin());
+      try_assign_pending();
       dc.trim(512);
       printf("🧹 [CLEANUP] agents=%zu pending=%zu\n", agents.size(),
              pending_tasks.size());

@@ -28,17 +28,16 @@
 #include <string>
 #include <vector>
 
+#include <deque>
+
 #include "../common/bus.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
+#include "../common/knobs.hpp"
 
 using namespace mapd;
 
 namespace {
-
-constexpr int64_t kCleanupMs = 30000;  // ref :158-194
-constexpr size_t kMaxPeers = 200;      // ref :173
-constexpr size_t kMaxPositions = 60;
 
 volatile sig_atomic_t g_stop = 0;
 void handle_stop(int) { g_stop = 1; }
@@ -46,20 +45,27 @@ void handle_stop(int) { g_stop = 1; }
 }  // namespace
 
 int main(int argc, char** argv) {
-  uint16_t port = 7400;
-  std::string map_file;
-  uint64_t seed = std::random_device{}();
-  bool clean = false;
-  for (int i = 1; i < argc; ++i) {
-    if (!strcmp(argv[i], "--port") && i + 1 < argc)
-      port = static_cast<uint16_t>(atoi(argv[++i]));
-    else if (!strcmp(argv[i], "--map") && i + 1 < argc)
-      map_file = argv[++i];
-    else if (!strcmp(argv[i], "--seed") && i + 1 < argc)
-      seed = strtoull(argv[++i], nullptr, 10);
-    else if (!strcmp(argv[i], "--clean"))
-      clean = true;  // ignore re-discovered peers (ref --clean)
-  }
+  Knobs knobs(argc, argv);
+  const std::string bus_host = knobs.get_str("--host", "MAPD_BUS_HOST",
+                                             "127.0.0.1");
+  const uint16_t port = static_cast<uint16_t>(
+      knobs.get_int("--port", "MAPD_BUS_PORT", 7400));
+  const std::string map_file = knobs.get_str("--map", "MAPD_MAP", "");
+  // ignore re-discovered peers (ref --clean)
+  const bool clean = knobs.get_bool("--clean", "MAPD_CLEAN");
+  const uint64_t seed = static_cast<uint64_t>(knobs.get_int(
+      "--seed", "MAPD_SEED",
+      static_cast<int64_t>(std::random_device{}())));
+  // RuntimeConfig knobs, reference-parity defaults (core/config.py).
+  const int64_t cleanup_ms =
+      knobs.get_int("--cleanup-interval-ms", "MAPD_CLEANUP_INTERVAL_MS",
+                    30000);                                 // ref :158-194
+  const size_t max_peers = static_cast<size_t>(
+      knobs.get_int("--max-tracked-peers", "MAPD_MAX_TRACKED_PEERS",
+                    200));                                  // ref :173
+  const size_t max_positions = static_cast<size_t>(
+      knobs.get_int("--max-cached-positions", "MAPD_MAX_CACHED_POSITIONS",
+                    60));
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -77,7 +83,7 @@ int main(int argc, char** argv) {
 
   BusClient bus;
   std::string my_id = random_peer_id();
-  if (!bus.connect("127.0.0.1", port, my_id)) {
+  if (!bus.connect(bus_host, port, my_id)) {
     fprintf(stderr, "cannot connect to bus on port %u\n", port);
     return 1;
   }
@@ -91,7 +97,8 @@ int main(int argc, char** argv) {
   std::set<std::string> subscribed_peers;
   std::set<std::string> known_left;  // --clean: never re-add these
   std::map<std::string, Cell> peer_positions;
-  std::map<std::string, uint64_t> peer_busy;  // peer -> active task id
+  std::map<std::string, Json> peer_busy;   // peer -> active task (full JSON)
+  std::deque<Json> requeue;                // tasks orphaned by dead peers
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
@@ -99,10 +106,23 @@ int main(int argc, char** argv) {
   auto free_cells = grid.free_cells();
   auto gen_point = [&]() { return free_cells[rng() % free_cells.size()]; };
 
+  auto dispatch_task = [&](const std::string& peer, Json t) {
+    uint64_t id = static_cast<uint64_t>(t["task_id"].as_int());
+    t.set("peer_id", peer);
+    TaskMetric m;
+    m.task_id = id;
+    m.peer_id = peer;
+    m.sent_time = unix_ms();
+    task_metrics.add_metric(m);
+    peer_busy[peer] = t;
+    bus.publish("mapd", t);
+    printf("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
+           peer.c_str());
+  };
+
   auto send_task_to = [&](const std::string& peer) {
     Cell pickup = gen_point(), delivery = gen_point();
     while (delivery == pickup) delivery = gen_point();
-    uint64_t id = next_task_id++;
     Json t;  // bare Task JSON, the one shared serde struct (ref C10)
     Json pk, dl;
     pk.push_back(Json(grid.x_of(pickup)));
@@ -110,18 +130,28 @@ int main(int argc, char** argv) {
     dl.push_back(Json(grid.x_of(delivery)));
     dl.push_back(Json(grid.y_of(delivery)));
     t.set("pickup", pk).set("delivery", dl).set("peer_id", peer)
-        .set("task_id", id);
-    TaskMetric m;
-    m.task_id = id;
-    m.peer_id = peer;
-    m.sent_time = unix_ms();
-    task_metrics.add_metric(m);
-    peer_busy[peer] = id;
-    bus.publish("mapd", t);
-    printf("📤 Task %llu -> %s  pickup(%d,%d) delivery(%d,%d)\n",
-           static_cast<unsigned long long>(id), peer.c_str(),
-           grid.x_of(pickup), grid.y_of(pickup), grid.x_of(delivery),
-           grid.y_of(delivery));
+        .set("task_id", next_task_id++);
+    dispatch_task(peer, std::move(t));
+  };
+
+  // Orphaned tasks from dead peers go to the next free peer — the reference
+  // loses them (src/bin/decentralized/manager.rs:185-189, documented flaw;
+  // SURVEY §5 calls it out).  Drained on done / peer_joined / task commands.
+  auto drain_requeue = [&]() {
+    while (!requeue.empty()) {
+      std::string free_peer;
+      for (const auto& peer : subscribed_peers)
+        if (!peer_busy.count(peer)) {
+          free_peer = peer;
+          break;
+        }
+      if (free_peer.empty()) return;
+      Json t = requeue.front();
+      requeue.pop_front();
+      printf("♻️  re-dispatching orphaned task %lld\n",
+             static_cast<long long>(t["task_id"].as_int()));
+      dispatch_task(free_peer, std::move(t));
+    }
   };
 
   auto assign_round_robin = [&](size_t count) {
@@ -161,6 +191,7 @@ int main(int argc, char** argv) {
     in >> cmd;
     if (cmd == "quit" || cmd == "exit") return false;
     if (cmd == "task") {
+      drain_requeue();
       for (const auto& peer : subscribed_peers)
         if (!peer_busy.count(peer)) {
           send_task_to(peer);
@@ -170,6 +201,7 @@ int main(int argc, char** argv) {
     } else if (cmd == "tasks") {
       size_t n = 0;
       in >> n;
+      drain_requeue();
       assign_round_robin(n ? n : subscribed_peers.size());
     } else if (cmd == "metrics") {
       printf("%s\n", task_metrics.statistics().to_string().c_str());
@@ -189,6 +221,7 @@ int main(int argc, char** argv) {
       task_metrics.clear();
       path_metrics.clear();
       peer_busy.clear();
+      requeue.clear();
       printf("🔄 state reset\n");
     } else if (!cmd.empty()) {
       Json raw;  // unknown lines broadcast raw (ref :389-395)
@@ -279,7 +312,10 @@ int main(int argc, char** argv) {
             peer_busy.erase(peer);
             printf("🎉 %s finished task %lld\n", peer.c_str(),
                    static_cast<long long>(d["task_id"].as_int()));
-            if (subscribed_peers.count(peer)) send_task_to(peer);
+            if (!requeue.empty())
+              drain_requeue();  // orphans take priority over fresh tasks
+            if (!peer_busy.count(peer) && subscribed_peers.count(peer))
+              send_task_to(peer);
           }
         },
         [&](const Json& ev) {
@@ -290,12 +326,26 @@ int main(int argc, char** argv) {
             subscribed_peers.insert(peer);
             printf("🔍 peer joined: %s (%zu peers)\n", peer.c_str(),
                    subscribed_peers.size());
+            drain_requeue();
           } else if (op == "peer_left") {
             const std::string& peer = ev["peer_id"].as_str();
             known_left.insert(peer);
             subscribed_peers.erase(peer);
             peer_positions.erase(peer);
-            peer_busy.erase(peer);  // note: its task is lost, like the ref
+            auto busy = peer_busy.find(peer);
+            if (busy != peer_busy.end()) {
+              // Re-queue the dead peer's in-flight task — the reference
+              // only cleans the mapping and the task is lost
+              // (src/bin/decentralized/manager.rs:185-189, documented
+              // flaw; SURVEY §5).
+              printf("♻️  peer %s died with task %lld in flight, "
+                     "re-queueing\n", peer.c_str(),
+                     static_cast<long long>(
+                         busy->second["task_id"].as_int()));
+              requeue.push_back(std::move(busy->second));
+              peer_busy.erase(busy);
+              drain_requeue();
+            }
             printf("👋 peer left: %s\n", peer.c_str());
           } else if (op == "peers") {
             for (const auto& p : ev["peers"].as_array())
@@ -306,15 +356,15 @@ int main(int argc, char** argv) {
     if (!alive) break;
 
     int64_t now = mono_ms();
-    if (now - last_cleanup > kCleanupMs) {
+    if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;
-      while (subscribed_peers.size() > kMaxPeers)
+      while (subscribed_peers.size() > max_peers)
         subscribed_peers.erase(subscribed_peers.begin());
-      while (peer_positions.size() > kMaxPositions)
+      while (peer_positions.size() > max_positions)
         peer_positions.erase(peer_positions.begin());
-      printf("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu\n",
+      printf("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu requeue=%zu\n",
              subscribed_peers.size(), peer_positions.size(),
-             peer_busy.size());
+             peer_busy.size(), requeue.size());
       fflush(stdout);
     }
   }

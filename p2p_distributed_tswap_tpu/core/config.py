@@ -29,9 +29,13 @@ class SolverConfig:
     num_agents: int
     # Offline-solver horizon cap (ref src/algorithm/tswap.rs:167).
     max_timesteps: int = 2000
-    # Max direction-field recomputations processed per replan call; fields
-    # beyond this spill to the next call. Static so replan has fixed shapes.
+    # Max direction-field recomputations processed per replan round; rounds
+    # repeat until the dirty set drains. Static so replan has fixed shapes.
     replan_chunk: int = 64
+    # Narrow chunk used (via lax.cond) when few fields are dirty — the
+    # steady-state case of a handful of task arrivals per step; a wide
+    # round would burn a full (replan_chunk, H, W) sweep on them.
+    replan_chunk_small: int = 8
     # Rule-4 deadlock cycles are detected exactly up to this length
     # (ref walks unbounded chains, src/algorithm/tswap.rs:204-249; cycles
     # longer than this simply wait and retry next step).
@@ -64,7 +68,14 @@ class SolverConfig:
 
 @dataclasses.dataclass(frozen=True)
 class RuntimeConfig:
-    """Host-runtime knobs (C++ bus / manager / agents)."""
+    """Host-runtime knobs (C++ bus / manager / agents).
+
+    Every field maps to a ``MAPD_*`` env var (and a ``--kebab-case`` CLI
+    flag) read by the C++ binaries via cpp/common/knobs.hpp; precedence is
+    flag > env > reference-parity default.  ``runtime.fleet.Fleet`` accepts a
+    RuntimeConfig and exports it through :meth:`to_env`, so one dataclass
+    configures a whole fleet end-to-end (SURVEY §5 "real config system").
+    """
 
     # Centralized planning tick (ref 500 ms, src/bin/centralized/manager.rs:567).
     planning_interval_ms: int = 500
@@ -73,12 +84,27 @@ class RuntimeConfig:
     # Periodic state cleanup (ref 30 s, src/bin/centralized/manager.rs:727).
     cleanup_interval_ms: int = 30_000
     # Memory caps (ref manager.rs:734,752; decentralized/manager.rs:173;
-    # decentralized/agent.rs:800-804).
+    # decentralized/agent.rs:800-804).  The two peer caps are distinct
+    # knobs because they cap different things at different reference
+    # defaults: max_known_peers bounds the centralized manager's
+    # departed-peer memory (ref 1000), max_tracked_peers bounds the
+    # decentralized manager's subscribed-peer set (ref 200).
     max_tracked_agents: int = 500
-    max_tracked_peers: int = 1000
+    max_known_peers: int = 1000
+    max_tracked_peers: int = 200
     max_cached_positions: int = 60
+    max_cached_requests: int = 50
     # Neighbor-info age-out (ref 10 s, src/bin/decentralized/agent.rs:156-167).
     neighbor_ttl_ms: int = 10_000
+    # Decentralized visibility radius (ref TSWAP_RADIUS=15,
+    # src/bin/decentralized/agent.rs:796-801).
+    visibility_radius: int = 15
+    # Pending goal-swap / rotation retry window (our coordination layer).
+    swap_timeout_ms: int = 2_000
+    # Centralized agent position heartbeat (ref >=1 s, centralized/agent.rs:285-291).
+    heartbeat_ms: int = 1_000
+    # Centralized manager drops agents unseen for this long (ref 60 s).
+    agent_stale_ms: int = 60_000
     # Bus endpoint.
     bus_host: str = "127.0.0.1"
     bus_port: int = 7400
@@ -87,3 +113,32 @@ class RuntimeConfig:
     # src/bin/decentralized/manager.rs:48-50).
     task_csv_path: Optional[str] = None
     path_csv_path: Optional[str] = None
+
+    def to_env(self) -> dict:
+        """Env-var map consumed by the C++ binaries (cpp/common/knobs.hpp).
+
+        ``bus_port`` is omitted: the fleet passes it per-process as --port
+        (each test fleet picks its own free port).  ``topic`` is likewise a
+        wire-level constant ("mapd") shared with the reference protocol.
+        """
+        env = {
+            "MAPD_BUS_HOST": self.bus_host,
+            "MAPD_PLANNING_INTERVAL_MS": self.planning_interval_ms,
+            "MAPD_DECISION_INTERVAL_MS": self.decision_interval_ms,
+            "MAPD_CLEANUP_INTERVAL_MS": self.cleanup_interval_ms,
+            "MAPD_MAX_TRACKED_AGENTS": self.max_tracked_agents,
+            "MAPD_MAX_KNOWN_PEERS": self.max_known_peers,
+            "MAPD_MAX_TRACKED_PEERS": self.max_tracked_peers,
+            "MAPD_MAX_CACHED_POSITIONS": self.max_cached_positions,
+            "MAPD_MAX_CACHED_REQUESTS": self.max_cached_requests,
+            "MAPD_NEIGHBOR_TTL_MS": self.neighbor_ttl_ms,
+            "MAPD_VISIBILITY_RADIUS": self.visibility_radius,
+            "MAPD_SWAP_TIMEOUT_MS": self.swap_timeout_ms,
+            "MAPD_HEARTBEAT_MS": self.heartbeat_ms,
+            "MAPD_AGENT_STALE_MS": self.agent_stale_ms,
+        }
+        if self.task_csv_path:
+            env["TASK_CSV_PATH"] = self.task_csv_path
+        if self.path_csv_path:
+            env["PATH_CSV_PATH"] = self.path_csv_path
+        return {k: str(v) for k, v in env.items()}

@@ -47,8 +47,10 @@ MEDIUM = Scenario(
     "1k-512", lambda: Grid.random_obstacles(512, 512, 0.1, seed=0), 1000, 1000,
     replan_chunk=128)
 FLAGSHIP = Scenario(                # north-star config: 10k agents, 1024^2
+    # replan_chunk 64: transient replan memory is O(chunk * H * W) int32 and
+    # must fit beside the persistent 5.25 GB packed fields on a 16 GB chip.
     "10k-1024-warehouse", lambda: Grid.warehouse(1024, 1024), 10_000, 10_000,
-    replan_chunk=256)
+    replan_chunk=64)
 EXTREME = Scenario(                 # v5e-16 territory, agent-axis sharded
     "100k-4096", lambda: Grid.warehouse(4096, 4096), 100_000, 100_000,
     replan_chunk=512)

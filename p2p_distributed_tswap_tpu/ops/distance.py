@@ -35,23 +35,47 @@ INF = np.int32(1 << 30)
 # (dx, dy) in the reference's neighbor order; index = direction code.
 DIR_DXDY = ((0, 1), (1, 0), (0, -1), (-1, 0))
 DIR_STAY = 4
-# one byte of packed all-STAY field (both nibbles DIR_STAY); see pack_directions
-PACKED_STAY = DIR_STAY | (DIR_STAY << 4)
+# one uint32 word of packed all-STAY field (8 DIR_STAY nibbles); see
+# pack_directions
+PACKED_LANES = 8
+PACKED_STAY = sum(DIR_STAY << (4 * i) for i in range(PACKED_LANES))
 
 
 def _seg_min_scan(values: jnp.ndarray, resets: jnp.ndarray, axis: int,
                   reverse: bool) -> jnp.ndarray:
     """Segmented running minimum along ``axis``: at positions where ``resets``
-    is True the minimum restarts from that position's value."""
+    is True the minimum restarts from that position's value.
 
-    def op(a, b):
-        av, ar = a
-        bv, br = b
-        return jnp.where(br, bv, jnp.minimum(av, bv)), ar | br
-
-    out, _ = jax.lax.associative_scan(op, (values, resets), axis=axis,
-                                      reverse=reverse)
-    return out
+    Hand-rolled Hillis-Steele doubling (log2(n) rounds of roll + min/where)
+    over the associative operator ``(a, b) -> (b.reset ? b.v : min(a.v, b.v),
+    a.reset | b.reset)`` instead of ``jax.lax.associative_scan``: on the TPU
+    backend in this environment, associative_scan over tuple carries silently
+    corrupts results (and sometimes kernel-faults) once the operand exceeds
+    ~2^24 elements — e.g. every value of the 64x1024x1024 FLAGSHIP replan
+    batch came back negative, nondeterministically.  The doubling form uses
+    only roll/where/minimum and is bit-identical to the CPU associative_scan
+    reference at all sizes tested (checksum-verified at 64x1024^2)."""
+    n = values.shape[axis]
+    if reverse:
+        values = jnp.flip(values, axis)
+        resets = jnp.flip(resets, axis)
+    v, r = values, resets
+    idx_shape = [1] * values.ndim
+    idx_shape[axis] = n
+    idx = jnp.arange(n).reshape(idx_shape)
+    off = 1
+    while off < n:
+        # (value, reset) from `off` positions earlier along axis; positions
+        # without a predecessor combine with the identity (+inf, no reset).
+        valid = idx >= off
+        sv = jnp.where(valid, jnp.roll(v, off, axis), INF + n)
+        sr = jnp.where(valid, jnp.roll(r, off, axis), False)
+        v = jnp.where(r, v, jnp.minimum(v, sv))
+        r = r | sr
+        off *= 2
+    if reverse:
+        v = jnp.flip(v, axis)
+    return v
 
 
 def _sweep(d: jnp.ndarray, free: jnp.ndarray, axis: int, reverse: bool,
@@ -133,17 +157,26 @@ def directions_from_distance(dist: jnp.ndarray, free: jnp.ndarray) -> jnp.ndarra
       4 = stay (at goal, obstacle, or unreachable).
     """
     pad = [(0, 0)] * (dist.ndim - 2)
+    padded = jnp.pad(dist, pad + [(1, 1), (1, 1)], constant_values=INF)
 
     def shifted(dx, dy):
         # value of dist at (x+dx, y+dy), INF out of bounds
-        s = jnp.pad(dist, pad + [(1, 1), (1, 1)], constant_values=INF)
         return jax.lax.slice_in_dim(
-            jax.lax.slice_in_dim(s, 1 + dy, 1 + dy + dist.shape[-2], axis=-2),
+            jax.lax.slice_in_dim(padded, 1 + dy, 1 + dy + dist.shape[-2],
+                                 axis=-2),
             1 + dx, 1 + dx + dist.shape[-1], axis=-1)
 
-    neigh = jnp.stack([shifted(dx, dy) for dx, dy in DIR_DXDY], axis=0)
-    best = jnp.argmin(neigh, axis=0).astype(jnp.uint8)  # first-min tie-break
-    best_val = jnp.min(neigh, axis=0)
+    # Fold over the 4 directions (first-min tie-break preserved by the strict
+    # <) instead of stacking them: the stacked (4, ..., H, W) int32 tensor was
+    # the peak replan transient — 4 GB at the FLAGSHIP rung's former chunking,
+    # the round-2 RESOURCE_EXHAUSTED culprit.
+    best = jnp.full(dist.shape, DIR_STAY, jnp.uint8)
+    best_val = jnp.full(dist.shape, INF, jnp.int32)
+    for k, (dx, dy) in enumerate(DIR_DXDY):
+        nv = shifted(dx, dy)
+        better = nv < best_val
+        best = jnp.where(better, jnp.uint8(k), best)
+        best_val = jnp.minimum(best_val, nv)
     stay = (dist == 0) | (dist >= INF) | (best_val >= INF) | (best_val >= dist) | ~free
     return jnp.where(stay, jnp.uint8(DIR_STAY), best)
 
@@ -156,37 +189,46 @@ def direction_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
 
 
 def packed_cells(num_cells: int) -> int:
-    """Bytes per packed direction-field row (two 4-bit codes per byte)."""
-    return (num_cells + 1) // 2
+    """uint32 words per packed direction-field row (8 nibbles per word)."""
+    return (num_cells + PACKED_LANES - 1) // PACKED_LANES
 
 
 def pack_directions(fields: jnp.ndarray) -> jnp.ndarray:
     """Pack (..., HW) uint8 direction codes (values 0..4) into
-    (..., ceil(HW/2)) uint8, two codes per byte: cell ``2j`` in the low
-    nibble of byte ``j``, cell ``2j+1`` in the high nibble.  Odd trailing
-    cell pads with DIR_STAY.
+    (..., ceil(HW/8)) uint32, 8 codes per word: cell ``8j + l`` lives in
+    nibble ``l`` (bits ``4l..4l+3``) of word ``j``.  Trailing cells pad
+    with DIR_STAY.
 
     Direction fields are the framework's dominant state — O(live goals × HW)
     bytes (SURVEY §7 hard part 2) — and codes need 3 bits, so nibble packing
     halves HBM residency: the FLAGSHIP rung (10k fields × 1024²) drops from
-    10.5 GB to 5.25 GB on a 16 GB v5e chip.
+    10.5 GB to 5.25 GB on a 16 GB v5e chip.  The lane type is uint32 — not
+    uint8 — because element COUNT is its own ceiling: a (10k, 1024²/2)
+    uint8 buffer has 5.2e9 > 2^32 elements, past the backend's 32-bit
+    linear-index space (observed as TPU kernel faults at exactly that rung);
+    8 nibbles per word keeps the element count 8x under it, and 32-bit lanes
+    are the natural VPU width anyway.
     """
     hw = fields.shape[-1]
-    if hw % 2:
-        pad = [(0, 0)] * (fields.ndim - 1) + [(0, 1)]
+    if hw % PACKED_LANES:
+        pad = [(0, 0)] * (fields.ndim - 1) + [(0, -hw % PACKED_LANES)]
         fields = jnp.pad(fields, pad, constant_values=DIR_STAY)
-    lo = fields[..., 0::2].astype(jnp.uint8)
-    hi = fields[..., 1::2].astype(jnp.uint8)
-    return lo | (hi << 4)
+    lanes = fields.reshape(*fields.shape[:-1], -1, PACKED_LANES)
+    lanes = lanes.astype(jnp.uint32)
+    word = lanes[..., 0]
+    for lane in range(1, PACKED_LANES):  # disjoint nibbles: OR == sum
+        word = word | (lanes[..., lane] << (4 * lane))
+    return word
 
 
 def gather_packed(packed: jnp.ndarray, row: jnp.ndarray,
                   pos_idx: jnp.ndarray) -> jnp.ndarray:
     """Direction code at flat cell ``pos_idx`` from packed row ``row``:
-    ``unpack(packed[row, pos//2], nibble=pos%2)`` — one byte gather plus a
+    ``unpack(packed[row, pos//8], nibble=pos%8)`` — one word gather plus a
     shift/mask per agent."""
-    byte = packed[row, pos_idx >> 1].astype(jnp.int32)
-    return ((byte >> ((pos_idx & 1) * 4)) & 0xF).astype(jnp.uint8)
+    word = packed[row, pos_idx >> 3]
+    nib = ((pos_idx & 7) * 4).astype(jnp.uint32)
+    return ((word >> nib) & 0xF).astype(jnp.uint8)
 
 
 def apply_direction(pos_idx: jnp.ndarray, dir_code: jnp.ndarray,

@@ -109,6 +109,17 @@ def reserved_astar(free: jnp.ndarray, starts: jnp.ndarray, goals: jnp.ndarray,
     nsteps = horizon - start_time
     b = starts.shape[0]
 
+    if nsteps <= 0:
+        # Degenerate horizon: no move can be searched.  Agents already on
+        # their goal are trivially done (arrival = start_time, ref :53 pop);
+        # everyone else is unreachable within the table.  Shapes stay
+        # (B, horizon+1) like the searched case.
+        trivially_done = starts == goals
+        arrival = jnp.where(trivially_done, jnp.int32(start_time),
+                            jnp.int32(-1))
+        paths = jnp.broadcast_to(starts[:, None], (b, horizon + 1))
+        return paths, arrival
+
     node_g = node_res.reshape(horizon + 1, h, w)
     edge_g = edge_res.reshape(horizon + 1, h, w, 4)
 

@@ -21,6 +21,8 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
+from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
+
 REPO_ROOT = Path(__file__).resolve().parents[2]
 BUILD_DIR = REPO_ROOT / "cpp" / "build"
 
@@ -42,7 +44,8 @@ class Fleet:
     def __init__(self, mode: str = "decentralized", num_agents: int = 3,
                  port: int = 7450, map_file: Optional[str] = None,
                  solver: str = "cpu", log_dir: Optional[str] = None,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 config: Optional[RuntimeConfig] = None):
         assert mode in ("centralized", "decentralized")
         build = ensure_built()
         self.procs: List[subprocess.Popen] = []
@@ -50,6 +53,10 @@ class Fleet:
         if self.log_dir:
             self.log_dir.mkdir(parents=True, exist_ok=True)
         penv = dict(os.environ)
+        if config is not None:
+            # one RuntimeConfig configures every binary in the fleet
+            # (MAPD_* env knobs, cpp/common/knobs.hpp)
+            penv.update(config.to_env())
         if env:
             penv.update(env)
         self._logs: List = []

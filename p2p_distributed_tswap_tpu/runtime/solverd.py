@@ -57,7 +57,7 @@ class PlanService:
         self.max_fields = field_cache
         # goal cell -> row index into the dirs buffer
         self.goal_rows: "OrderedDict[int, int]" = OrderedDict()
-        self.dirs: jnp.ndarray | None = None  # (rows, ceil(HW/2)) packed uint8
+        self.dirs: jnp.ndarray | None = None  # (rows, ceil(HW/8)) packed uint32
         self._step = functools.partial(jax.jit, static_argnums=0)(step_parallel)
 
     def _capacity(self, n: int) -> int:
@@ -71,7 +71,7 @@ class PlanService:
         pc = packed_cells(self.grid.num_cells)
         if self.dirs is None:
             rows = max(self._capacity(len(missing)), self.capacity_min)
-            self.dirs = jnp.full((rows, pc), PACKED_STAY, jnp.uint8)
+            self.dirs = jnp.full((rows, pc), PACKED_STAY, jnp.uint32)
         needed = len(self.goal_rows) + len(missing)
         if needed > self.dirs.shape[0]:
             grow = self.dirs.shape[0]
@@ -80,7 +80,7 @@ class PlanService:
             self.dirs = jnp.concatenate(
                 [self.dirs,
                  jnp.full((grow - self.dirs.shape[0], pc), PACKED_STAY,
-                          jnp.uint8)])
+                          jnp.uint32)])
         if not missing:
             return
         # evict LRU rows when over budget — never a goal of the current

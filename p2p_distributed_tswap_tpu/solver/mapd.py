@@ -44,7 +44,7 @@ class MapdState:
     pos: jnp.ndarray          # (N,) int32 flat cell
     goal: jnp.ndarray         # (N,) int32 flat cell
     slot: jnp.ndarray         # (N,) int32 agent -> field row
-    dirs: jnp.ndarray         # (N, ceil(HW/2)) uint8 packed direction fields
+    dirs: jnp.ndarray         # (N, ceil(HW/8)) uint32 packed direction fields
     phase: jnp.ndarray        # (N,) int8 AgentPhase
     agent_task: jnp.ndarray   # (N,) int32 task index or -1
     task_used: jnp.ndarray    # (T,) bool
@@ -63,7 +63,7 @@ def init_state(cfg: SolverConfig, starts: jnp.ndarray,
         pos=jnp.asarray(starts, jnp.int32),
         goal=jnp.asarray(starts, jnp.int32),
         slot=jnp.arange(n, dtype=jnp.int32),
-        dirs=jnp.full((n, packed_cells(hw)), PACKED_STAY, jnp.uint8),
+        dirs=jnp.full((n, packed_cells(hw)), PACKED_STAY, jnp.uint32),
         phase=jnp.full(n, AgentPhase.IDLE, jnp.int8),
         agent_task=jnp.full(n, -1, jnp.int32),
         task_used=jnp.zeros(num_tasks, bool),
@@ -180,8 +180,21 @@ def _assign(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray) -> MapdState:
 
 def _replan(cfg: SolverConfig, s: MapdState, free: jnp.ndarray) -> MapdState:
     """Recompute direction-field rows for agents whose goal changed, in
-    static chunks of ``replan_chunk`` per round until the set drains."""
-    n, r = cfg.num_agents, min(cfg.replan_chunk, cfg.num_agents)
+    static-size chunks per round until the set drains.
+
+    Chunking strategy: sweep cost is O(chunk * H * W) per round regardless
+    of how few rows are actually dirty, and at steady state only a handful
+    of arrivals per step need fields — so the in-step loop uses the NARROW
+    ``replan_chunk_small``.  The t=0 burst (all N fields at once) is
+    handled by :func:`prime_fields` with the wide ``replan_chunk`` instead.
+    Deliberately a single while_loop with one chunk width: a per-round
+    ``lax.cond`` between two widths executed at wide-branch cost on the
+    axon backend once fused into the full step program (~1.45 s/step), and
+    a wide-then-narrow pair of while_loops was slower still (~2.7 s/step)
+    even with the wide loop at zero iterations — vs 0.19 s/step for this
+    shape at the 1k-512 rung."""
+    n = cfg.num_agents
+    r = min(cfg.replan_chunk_small, n)
     idx = jnp.arange(n, dtype=jnp.int32)
 
     def cond(carry):
@@ -207,6 +220,35 @@ def _replan(cfg: SolverConfig, s: MapdState, free: jnp.ndarray) -> MapdState:
 
     dirs, need = jax.lax.while_loop(cond, body, (s.dirs, s.need_replan))
     return s.replace(dirs=dirs, need_replan=need)
+
+
+def prime_fields(cfg: SolverConfig, s: MapdState, free: jnp.ndarray) -> MapdState:
+    """Compute direction fields for EVERY agent's current goal in wide
+    static chunks — the t=0 burst, hoisted out of the per-step replan loop.
+
+    One ``lax.scan`` of ceil(N / replan_chunk) steps (static trip count, no
+    data-dependent control flow), each sweeping a (replan_chunk, H, W)
+    batch.  Call once after initial task assignment (``prepare_state``);
+    afterwards the per-step narrow replan only ever sees incremental goal
+    changes.  The tail chunk clips to agent n-1 and recomputes a few rows
+    redundantly — their (goal, slot) pairs are consistent, so the extra
+    writes are correct."""
+    n, r = cfg.num_agents, min(cfg.replan_chunk, cfg.num_agents)
+    nchunks = -(-n // r)
+    lane = jnp.arange(r, dtype=jnp.int32)
+
+    def chunk(dirs, ci):
+        sel = jnp.clip(ci * r + lane, 0, n - 1)
+        fields = direction_fields(free, s.goal[sel],
+                                  max_rounds=cfg.max_sweep_rounds)
+        dirs = dirs.at[s.slot[sel]].set(
+            pack_directions(fields.reshape(r, cfg.num_cells)))
+        return dirs, None
+
+    dirs, _ = jax.lax.scan(chunk, s.dirs,
+                           jnp.arange(nchunks, dtype=jnp.int32))
+    return s.replace(dirs=dirs,
+                     need_replan=jnp.zeros(n, bool))
 
 
 def _record(cfg: SolverConfig, s: MapdState) -> MapdState:
@@ -271,17 +313,36 @@ def validate_tasks(grid: Grid, tasks) -> None:
         raise ValueError("task pickup/delivery cell on an obstacle")
 
 
-def run_mapd(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
-             free: jnp.ndarray) -> MapdState:
-    """Jittable end-to-end MAPD solve. Returns the final state; makespan is
-    ``state.t`` and paths are in ``paths_pos/paths_state[: state.t]``."""
+def prepare_state(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
+                  free: jnp.ndarray) -> Tuple[MapdState, jnp.ndarray]:
+    """Initial state ready for stepping: init, first task assignment, and
+    the wide-chunk field burst (:func:`prime_fields`).  Returns
+    ``(state, tasks)`` with the zero-task case substituted by one pre-used
+    dummy task so downstream programs stay shape-total.
+
+    Documented divergence (like the parallel-ordering ones in step.py): an
+    agent whose start cell IS its assigned pickup gets its pickup->delivery
+    flip from the first ``mapd_step``'s transitions — one step earlier than
+    the reference loop (tswap.rs:106-121, where t=0 still records the
+    pickup phase) — so makespan can shrink by 1 for such agents and no
+    PICKING step is recorded for them.  Collision-freedom is unaffected;
+    the makespan-parity suite bounds the effect."""
     if tasks.shape[0] == 0:
-        # keep the traced body total: substitute one dummy task, pre-used
         tasks = jnp.zeros((1, 2), jnp.int32)
         s = init_state(cfg, starts, 1)
         s = s.replace(task_used=jnp.ones(1, bool))
     else:
         s = init_state(cfg, starts, tasks.shape[0])
+    s = _transitions(cfg, s, tasks)
+    s = _assign(cfg, s, tasks)
+    return prime_fields(cfg, s, free), tasks
+
+
+def run_mapd(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
+             free: jnp.ndarray) -> MapdState:
+    """Jittable end-to-end MAPD solve. Returns the final state; makespan is
+    ``state.t`` and paths are in ``paths_pos/paths_state[: state.t]``."""
+    s, tasks = prepare_state(cfg, starts, tasks, free)
 
     def cond(s):
         return ~_finished(cfg, s)

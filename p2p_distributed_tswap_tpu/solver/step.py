@@ -199,7 +199,7 @@ def step_parallel(cfg: SolverConfig, pos: jnp.ndarray, goal: jnp.ndarray,
       pos:  (N,) int32 flat cell per agent (vertex-disjoint).
       goal: (N,) int32 flat goal cell per agent.
       slot: (N,) int32 agent -> direction-field row (a permutation).
-      dirs: (N, ceil(H*W/2)) uint8 nibble-packed direction fields
+      dirs: (N, ceil(H*W/8)) uint32 nibble-packed direction fields
         (ops.distance.pack_directions), row ``slot[i]`` is agent i's field
         (invariant: row slot[i] encodes descent toward goal[i]).
 
