@@ -40,22 +40,38 @@ inline std::optional<size_t> occupant_of(const std::vector<TswapAgent>& agents,
 // One sequential TSWAP step over all agents, in index order.
 inline void tswap_step(std::vector<TswapAgent>& agents, DistanceCache& dc) {
   const size_t n = agents.size();
+  // agents whose goal was retargeted by the push extension this step; they
+  // skip the goal phase and absorb chain walks so Rule 4 cannot rotate the
+  // push away before the movement pass's mutual swap lands it
+  std::vector<char> pushed(n, 0);
 
   // --- goal-swapping phase (Rules 1, 3, 4) ---
   for (size_t i = 0; i < n; ++i) {
+    if (pushed[i]) continue;
     if (agents[i].v == agents[i].g) continue;  // Rule 1
     auto u = dc.next_hop(agents[i].v, agents[i].g);
     if (!u) continue;
     auto j = occupant_of(agents, *u);
     if (!j || *j == i) continue;
     if (agents[*j].v == agents[*j].g) {
-      std::swap(agents[i].g, agents[*j].g);  // Rule 3
+      if (agents[*j].g == agents[i].g) {
+        // Push extension (deliberate fix of a reference deadlock): two
+        // tasks sharing a delivery cell make the Rule-3 swap exchange
+        // identical goals and no-op forever (tswap.rs:197-202).  Retarget
+        // the parked blocker toward the mover's cell; the movement pass
+        // resolves the pair as a mutual swap.  Mirrors solver/step.py.
+        agents[*j].g = agents[i].v;
+        pushed[*j] = 1;
+      } else {
+        std::swap(agents[i].g, agents[*j].g);  // Rule 3
+      }
     } else {
       // Rule 4: walk the blocking chain
       std::vector<size_t> a_p{i};
       size_t cur = *j;
       bool deadlock = false;
       while (true) {
+        if (pushed[cur]) break;  // pushed agents absorb (see push above)
         if (agents[cur].v == agents[cur].g) break;
         auto w = dc.next_hop(agents[cur].v, agents[cur].g);
         if (!w) break;

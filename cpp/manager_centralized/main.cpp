@@ -479,12 +479,23 @@ int main(int argc, char** argv) {
           ++it;
         }
       }
+      // Cap trim: only IDLE agents are eligible — evicting a live busy
+      // agent would either lose its task (it re-registers task-less on the
+      // next heartbeat and gets a second assignment) or duplicate it (if
+      // re-queued while the agent keeps working).  If everyone is busy the
+      // cap is soft: warn and keep them until tasks complete.
       while (agents.size() > max_agents) {
-        // trim the least-recently-seen live agent; its task stays with it
-        auto oldest = agents.begin();
+        auto oldest = agents.end();
         for (auto it = agents.begin(); it != agents.end(); ++it)
-          if (it->second.last_seen_ms < oldest->second.last_seen_ms)
+          if (!it->second.task
+              && (oldest == agents.end()
+                  || it->second.last_seen_ms < oldest->second.last_seen_ms))
             oldest = it;
+        if (oldest == agents.end()) {
+          printf("⚠️  %zu agents exceed cap %zu but all are busy; "
+                 "deferring trim\n", agents.size(), max_agents);
+          break;
+        }
         agents.erase(oldest);
       }
       while (known_left.size() > max_known_peers)

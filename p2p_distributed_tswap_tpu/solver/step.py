@@ -32,7 +32,20 @@ for makespan parity in tests):
   can move the partner again later in the same pass, tswap.rs:269-278);
 - the movement cascade lets an agent enter a cell vacated this step by ANY
   mover, where the sequential pass only sees vacancies created by
-  lower-indexed agents — strictly more progress per step.
+  lower-indexed agents — strictly more progress per step;
+- **push extension** (deliberate fix of a reference deadlock): when the
+  blocker is parked on the mover's OWN goal (two tasks sharing a delivery
+  cell — goals equal, so the reference's Rule-3 swap exchanges identical
+  values and no-ops forever, tswap.rs:197-202), the blocker's goal is
+  retargeted to the mover's current cell; the next movement phase resolves
+  the pair as a mutual position swap.  Pushed goals are served by the
+  goal-adjacency shortcut below, so the blocker's (stale) field row is
+  never consulted for them.
+
+Next-hop lookups apply a **goal-adjacency shortcut**: an agent whose goal is
+exactly one cell away steps straight to it, bypassing its direction field.
+For field-backed goals this is a no-op (the field would say the same); it
+makes pushed/stale-field goals exact within one step of staleness.
 """
 
 from __future__ import annotations
@@ -103,56 +116,109 @@ def _apply_pair_swaps(goal, slot, sel, partner, n):
     return goal[p], slot[p]
 
 
-def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, nh_fn, occ, active):
+def _hops(cfg: SolverConfig, nh_fn, slot, pos, goal, active):
+    """Next hops with the goal-adjacency shortcut (see module docstring)."""
+    u = jnp.where(active, nh_fn(slot, pos), pos)
+    w = cfg.width
+    mh = jnp.abs(pos % w - goal % w) + jnp.abs(pos // w - goal // w)
+    return jnp.where(active & (mh == 1), goal, u)
+
+
+def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, pushed, nh_fn, occ,
+                      active):
     n = cfg.num_agents
     idx = jnp.arange(n, dtype=jnp.int32)
 
     # ---- Rule 3: swap goals with a blocker parked on its own goal ----
     at_goal = pos == goal
-    u = jnp.where(active, nh_fn(slot, pos), pos)
+    u = _hops(cfg, nh_fn, slot, pos, goal, active)
     b, has_move = _blockers(occ, pos, u)
     bc = jnp.clip(b, 0, n - 1)
     cand = (has_move & (b >= 0) & at_goal[bc]
             & _within_radius(cfg, pos, idx, bc))
     # lowest claimant id per blocker wins
     winner = jnp.full(n + 1, n, jnp.int32).at[jnp.where(cand, b, n)].min(idx)
-    sel3 = cand & (winner[bc] == idx)
+    sel = cand & (winner[bc] == idx)
+    # blocker parked on the mover's own goal: swapping equal goals no-ops
+    # (the reference deadlock) -> push the blocker toward the mover's cell.
+    # The pushed pair now wants each other's cells, which Rule 4 would read
+    # as a 2-cycle and rotate straight back to self-goals — undoing the push
+    # and marking the delivery at the wrong cell — so pushed agents are
+    # flagged and excluded from the cycle graph for the rest of the step;
+    # the movement phase then resolves the pair as a mutual position swap
+    # and the mover PHYSICALLY reaches the contested cell.
+    same_goal = goal[bc] == goal
+    sel3 = sel & ~same_goal
+    push = sel & same_goal
     goal, slot = _apply_pair_swaps(goal, slot, sel3, bc, n)
+    ge = jnp.concatenate([goal, jnp.zeros(1, goal.dtype)])
+    ge = ge.at[jnp.where(push, bc, n)].set(jnp.where(push, pos, 0))
+    goal = ge[:n]
+    pe = jnp.concatenate([pushed, jnp.zeros(1, bool)])
+    pushed = pe.at[jnp.where(push, bc, n)].set(True)[:n]
 
     # ---- Rule 4: rotate goals around blocking cycles ----
     at_goal = pos == goal
-    u = jnp.where(active, nh_fn(slot, pos), pos)
+    u = _hops(cfg, nh_fn, slot, pos, goal, active)
     b, has_move = _blockers(occ, pos, u)
     # blocking-graph successor; n = absorbing sentinel (chain breaks at
-    # at-goal agents automatically: they have no move, f = n).  In
-    # decentralized mode edges are limited to visible pairs, so detected
-    # cycles have every consecutive pair within radius (the reference
-    # requires the whole chain inside the *initiator's* radius,
-    # agent.rs:379-448 — a slightly stricter condition; divergence is
-    # validated empirically like the other parallel-ordering differences).
-    f = jnp.where(has_move & (b >= 0)
-                  & _within_radius(cfg, pos, idx, jnp.clip(b, 0, n - 1)),
-                  b, n)
+    # at-goal agents automatically: they have no move, f = n).  Chain edges
+    # are always adjacent pairs, so pairwise visibility never restricts
+    # them; the reference's decentralized mode instead requires the WHOLE
+    # chain inside the *initiator's* radius (agent.rs:379-448, the
+    # radius-15 nearby cache the initiator walks).  Matching that: a cycle
+    # rotates iff at least one member sees every member within its own
+    # radius (that member is the initiator broadcasting
+    # target_rotation_request); all members then rotate consistently.
+    # Freshly-pushed agents absorb (f = n): no cycle may pass through them
+    # this step (see the push comment above).
+    f = jnp.where(has_move & (b >= 0) & ~pushed, b, n)
     f_ext = jnp.concatenate([f, jnp.array([n], jnp.int32)])
+
     def cycle_scan(carry, _):
-        y, on_cycle = carry
+        y, on_cycle, within = carry
         y = f_ext[y]
-        return (y, on_cycle | (y == idx)), None
-    (_, on_cycle), _ = jax.lax.scan(
-        cycle_scan, (f, jnp.zeros(n, bool)), None, length=cfg.cycle_cap)
+        within = within & _within_radius(cfg, pos, idx, jnp.clip(y, 0, n - 1))
+        return (y, on_cycle | ((y == idx) & within), within), None
+
+    (_, init_ok, _), _ = jax.lax.scan(
+        cycle_scan, (f, jnp.zeros(n, bool), jnp.ones(n, bool)), None,
+        length=cfg.cycle_cap)
+    if cfg.visibility_radius is None:
+        on_cycle = init_ok  # global view: every member is its own initiator
+    else:
+        # plain cycle membership (no radius), then OR the initiator flag
+        # around each cycle so members rotate all-or-nothing
+        def plain_scan(carry, _):
+            y, oc = carry
+            y = f_ext[y]
+            return (y, oc | (y == idx)), None
+
+        (_, on_cycle_plain), _ = jax.lax.scan(
+            plain_scan, (f, jnp.zeros(n, bool)), None, length=cfg.cycle_cap)
+        init_ext = jnp.concatenate([init_ok, jnp.array([False])])
+
+        def prop_scan(carry, _):
+            y, any_ok = carry
+            y = f_ext[y]
+            return (y, any_ok | init_ext[y]), None
+
+        (_, any_ok), _ = jax.lax.scan(
+            prop_scan, (f, init_ok), None, length=cfg.cycle_cap)
+        on_cycle = on_cycle_plain & any_ok
     # each cycle member hands its goal to its successor: perm q[f[x]] = x
     # (padded scratch slot n instead of mode="drop"; see _apply_pair_swaps)
     q = jnp.arange(n + 1, dtype=jnp.int32)
     q = q.at[jnp.where(on_cycle, f, n)].set(jnp.where(on_cycle, idx, n))
     q = q[:n]
     goal, slot = goal[q], slot[q]
-    return goal, slot
+    return goal, slot, pushed
 
 
 def _movement_phase(cfg: SolverConfig, pos, goal, slot, nh_fn, occ, active):
     n = cfg.num_agents
     idx = jnp.arange(n, dtype=jnp.int32)
-    u = jnp.where(active, nh_fn(slot, pos), pos)
+    u = _hops(cfg, nh_fn, slot, pos, goal, active)
     b, has_move = _blockers(occ, pos, u)
     bc = jnp.clip(b, 0, n - 1)
 
@@ -228,10 +294,13 @@ def step_with_next_hops(cfg: SolverConfig, pos, goal, slot, nh_fn,
         active = jnp.ones(cfg.num_agents, bool)
     occ = _occupancy(cfg, pos, active)
 
-    def round_body(_, gs):
-        goal, slot = gs
-        return _swap_phase_round(cfg, pos, goal, slot, nh_fn, occ, active)
+    def round_body(_, gsp):
+        goal, slot, pushed = gsp
+        return _swap_phase_round(cfg, pos, goal, slot, pushed, nh_fn, occ,
+                                 active)
 
-    goal, slot = jax.lax.fori_loop(0, cfg.swap_rounds, round_body, (goal, slot))
+    goal, slot, _ = jax.lax.fori_loop(
+        0, cfg.swap_rounds, round_body,
+        (goal, slot, jnp.zeros(cfg.num_agents, bool)))
     pos = _movement_phase(cfg, pos, goal, slot, nh_fn, occ, active)
     return pos, goal, slot

@@ -73,6 +73,56 @@ def test_parallel_vs_oracle_makespan():
     assert np.mean(ratios) < 1.5, f"makespan ratios {ratios}"
 
 
+@pytest.mark.parametrize("grid_fn,na,nt,thresh", [
+    # the reference's own comfortable envelope (manager.rs:564-567 scale);
+    # threshold from PARITY.md (mean 1.065 over 10 seeds, margin on top)
+    (Grid.default, 50, 50, 1.3),
+    # congested warehouse aisles
+    (lambda: Grid.warehouse(64, 64), 40, 40, 1.3),
+])
+def test_parity_at_reference_envelope(grid_fn, na, nt, thresh):
+    """Oracle-vs-parallel parity at the reference's deployment scale and on
+    congested maps (VERDICT r1 item 5); the full 10-seed table is
+    PARITY.md (analysis/parity_table.py).  Seeds where the ORACLE deadlocks
+    (the reference's shared-delivery flaw, fixed by our push extension)
+    count as wins for the parallel solver and skip the ratio."""
+    grid = grid_fn()
+    ratios = []
+    for seed in range(3):
+        starts, tasks = _scenario(grid, na, nt, seed=seed)
+        oracle = OracleSim(grid, starts, tasks)
+        mk_oracle = oracle.run()
+        oracle.assert_no_collisions()
+        _, _, mk_par = solve_offline(grid, starts, tasks)
+        assert 0 < mk_par <= 2000, "parallel solver must always complete"
+        if oracle.task_used.all() and mk_oracle <= 2000:
+            ratios.append(mk_par / mk_oracle)
+    assert ratios, "oracle deadlocked on every seed"
+    assert np.mean(ratios) < thresh, f"makespan ratios {ratios}"
+
+
+def test_push_extension_resolves_shared_delivery_deadlock():
+    """Two tasks delivering to the same cell: the first deliverer parks on
+    it and the reference (= oracle) deadlocks — its Rule-3 swap exchanges
+    identical goals (tswap.rs:197-202).  The parallel solver's documented
+    push extension (solver/step.py) must complete."""
+    grid = Grid.from_ascii("." * 6)
+    starts = np.array([grid.idx((0, 0)), grid.idx((5, 0))], np.int32)
+    tasks = np.array([[grid.idx((0, 0)), grid.idx((3, 0))],
+                      [grid.idx((5, 0)), grid.idx((3, 0))]], np.int32)
+    oracle = OracleSim(grid, starts, tasks)
+    mk_oracle = oracle.run()
+    assert mk_oracle > 2000 or not oracle.task_used.all(), (
+        "expected the reference semantics to deadlock on this instance")
+    paths, _, mk = solve_offline(grid, starts, tasks)
+    assert 0 < mk < 50, "push extension failed to resolve the deadlock"
+    _check_paths(grid, paths)
+    # the carrying agent must PHYSICALLY reach the contested delivery cell
+    # (Rule 4 must not rotate the push away; the pair mutual-swaps instead)
+    assert (paths[:, 0] == grid.idx((3, 0))).any(), (
+        "agent 0 never physically reached its delivery cell")
+
+
 def test_solver_completes_all_tasks():
     grid = Grid.from_ascii("\n".join(["." * 12] * 12))
     starts, tasks = _scenario(grid, 4, 8, seed=5)
