@@ -1,0 +1,128 @@
+// mapd_chat — interactive bus probe (SURVEY C13).
+//
+// Capability equivalent of the reference's two broadcast demos: `chat`
+// (gossipsub + mDNS stdin chat on topic "test-net",
+// src/test/libp2p/chat.rs:24-116) and `sns` (serialized Post broadcast on
+// topic "sns", src/test/libp2p/sns.rs:21-127).  Lines typed on stdin are
+// broadcast to every peer on the topic; `/post <text>` sends an sns-style
+// structured post {author, content, timestamp} instead of a plain line.
+// Peer join/leave events print as they arrive — the manual integration
+// probe for discovery + pub/sub fanout, exactly what the reference used its
+// demos for.
+//
+// Usage: mapd_chat [--port P] [--topic test-net] [--name NAME]
+
+#include <poll.h>
+#include <signal.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "../common/bus.hpp"
+#include "../common/json.hpp"
+#include "../common/knobs.hpp"
+
+using namespace mapd;
+
+namespace {
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
+}  // namespace
+
+int main(int argc, char** argv) {
+  Knobs knobs(argc, argv);
+  const std::string host = knobs.get_str("--host", "MAPD_BUS_HOST",
+                                         "127.0.0.1");
+  const uint16_t port = static_cast<uint16_t>(
+      knobs.get_int("--port", "MAPD_BUS_PORT", 7400));
+  // the reference's chat demo topic (chat.rs:58)
+  const std::string topic = knobs.get_str("--topic", "", "test-net");
+  std::string name = knobs.get_str("--name", "", "");
+
+  signal(SIGINT, handle_stop);
+  signal(SIGTERM, handle_stop);
+  signal(SIGPIPE, SIG_IGN);
+
+  BusClient bus;
+  std::string my_id = random_peer_id();
+  if (!name.empty()) my_id = name;
+  if (!bus.connect(host, port, my_id)) {
+    fprintf(stderr, "cannot connect to bus on port %u\n", port);
+    return 1;
+  }
+  bus.subscribe(topic);
+  printf("💬 chat probe %s on topic \"%s\" — type to broadcast, "
+         "/post <text> for an sns-style post, /quit to exit\n",
+         my_id.c_str(), topic.c_str());
+  fflush(stdout);
+
+  std::string stdin_buf;
+  bool running = true;
+  while (running && !g_stop && bus.connected()) {
+    pollfd pfds[2] = {
+        {bus.fd(),
+         static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0},
+        {STDIN_FILENO, POLLIN, 0}};
+    poll(pfds, 2, 200);
+
+    if (pfds[1].revents & POLLIN) {
+      char buf[4096];
+      ssize_t r = read(STDIN_FILENO, buf, sizeof(buf));
+      if (r > 0) {
+        stdin_buf.append(buf, static_cast<size_t>(r));
+        size_t nl;
+        while ((nl = stdin_buf.find('\n')) != std::string::npos) {
+          std::string line = stdin_buf.substr(0, nl);
+          stdin_buf.erase(0, nl + 1);
+          if (line == "/quit" || line == "/exit") {
+            running = false;
+            break;
+          }
+          Json m;
+          if (line.rfind("/post ", 0) == 0) {
+            // sns Post shape (sns.rs Post {author, content, timestamp})
+            m.set("type", "post")
+                .set("author", my_id)
+                .set("content", line.substr(6))
+                .set("timestamp", unix_ms());
+          } else if (!line.empty()) {
+            m.set("type", "chat").set("from", my_id).set("text", line);
+          } else {
+            continue;
+          }
+          bus.publish(topic, m);
+        }
+      } else if (r == 0) {
+        running = false;
+      }
+    }
+
+    bool alive = bus.pump(
+        [&](const BusClient::Msg& msg) {
+          const Json& d = msg.data;
+          if (d["type"].as_str() == "post")
+            printf("📝 [%s] %s\n", d["author"].as_str().c_str(),
+                   d["content"].as_str().c_str());
+          else if (d["type"].as_str() == "chat")
+            printf("💬 <%s> %s\n", d["from"].as_str().c_str(),
+                   d["text"].as_str().c_str());
+          else
+            printf("📦 %s\n", d.dump().c_str());
+          fflush(stdout);
+        },
+        [&](const Json& ev) {
+          const std::string& op = ev["op"].as_str();
+          if (op == "peer_joined")
+            printf("🔍 peer joined: %s\n", ev["peer_id"].as_str().c_str());
+          else if (op == "peer_left")
+            printf("👋 peer left: %s\n", ev["peer_id"].as_str().c_str());
+          fflush(stdout);
+        });
+    if (!alive) break;
+  }
+  printf("chat: bye\n");
+  bus.close();
+  return 0;
+}

@@ -1,0 +1,152 @@
+// mapd_echo — self-validating echo probe (SURVEY C13).
+//
+// Capability equivalent of the reference's `stream` demo
+// (src/test/libp2p/stream.rs:11-157): a QUIC echo protocol where the client
+// sends a random payload and byte-verifies the echo (stream.rs:139-156).
+// Here the transport under test is the host bus: the server role echoes
+// every payload back on the topic; the client role sends N random hex
+// payloads, verifies each echo byte-for-byte, and exits 0 only if all
+// round-trips validate — an automatable smoke test of bus connect /
+// subscribe / fanout / framing.
+//
+// Usage: mapd_echo --server [--port P] [--topic echo]
+//        mapd_echo --client [--port P] [--topic echo] [--count 5]
+//                  [--bytes 64] [--seed S]
+
+#include <poll.h>
+#include <signal.h>
+
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <string>
+
+#include "../common/bus.hpp"
+#include "../common/json.hpp"
+#include "../common/knobs.hpp"
+
+using namespace mapd;
+
+namespace {
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
+
+std::string random_hex(std::mt19937_64& rng, size_t nbytes) {
+  static const char* hexd = "0123456789abcdef";
+  std::string s;
+  s.reserve(nbytes * 2);
+  for (size_t i = 0; i < nbytes; ++i) {
+    uint8_t b = static_cast<uint8_t>(rng());
+    s += hexd[b >> 4];
+    s += hexd[b & 0xF];
+  }
+  return s;
+}
+}  // namespace
+
+int main(int argc, char** argv) {
+  Knobs knobs(argc, argv);
+  const std::string host = knobs.get_str("--host", "MAPD_BUS_HOST",
+                                         "127.0.0.1");
+  const uint16_t port = static_cast<uint16_t>(
+      knobs.get_int("--port", "MAPD_BUS_PORT", 7400));
+  const std::string topic = knobs.get_str("--topic", "", "echo");
+  const bool server = knobs.get_bool("--server", "");
+  const bool client = knobs.get_bool("--client", "");
+  const int count = static_cast<int>(knobs.get_int("--count", "", 5));
+  const size_t nbytes = static_cast<size_t>(
+      knobs.get_int("--bytes", "", 64));  // ref stream.rs: random payloads
+  const uint64_t seed = static_cast<uint64_t>(knobs.get_int(
+      "--seed", "", static_cast<int64_t>(std::random_device{}())));
+  if (server == client) {
+    fprintf(stderr, "usage: mapd_echo --server | --client [--count N]\n");
+    return 2;
+  }
+
+  signal(SIGINT, handle_stop);
+  signal(SIGTERM, handle_stop);
+  signal(SIGPIPE, SIG_IGN);
+
+  BusClient bus;
+  std::string my_id = random_peer_id();
+  if (!bus.connect(host, port, my_id)) {
+    fprintf(stderr, "cannot connect to bus on port %u\n", port);
+    return 1;
+  }
+  bus.subscribe(topic);
+
+  if (server) {
+    printf("🔁 echo server %s on topic \"%s\"\n", my_id.c_str(),
+           topic.c_str());
+    fflush(stdout);
+    while (!g_stop && bus.connected()) {
+      pollfd pfd{bus.fd(),
+                 static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)),
+                 0};
+      poll(&pfd, 1, 200);
+      if (!bus.pump(
+              [&](const BusClient::Msg& m) {
+                if (m.data["type"].as_str() != "echo_request") return;
+                Json r;
+                r.set("type", "echo_response")
+                    .set("to", m.data["from"])
+                    .set("nonce", m.data["nonce"])
+                    .set("payload", m.data["payload"]);
+                bus.publish(topic, r);
+              },
+              [](const Json&) {}))
+        break;
+    }
+    bus.close();
+    return 0;
+  }
+
+  // client: send `count` random payloads, verify each echo byte-for-byte
+  // (the reference's self-validation, stream.rs:139-156)
+  std::mt19937_64 rng(seed);
+  int ok = 0;
+  for (int k = 0; k < count && !g_stop; ++k) {
+    const std::string payload = random_hex(rng, nbytes);
+    const int64_t nonce = k + 1;
+    Json req;
+    req.set("type", "echo_request")
+        .set("from", my_id)
+        .set("nonce", nonce)
+        .set("payload", payload);
+    bus.publish(topic, req);
+
+    bool verified = false;
+    int64_t deadline = mono_ms() + 5000;
+    while (!verified && !g_stop && mono_ms() < deadline && bus.connected()) {
+      pollfd pfd{bus.fd(),
+                 static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)),
+                 0};
+      poll(&pfd, 1, 100);
+      if (!bus.pump(
+              [&](const BusClient::Msg& m) {
+                if (m.data["type"].as_str() != "echo_response") return;
+                if (m.data["to"].as_str() != my_id) return;
+                if (m.data["nonce"].as_int() != nonce) return;
+                if (m.data["payload"].as_str() == payload) {
+                  verified = true;
+                } else {
+                  fprintf(stderr, "❌ payload mismatch on nonce %lld\n",
+                          static_cast<long long>(nonce));
+                }
+              },
+              [](const Json&) {}))
+        break;
+    }
+    if (verified) {
+      ++ok;
+      printf("✅ echo %d/%d verified (%zu bytes)\n", k + 1, count,
+             payload.size());
+    } else {
+      printf("❌ echo %d/%d FAILED (timeout or mismatch)\n", k + 1, count);
+    }
+    fflush(stdout);
+  }
+  bus.close();
+  printf("echo client: %d/%d verified\n", ok, count);
+  return ok == count ? 0 : 1;
+}

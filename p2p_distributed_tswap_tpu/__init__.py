@@ -17,7 +17,6 @@ Package layout
 - ``metrics``  — task / path / network metrics with reference-compatible CSV schemas
 - ``runtime``  — Python side of the host runtime (bus client, solver daemon)
 - ``models``   — benchmark scenario/config ladder (flagship configs)
-- ``utils``    — small shared helpers
 """
 
 __version__ = "0.1.0"

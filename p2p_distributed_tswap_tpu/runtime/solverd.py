@@ -59,6 +59,8 @@ class PlanService:
         self.goal_rows: "OrderedDict[int, int]" = OrderedDict()
         self.dirs: jnp.ndarray | None = None  # (rows, ceil(HW/8)) packed uint32
         self._step = functools.partial(jax.jit, static_argnums=0)(step_parallel)
+        self._last_cap = 0
+        self._seen_programs = 0
 
     def _capacity(self, n: int) -> int:
         c = self.capacity_min
@@ -104,6 +106,13 @@ class PlanService:
         """agents: [(peer_id, pos_cell, goal_cell)] ->
         [(peer_id, next_cell, goal_cell)] after one TSWAP step."""
         n = len(agents)
+        cap = self._capacity(n)
+        # Operator-visible recompile stalls (survivable — the manager keeps
+        # its own tick and drops the stale seq — but they must not be
+        # silent).  Detected via the jit cache size, which catches EVERY
+        # retrace — capacity changes AND dirs-buffer growth — and stays
+        # quiet on cache hits (e.g. shrinking back to a known capacity).
+        t_plan0 = time.perf_counter()
         goals = [g for _, _, g in agents]
         # LRU-touch cached request goals FIRST so eviction inside
         # _ensure_fields can only hit goals absent from this request
@@ -128,6 +137,14 @@ class PlanService:
             self.dirs[:, :], jnp.asarray(active))
         new_pos = np.asarray(new_pos)
         new_goal = np.asarray(new_goal)
+        new_cache = getattr(self._step, "_cache_size", lambda: None)()
+        if new_cache is not None and new_cache > self._seen_programs:
+            print(f"⏳ recompiled step program "
+                  f"(capacity {self._last_cap} -> {cap}, "
+                  f"{self.dirs.shape[0]} field rows): plan stalled "
+                  f"{time.perf_counter() - t_plan0:.1f}s", flush=True)
+            self._seen_programs = new_cache
+        self._last_cap = cap
         return [(agents[k][0], int(new_pos[k]), int(new_goal[k]))
                 for k in range(n)]
 
@@ -137,7 +154,13 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=7400)
     ap.add_argument("--map", default=None)
     ap.add_argument("--capacity-min", type=int, default=16)
+    # Force the CPU backend (tests; also the env-var route is unreliable in
+    # environments whose sitecustomize pre-imports jax with a plugin set).
+    ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     if args.map:
         with open(args.map) as f:
@@ -155,13 +178,18 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
         jax.devices()
 
-    service = PlanService(grid, capacity_min=args.capacity_min)
+    # Subscribe BEFORE touching the device: accelerator init through the
+    # tunnel can take many seconds, and plan_requests published meanwhile
+    # would be lost (the bus does not replay).  The banner below is the
+    # readiness signal harnesses wait for.
     bus = BusClient(port=args.port, peer_id="solverd")
     bus.subscribe("solver")
+    service = PlanService(grid, capacity_min=args.capacity_min)
     print(f"🧮 solverd up on port {args.port} "
           f"(grid {grid.height}x{grid.width}, devices={jax.devices()})")
     sys.stdout.flush()
 
+    dropped_total = 0
     while True:
         frame = bus.recv(timeout=1.0)
         if frame is None or frame.get("op") != "msg":
@@ -169,6 +197,29 @@ def main(argv=None) -> int:
         data = frame.get("data") or {}
         if data.get("type") != "plan_request":
             continue
+        # Staleness drop: if planning fell behind the manager's tick (slow
+        # plan, recompile stall), requests queue up on the socket.  Only the
+        # NEWEST is worth computing — the manager discards stale seqs anyway
+        # (manager_centralized handle_plan_response) — so drain the queue
+        # and plan once.
+        dropped = 0
+        while True:
+            # small positive timeout: 0.0 would flip the socket into
+            # non-blocking mode, whose BlockingIOError recv() doesn't catch
+            nxt = bus.recv(timeout=0.005)
+            if nxt is None:
+                break
+            if nxt.get("op") != "msg":
+                continue
+            ndata = nxt.get("data") or {}
+            if ndata.get("type") == "plan_request":
+                data = ndata
+                dropped += 1
+        if dropped:
+            dropped_total += dropped
+            print(f"⏭️  dropped {dropped} stale plan_request(s) "
+                  f"({dropped_total} total); planning seq {data.get('seq')}",
+                  flush=True)
         t0 = time.perf_counter()
         agents = []
         w = grid.width

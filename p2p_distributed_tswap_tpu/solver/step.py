@@ -42,10 +42,13 @@ for makespan parity in tests):
   goal-adjacency shortcut below, so the blocker's (stale) field row is
   never consulted for them.
 
-Next-hop lookups apply a **goal-adjacency shortcut**: an agent whose goal is
-exactly one cell away steps straight to it, bypassing its direction field.
-For field-backed goals this is a no-op (the field would say the same); it
-makes pushed/stale-field goals exact within one step of staleness.
+Next-hop lookups enforce Rule 1 explicitly (at-goal agents never move, even
+if their field row is stale) and apply a **goal-adjacency shortcut**: an
+agent whose goal is exactly one cell away steps straight to it, bypassing
+its direction field.  For field-backed goals both are no-ops (the field
+would say the same); together they make pushed/stale-row (goal, slot) pairs
+— which Rule-3/4 exchanges may hand around — exact within one extra step
+for movers and inert for parked agents.
 """
 
 from __future__ import annotations
@@ -117,11 +120,20 @@ def _apply_pair_swaps(goal, slot, sel, partner, n):
 
 
 def _hops(cfg: SolverConfig, nh_fn, slot, pos, goal, active):
-    """Next hops with the goal-adjacency shortcut (see module docstring)."""
+    """Next hops with Rule 1 and the goal-adjacency shortcut explicit.
+
+    Rule 1 (at-goal agents never move, ref tswap.rs:186) is enforced here
+    directly instead of relying on the field saying STAY at the goal: a
+    pushed agent's field row targets its OLD goal, and without the explicit
+    check a parked pushed agent would wander off its goal following the
+    stale row.  Together with the adjacency shortcut this bounds any
+    stale-row effect to one extra step for moving agents and zero for
+    parked ones."""
     u = jnp.where(active, nh_fn(slot, pos), pos)
     w = cfg.width
     mh = jnp.abs(pos % w - goal % w) + jnp.abs(pos // w - goal // w)
-    return jnp.where(active & (mh == 1), goal, u)
+    u = jnp.where(active & (mh == 1), goal, u)
+    return jnp.where(pos == goal, pos, u)
 
 
 def _swap_phase_round(cfg: SolverConfig, pos, goal, slot, pushed, nh_fn, occ,

@@ -136,6 +136,130 @@ def test_task_requeued_on_agent_death(built, tiny_map, tmp_path, mode):
             "death:\n" + log[-1500:])
 
 
+def test_solverd_drops_stale_requests_and_reports_recompiles(built):
+    """A burst of plan_requests queued behind a slow plan: solverd must
+    compute only the NEWEST (the manager discards stale seqs anyway) and
+    must announce recompile stalls to the operator (VERDICT r1 weak 8)."""
+    import subprocess
+    import sys
+    import threading
+
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    port = _free_port()
+    bus = subprocess.Popen([str(BUILD_DIR / "mapd_bus"), str(port)],
+                           stdout=subprocess.DEVNULL)
+    sd = None
+    try:
+        time.sleep(0.3)
+        sd = subprocess.Popen(
+            [sys.executable, "-m",
+             "p2p_distributed_tswap_tpu.runtime.solverd",
+             "--port", str(port), "--cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        lines = []
+        threading.Thread(target=lambda: [lines.append(l) for l in sd.stdout],
+                         daemon=True).start()
+        assert _wait_for(lambda: any("solverd up" in l for l in lines), 60), \
+            lines
+        cli = BusClient(port=port, peer_id="fakemgr")
+        cli.subscribe("solver")
+        time.sleep(0.3)
+        # 30 rapid requests: whatever solverd dequeues first compiles for
+        # seconds, so the rest pile up and the drain must skip straight to
+        # the newest (exact batching depends on scheduling, hence ranges)
+        last_seq = 30
+        for seq in range(1, last_seq + 1):
+            cli.publish("solver", {
+                "type": "plan_request", "seq": seq,
+                "agents": [{"peer_id": "a1", "pos": [1, 1],
+                            "goal": [5, 5]}]})
+        got = []
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and last_seq not in got:
+            f = cli.recv(timeout=2.0)
+            if (f and f.get("op") == "msg"
+                    and (f.get("data") or {}).get("type") == "plan_response"):
+                got.append(f["data"]["seq"])
+        assert got and got[-1] == last_seq, (got, lines[-5:])
+        assert len(got) < last_seq / 2, f"barely any drops: {got}"
+        assert any("dropped" in l for l in lines), lines
+        assert any("recompiled step program" in l for l in lines), lines
+    finally:
+        if sd is not None:
+            sd.terminate()
+        bus.terminate()
+
+
+def test_echo_probe_self_validates(built):
+    """The C13 stream-demo equivalent: echo client sends random payloads and
+    byte-verifies every echo (ref stream.rs:139-156 self-validation); exit 0
+    only when all round-trips check out."""
+    import subprocess
+
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    port = _free_port()
+    bus = subprocess.Popen([str(BUILD_DIR / "mapd_bus"), str(port)],
+                           stdout=subprocess.DEVNULL)
+    server = None
+    try:
+        time.sleep(0.3)
+        server = subprocess.Popen(
+            [str(BUILD_DIR / "mapd_echo"), "--server", "--port", str(port)],
+            stdout=subprocess.DEVNULL)
+        time.sleep(0.3)
+        client = subprocess.run(
+            [str(BUILD_DIR / "mapd_echo"), "--client", "--port", str(port),
+             "--count", "5", "--bytes", "128", "--seed", "7"],
+            capture_output=True, text=True, timeout=30)
+        assert client.returncode == 0, client.stdout + client.stderr
+        assert "5/5 verified" in client.stdout
+    finally:
+        if server is not None:
+            server.terminate()
+        bus.terminate()
+
+
+def test_chat_probe_broadcasts(built):
+    """The C13 chat/sns-demo equivalent: a line typed at one probe arrives
+    at the other; /post sends the sns-style structured Post."""
+    import subprocess
+
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    port = _free_port()
+    bus = subprocess.Popen([str(BUILD_DIR / "mapd_bus"), str(port)],
+                           stdout=subprocess.DEVNULL)
+    a = b = None
+    try:
+        time.sleep(0.3)
+        a = subprocess.Popen(
+            [str(BUILD_DIR / "mapd_chat"), "--port", str(port),
+             "--name", "alice"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        b = subprocess.Popen(
+            [str(BUILD_DIR / "mapd_chat"), "--port", str(port),
+             "--name", "bob"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        time.sleep(0.5)
+        a.stdin.write("hello from alice\n/post status update\n/quit\n")
+        a.stdin.flush()
+        time.sleep(1.0)
+        b.stdin.write("/quit\n")
+        b.stdin.flush()
+        out_b = b.communicate(timeout=10)[0]
+        a.wait(timeout=10)
+        assert "<alice> hello from alice" in out_b, out_b
+        assert "[alice] status update" in out_b, out_b
+    finally:
+        for p in (a, b):
+            if p is not None and p.poll() is None:
+                p.kill()
+        bus.terminate()
+
+
 def test_manager_cli_metrics_and_reset(built, tiny_map, tmp_path):
     with Fleet("decentralized", num_agents=1, port=_free_port(),
                map_file=tiny_map, log_dir=str(tmp_path)) as fleet:
