@@ -1,6 +1,7 @@
 """Batched grid ops: BFS distance/direction fields (the production planner
-primitive) and reserved space-time A* (the prioritized-planning primitive,
-ref src/algorithm/a_star.rs)."""
+primitive), their grid-tile-sharded variants (spatial decomposition with
+ppermute halo exchange), and reserved space-time A* (the
+prioritized-planning primitive, ref src/algorithm/a_star.rs)."""
 
 from p2p_distributed_tswap_tpu.ops import distance
 from p2p_distributed_tswap_tpu.ops.distance import (
@@ -9,6 +10,10 @@ from p2p_distributed_tswap_tpu.ops.distance import (
     distance_fields,
     gather_packed,
     pack_directions,
+)
+from p2p_distributed_tswap_tpu.ops.tiled_distance import (
+    tiled_direction_fields,
+    tiled_distance_fields,
 )
 from p2p_distributed_tswap_tpu.ops.reserved_astar import (
     empty_reservations,

@@ -120,7 +120,6 @@ class PlanService:
             if g in self.goal_rows:
                 self.goal_rows.move_to_end(g)
         self._ensure_fields(goals)
-        cap = self._capacity(n)
         cfg = SolverConfig(height=self.grid.height, width=self.grid.width,
                            num_agents=cap)
         pos = np.zeros(cap, np.int32)
@@ -170,6 +169,13 @@ def main(argv=None) -> int:
     else:
         grid = Grid.default()
 
+    # Subscribe BEFORE touching the device (including the jax.devices()
+    # probe): accelerator init through the tunnel can take many seconds, and
+    # plan_requests published meanwhile would be lost (the bus does not
+    # replay).  The banner below is the readiness signal harnesses wait for.
+    bus = BusClient(port=args.port, peer_id="solverd")
+    bus.subscribe("solver")
+
     try:
         jax.devices()
     except RuntimeError as e:  # accelerator plugin failed: fall back to CPU
@@ -178,12 +184,6 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
         jax.devices()
 
-    # Subscribe BEFORE touching the device: accelerator init through the
-    # tunnel can take many seconds, and plan_requests published meanwhile
-    # would be lost (the bus does not replay).  The banner below is the
-    # readiness signal harnesses wait for.
-    bus = BusClient(port=args.port, peer_id="solverd")
-    bus.subscribe("solver")
     service = PlanService(grid, capacity_min=args.capacity_min)
     print(f"🧮 solverd up on port {args.port} "
           f"(grid {grid.height}x{grid.width}, devices={jax.devices()})")
