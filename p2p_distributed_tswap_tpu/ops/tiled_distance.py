@@ -68,7 +68,8 @@ def _halo_relax(d: jnp.ndarray, free_local: jnp.ndarray,
 
 def tiled_distance_fields(free_local: jnp.ndarray, goals_idx: jnp.ndarray,
                           width: int, axis_name: str = TILES_AXIS,
-                          max_rounds: int = 256) -> jnp.ndarray:
+                          max_rounds: int = 256,
+                          fixpoint_axes=None) -> jnp.ndarray:
     """Exact BFS distances on an H-sharded grid.
 
     Args:
@@ -77,6 +78,12 @@ def tiled_distance_fields(free_local: jnp.ndarray, goals_idx: jnp.ndarray,
       width: global grid width (== local width).
       axis_name: mesh axis H is sharded over.
       max_rounds: safety cap (fixpoint detection is global via psum).
+      fixpoint_axes: mesh axes the round-count fixpoint reduces over;
+        defaults to ``axis_name``.  On a multi-axis mesh whose OTHER axes
+        run this sweep with different data (e.g. the 2-D agents x tiles
+        solver), pass ALL axes: some backends key collectives on a global
+        schedule, so every device must execute the same number of
+        halo-exchange rounds even across independent sweeps.
 
     Returns:
       (G, H_local, W) int32 — this device's band of the exact global fields.
@@ -112,7 +119,8 @@ def tiled_distance_fields(free_local: jnp.ndarray, goals_idx: jnp.ndarray,
         nd = one_round(d)
         # global fixpoint: every band must be stable simultaneously
         changed = jax.lax.psum(
-            jnp.any(nd != d).astype(jnp.int32), axis_name) > 0
+            jnp.any(nd != d).astype(jnp.int32),
+            fixpoint_axes if fixpoint_axes is not None else axis_name) > 0
         return nd, changed, i + 1
 
     d, _, _ = jax.lax.while_loop(cond, body,
@@ -122,13 +130,14 @@ def tiled_distance_fields(free_local: jnp.ndarray, goals_idx: jnp.ndarray,
 
 def tiled_direction_fields(free_local: jnp.ndarray, goals_idx: jnp.ndarray,
                            width: int, axis_name: str = TILES_AXIS,
-                           max_rounds: int = 256) -> jnp.ndarray:
+                           max_rounds: int = 256,
+                           fixpoint_axes=None) -> jnp.ndarray:
     """(G, H_local, W) uint8 next-hop directions on an H-sharded grid —
     band-boundary cells see the neighbors' adjacent distance rows through
     one more halo exchange, so codes are bit-identical to the single-device
     ``direction_fields``."""
     d = tiled_distance_fields(free_local, goals_idx, width, axis_name,
-                              max_rounds)
+                              max_rounds, fixpoint_axes)
     if jax.lax.axis_size(axis_name) == 1:
         return directions_from_distance(d, free_local)
     above, below = _exchange_boundary_rows(d, axis_name)

@@ -15,6 +15,28 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AGENTS_AXIS = "agents"
+TILES_AXIS = "tiles"
+
+
+def _default_devices(n_devices: int | None = None):
+    default = jax.config.jax_default_device
+    devices = (jax.devices(default.platform) if default is not None
+               else jax.devices())
+    return devices if n_devices is None else devices[:n_devices]
+
+
+def agent_tile_mesh(n_agent_shards: int, n_tiles: int,
+                    devices=None) -> Mesh:
+    """2-D (agents x tiles) mesh: field ROWS shard over the agents axis and
+    each row's cells (grid bands) over the tiles axis — the composition
+    used for grids/fleets past one chip's field budget (SCALING.md)."""
+    if devices is None:
+        devices = _default_devices(n_agent_shards * n_tiles)
+    assert len(devices) >= n_agent_shards * n_tiles
+    return Mesh(
+        np.array(devices[:n_agent_shards * n_tiles]).reshape(
+            n_agent_shards, n_tiles),
+        (AGENTS_AXIS, TILES_AXIS))
 
 
 def agent_mesh(n_devices: int | None = None, devices=None) -> Mesh:

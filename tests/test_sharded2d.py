@@ -1,0 +1,54 @@
+"""2-D (agents x tiles) sharded solver: bit-identical to single-device.
+
+The composition of the agent-axis sharding (field rows) and the grid-tile
+sharding (bands of cells) must be a pure capacity lever — same paths, same
+makespan, same goals as solver/mapd.solve_offline on one device."""
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.core.sampling import start_positions_array
+from p2p_distributed_tswap_tpu.core.tasks import TaskGenerator
+from p2p_distributed_tswap_tpu.parallel.mesh import agent_tile_mesh
+from p2p_distributed_tswap_tpu.parallel.sharded2d import (
+    solve_offline_sharded2d,
+)
+from p2p_distributed_tswap_tpu.solver.mapd import solve_offline
+
+
+def _scenario(grid, na, nt, seed):
+    starts = start_positions_array(grid, na, seed=seed)
+    tasks = TaskGenerator(grid, seed=seed + 1).generate_task_arrays(nt)
+    return starts, tasks
+
+
+@pytest.mark.parametrize("grid_fn,na,nt,mesh_shape", [
+    (lambda: Grid.from_ascii("\n".join(["." * 32] * 32)), 8, 10, (2, 4)),
+    (lambda: Grid.random_obstacles(32, 32, 0.2, seed=5), 8, 8, (2, 4)),
+    (lambda: Grid.warehouse(32, 32), 16, 12, (4, 2)),
+])
+def test_sharded2d_matches_single_device(grid_fn, na, nt, mesh_shape):
+    grid = grid_fn()
+    starts, tasks = _scenario(grid, na, nt, seed=3)
+    p1, s1, mk1 = solve_offline(grid, starts, tasks)
+    mesh = agent_tile_mesh(*mesh_shape)
+    p2, s2, mk2 = solve_offline_sharded2d(grid, starts, tasks, mesh=mesh)
+    assert mk1 == mk2
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_sharded2d_rejects_bad_divisibility():
+    grid = Grid.from_ascii("\n".join(["." * 32] * 30))  # H=30 not % 4
+    starts, tasks = _scenario(grid, 8, 4, seed=0)
+    with pytest.raises(AssertionError, match="tiles"):
+        solve_offline_sharded2d(grid, starts, tasks,
+                                mesh=agent_tile_mesh(2, 4))
+    grid2 = Grid.from_ascii("\n".join(["." * 32] * 32))
+    starts2, tasks2 = _scenario(grid2, 6, 4, seed=0)  # N=6 not % 4
+    cfg = SolverConfig(height=32, width=32, num_agents=6)
+    with pytest.raises(AssertionError, match="agent shards"):
+        solve_offline_sharded2d(grid2, starts2, tasks2, cfg,
+                                mesh=agent_tile_mesh(4, 2))
