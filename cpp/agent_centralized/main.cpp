@@ -23,6 +23,7 @@
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
+#include "../common/log.hpp"
 
 using namespace mapd;
 
@@ -33,6 +34,7 @@ void handle_stop(int) { g_stop = 1; }
 
 int main(int argc, char** argv) {
   Knobs knobs(argc, argv);
+  set_log_level(knobs);
   const std::string bus_host = knobs.get_str("--host", "MAPD_BUS_HOST",
                                              "127.0.0.1");
   const uint16_t port = static_cast<uint16_t>(
@@ -111,15 +113,14 @@ int main(int argc, char** argv) {
       Json done;
       done.set("status", "done").set("task_id", (*my_task)["task_id"]);
       bus.publish("mapd", done);
-      printf("✅ Task %lld DONE\n",
-             static_cast<long long>((*my_task)["task_id"].as_int()));
+      log_info("✅ Task %lld DONE\n",
+               static_cast<long long>((*my_task)["task_id"].as_int()));
       my_task.reset();
     }
   };
 
-  printf("🤖 centralized agent %s at (%d, %d)\n", my_id.c_str(),
-         grid.x_of(my_pos), grid.y_of(my_pos));
-  fflush(stdout);
+  log_info("🤖 centralized agent %s at (%d, %d)\n", my_id.c_str(),
+           grid.x_of(my_pos), grid.y_of(my_pos));
 
   // 3x initial broadcast for startup robustness (ref :232-269)
   for (int i = 0; i < 3; ++i) broadcast_position();
@@ -145,14 +146,13 @@ int main(int argc, char** argv) {
         my_task = d;
         task_metric("task_metric_received");
         task_metric("task_metric_started");
-        printf("📦 [TASK RECEIVED] Task ID: %lld\n",
-               static_cast<long long>(d["task_id"].as_int()));
+        log_info("📦 [TASK RECEIVED] Task ID: %lld\n",
+                 static_cast<long long>(d["task_id"].as_int()));
         broadcast_position();
         last_broadcast = mono_ms();
         completion_check();  // degenerate tasks can complete in place
       }
-      fflush(stdout);
-    });
+        });
     if (!alive) break;
 
     if (mono_ms() - last_broadcast >= heartbeat_ms) {  // ref :285-291
@@ -161,7 +161,7 @@ int main(int argc, char** argv) {
     }
   }
 
-  printf("agent %s: shutting down\n", my_id.c_str());
+  log_info("agent %s: shutting down\n", my_id.c_str());
   bus.close();
   return 0;
 }

@@ -29,6 +29,7 @@
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
+#include "../common/log.hpp"
 #include "../common/tswap.hpp"
 
 using namespace mapd;
@@ -78,6 +79,7 @@ std::optional<Cell> parse_point(const Grid& grid, const Json& j) {
 
 int main(int argc, char** argv) {
   Knobs knobs(argc, argv);
+  set_log_level(knobs);
   Args args;
   args.host = knobs.get_str("--host", "MAPD_BUS_HOST", "127.0.0.1");
   args.port = static_cast<uint16_t>(
@@ -126,7 +128,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
-  printf("🤖 agent %s up (radius %d)\n", my_id.c_str(), args.radius);
+  log_info("🤖 agent %s up (radius %d)\n", my_id.c_str(), args.radius);
 
   // ---- initial position protocol (ref :518-650) ----
   // Ask who is where; wait up to 2 s for answers; pick a random free cell
@@ -160,8 +162,8 @@ int main(int argc, char** argv) {
     my_pos = avail[rng() % avail.size()];
   }
   Cell my_goal = my_pos;
-  printf("[Initial Position Decision] My position: (%d, %d)\n",
-         grid.x_of(my_pos), grid.y_of(my_pos));
+  log_debug("[Initial Position Decision] My position: (%d, %d)\n",
+            grid.x_of(my_pos), grid.y_of(my_pos));
 
   // ---- task state ----
   enum class TaskState { Idle, MovingToPickup, MovingToDelivery };
@@ -209,8 +211,8 @@ int main(int argc, char** argv) {
       if (auto d = task_cell("delivery")) {
         my_goal = *d;
         task_state = TaskState::MovingToDelivery;
-        printf("📦 Reached PICKUP, heading to DELIVERY (%d, %d)\n",
-               grid.x_of(*d), grid.y_of(*d));
+        log_info("📦 Reached PICKUP, heading to DELIVERY (%d, %d)\n",
+                 grid.x_of(*d), grid.y_of(*d));
         publish_position();
       }
     } else if (task_state == TaskState::MovingToDelivery) {
@@ -218,8 +220,8 @@ int main(int argc, char** argv) {
       Json done;
       done.set("status", "done").set("task_id", (*my_task)["task_id"]);
       bus.publish("mapd", done);
-      printf("✅ Task %lld DONE\n",
-             static_cast<long long>((*my_task)["task_id"].as_int()));
+      log_info("✅ Task %lld DONE\n",
+               static_cast<long long>((*my_task)["task_id"].as_int()));
       my_task.reset();
       task_state = TaskState::Idle;
     }
@@ -267,8 +269,8 @@ int main(int argc, char** argv) {
         resp.set("type", "goal_swap_response").set("data", inner.dump());
         bus.publish("mapd", resp);
         if (auto g = parse_point(grid, d["my_goal"])) {
-          printf("[GOAL_SWAP] accepted from %s\n",
-                 d["from_peer"].as_str().c_str());
+          log_debug("[GOAL_SWAP] accepted from %s\n",
+                    d["from_peer"].as_str().c_str());
           my_goal = *g;
         }
       } else if (type == "goal_swap_response") {
@@ -278,8 +280,8 @@ int main(int argc, char** argv) {
             !(*inner)["accepted"].as_bool())
           return;
         if (auto g = parse_point(grid, (*inner)["my_goal"])) {
-          printf("[GOAL_SWAP] swap confirmed by %s\n",
-                 (*inner)["from_peer"].as_str().c_str());
+          log_debug("[GOAL_SWAP] swap confirmed by %s\n",
+                    (*inner)["from_peer"].as_str().c_str());
           my_goal = *g;
         }
         pending_goal_swap.reset();
@@ -293,8 +295,8 @@ int main(int argc, char** argv) {
         size_t next = (my_index + 1) % parts.size();
         if (next < goals.size()) {  // take next participant's goal (ref :1090-1107)
           if (auto g = parse_point(grid, goals[next])) {
-            printf("[ROTATION] rotating goal with %zu participants\n",
-                   parts.size());
+            log_debug("[ROTATION] rotating goal with %zu participants\n",
+                      parts.size());
             my_goal = *g;
           }
         }
@@ -324,9 +326,9 @@ int main(int argc, char** argv) {
         my_task = d;
         publish_task_metric("task_metric_received");
         if (auto p = task_cell("pickup")) {
-          printf("📦 [TASK RECEIVED] Task ID: %lld -> pickup (%d, %d)\n",
-                 static_cast<long long>(d["task_id"].as_int()),
-                 grid.x_of(*p), grid.y_of(*p));
+          log_info("📦 [TASK RECEIVED] Task ID: %lld -> pickup (%d, %d)\n",
+                   static_cast<long long>(d["task_id"].as_int()),
+                   grid.x_of(*p), grid.y_of(*p));
           my_goal = *p;
           task_state = TaskState::MovingToPickup;
           publish_position();
@@ -428,13 +430,12 @@ int main(int argc, char** argv) {
     dc.trim(256);
 
     if (now - last_metrics_print > 10000) {  // ref :786-789
-      printf("%s\n", bus.net_metrics().to_string().c_str());
-      fflush(stdout);
-      last_metrics_print = now;
+      log_info("%s\n", bus.net_metrics().to_string().c_str());
+          last_metrics_print = now;
     }
   }
 
-  printf("agent %s: shutting down\n", my_id.c_str());
+  log_info("agent %s: shutting down\n", my_id.c_str());
   bus.close();
   return 0;
 }

@@ -20,6 +20,8 @@
 #include <vector>
 
 #include "../common/json.hpp"
+#include "../common/knobs.hpp"
+#include "../common/log.hpp"
 #include "../common/net.hpp"
 
 using namespace mapd;
@@ -39,7 +41,11 @@ void handle_stop(int) { g_stop = 1; }
 }  // namespace
 
 int main(int argc, char** argv) {
-  uint16_t port = argc > 1 ? static_cast<uint16_t>(atoi(argv[1])) : 7400;
+  Knobs knobs(argc, argv);
+  set_log_level(knobs);
+  uint16_t port = (argc > 1 && argv[1][0] != '-')
+                      ? static_cast<uint16_t>(atoi(argv[1]))
+                      : 7400;
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -50,8 +56,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   set_nonblocking(listen_fd);
-  printf("mapd_bus listening on 127.0.0.1:%u\n", port);
-  fflush(stdout);
+  log_info("mapd_bus listening on 127.0.0.1:%u\n", port);
 
   std::map<int, std::unique_ptr<Client>> clients;
 
@@ -162,6 +167,6 @@ int main(int argc, char** argv) {
 
   for (auto& [fd, c] : clients) c->conn.close_fd();
   close(listen_fd);
-  printf("mapd_bus: shut down\n");
+  log_info("mapd_bus: shut down\n");
   return 0;
 }

@@ -1,0 +1,82 @@
+// Leveled, timestamped logging for the host-runtime binaries.
+//
+// The reference initializes `tracing` only in its demo binaries and uses
+// raw println! everywhere else (SURVEY §5 "tracing/profiling").  Here every
+// binary logs through one leveled sink: ISO-ish wall time + level tag +
+// message, level settable per process via --log-level / MAPD_LOG_LEVEL
+// (error | warn | info | debug; default info).  Per-decision chatter (goal
+// swap traffic, neighbor cache events) sits at debug so production fleets
+// stay quiet without losing the lifecycle narrative.
+
+#pragma once
+
+#include <sys/time.h>
+
+#include <cstdarg>
+#include <ctime>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "knobs.hpp"
+
+namespace mapd {
+
+enum class LogLevel { Error = 0, Warn = 1, Info = 2, Debug = 3 };
+
+inline LogLevel& log_level() {
+  static LogLevel level = LogLevel::Info;
+  return level;
+}
+
+inline void set_log_level(const Knobs& knobs) {
+  std::string s = knobs.get_str("--log-level", "MAPD_LOG_LEVEL", "info");
+  if (s == "error") log_level() = LogLevel::Error;
+  else if (s == "warn") log_level() = LogLevel::Warn;
+  else if (s == "info") log_level() = LogLevel::Info;
+  else if (s == "debug") log_level() = LogLevel::Debug;
+  else fprintf(stderr, "log: unknown level \"%s\", keeping info\n", s.c_str());
+}
+
+inline void vlog_at(LogLevel lv, const char* fmt, va_list ap) {
+  if (lv > log_level()) return;
+  timeval tv;
+  gettimeofday(&tv, nullptr);
+  tm t;
+  localtime_r(&tv.tv_sec, &t);
+  static const char* tags[] = {"E", "W", "I", "D"};
+  printf("%02d:%02d:%02d.%03d %s ", t.tm_hour, t.tm_min, t.tm_sec,
+         static_cast<int>(tv.tv_usec / 1000), tags[static_cast<int>(lv)]);
+  vprintf(fmt, ap);
+  fflush(stdout);
+}
+
+inline void log_info(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vlog_at(LogLevel::Info, fmt, ap);
+  va_end(ap);
+}
+
+inline void log_debug(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vlog_at(LogLevel::Debug, fmt, ap);
+  va_end(ap);
+}
+
+inline void log_warn(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vlog_at(LogLevel::Warn, fmt, ap);
+  va_end(ap);
+}
+
+inline void log_error(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vlog_at(LogLevel::Error, fmt, ap);
+  va_end(ap);
+}
+
+}  // namespace mapd

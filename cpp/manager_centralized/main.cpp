@@ -40,6 +40,7 @@
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
+#include "../common/log.hpp"
 #include "../common/tswap.hpp"
 
 using namespace mapd;
@@ -63,6 +64,7 @@ struct AgentInfo {
 
 int main(int argc, char** argv) {
   Knobs knobs(argc, argv);
+  set_log_level(knobs);
   const std::string bus_host = knobs.get_str("--host", "MAPD_BUS_HOST",
                                              "127.0.0.1");
   const uint16_t port = static_cast<uint16_t>(
@@ -112,12 +114,11 @@ int main(int argc, char** argv) {
   }
   bus.subscribe("mapd");
   if (solver == "tpu") bus.subscribe("solver");
-  printf("🧠 centralized manager %s up (grid %dx%d, solver=%s%s)\n",
-         my_id.c_str(), grid.width, grid.height, solver.c_str(),
-         clean ? ", clean" : "");
-  printf("Commands: task | tasks N | metrics | save <file> | "
-         "save path <file> | reset | quit\n");
-  fflush(stdout);
+  log_info("🧠 centralized manager %s up (grid %dx%d, solver=%s%s)\n",
+           my_id.c_str(), grid.width, grid.height, solver.c_str(),
+           clean ? ", clean" : "");
+  log_info("Commands: task | tasks N | metrics | save <file> | "
+           "save path <file> | reset | quit\n");
 
   std::map<std::string, AgentInfo> agents;
   std::set<std::string> known_left;
@@ -169,8 +170,8 @@ int main(int argc, char** argv) {
     a.phase = Phase::ToPickup;
     if (auto p = parse_point(task["pickup"])) a.goal = *p;
     bus.publish("mapd", task);
-    printf("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
-           peer.c_str());
+    log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
+             peer.c_str());
   };
 
   // Push an agent's in-flight task back onto the pending queue (front: it
@@ -181,8 +182,8 @@ int main(int argc, char** argv) {
                           const char* why) {
     if (!a.task) return;
     Json t = *a.task;
-    printf("♻️  %s %s, re-queueing task %lld\n", why, peer.c_str(),
-           static_cast<long long>(t["task_id"].as_int()));
+    log_info("♻️  %s %s, re-queueing task %lld\n", why, peer.c_str(),
+             static_cast<long long>(t["task_id"].as_int()));
     t.set("peer_id", Json());
     pending_tasks.push_front(std::move(t));
   };
@@ -228,7 +229,7 @@ int main(int argc, char** argv) {
           if (auto dl = parse_point((*a.task)["delivery"])) {
             a.goal = *dl;
             a.phase = Phase::ToDelivery;
-            printf("📍 %s reached pickup, now -> delivery\n", peer.c_str());
+            log_info("📍 %s reached pickup, now -> delivery\n", peer.c_str());
           }
         }
       }
@@ -297,11 +298,11 @@ int main(int argc, char** argv) {
   auto save_csv = [&](const std::string& path, const std::string& content) {
     std::ofstream out(path);
     if (!out) {
-      printf("⚠️  cannot write %s\n", path.c_str());
+      log_warn("⚠️  cannot write %s\n", path.c_str());
       return;
     }
     out << content;
-    printf("💾 saved %s\n", path.c_str());
+    log_info("💾 saved %s\n", path.c_str());
   };
 
   auto handle_command = [&](const std::string& line) -> bool {
@@ -318,12 +319,12 @@ int main(int argc, char** argv) {
       if (!n) n = agents.size();
       for (size_t k = 0; k < n; ++k) pending_tasks.push_back(make_task());
       try_assign_pending();
-      printf("📦 queued %zu tasks (%zu pending)\n", n, pending_tasks.size());
+      log_info("📦 queued %zu tasks (%zu pending)\n", n, pending_tasks.size());
     } else if (cmd == "metrics") {
-      printf("%s\n", task_metrics.statistics().to_string().c_str());
+      log_info("%s\n", task_metrics.statistics().to_string().c_str());
       if (auto ps = path_metrics.statistics())
-        printf("%s\n", ps->to_string().c_str());
-      printf("%s\n", bus.net_metrics().to_string().c_str());
+        log_info("%s\n", ps->to_string().c_str());
+      log_info("%s\n", bus.net_metrics().to_string().c_str());
     } else if (cmd == "save") {
       std::string a, b;
       in >> a >> b;
@@ -342,14 +343,13 @@ int main(int argc, char** argv) {
         a.phase = Phase::None;
         a.goal = a.pos;
       }
-      printf("🔄 state reset\n");
+      log_info("🔄 state reset\n");
     } else if (!cmd.empty()) {
       Json raw;
       raw.set("raw", line);
       bus.publish("mapd", raw);
     }
-    fflush(stdout);
-    return true;
+      return true;
   };
 
   int64_t last_plan = 0, last_cleanup = mono_ms();
@@ -396,8 +396,8 @@ int main(int argc, char** argv) {
               a.pos = a.goal = *p;
               a.last_seen_ms = mono_ms();
               agents[peer] = a;
-              printf("🔍 tracking agent %s (%zu)\n", peer.c_str(),
-                     agents.size());
+              log_info("🔍 tracking agent %s (%zu)\n", peer.c_str(),
+                       agents.size());
               try_assign_pending();
             } else {
               it->second.pos = *p;
@@ -426,8 +426,8 @@ int main(int argc, char** argv) {
               it->second.phase = Phase::None;
               it->second.goal = it->second.pos;
             }
-            printf("🎉 %s finished task %lld\n", peer.c_str(),
-                   static_cast<long long>(d["task_id"].as_int()));
+            log_info("🎉 %s finished task %lld\n", peer.c_str(),
+                     static_cast<long long>(d["task_id"].as_int()));
             // auto-reassign on completion (ref :908-950): queued tasks
             // (incl. ones re-queued from dead agents) drain before a fresh
             // task is generated, so orphans cannot starve behind auto-refill
@@ -435,8 +435,7 @@ int main(int argc, char** argv) {
               assign_task(peer, make_task());
             try_assign_pending();
           }
-          fflush(stdout);
-        },
+                },
         [&](const Json& ev) {
           if (ev["op"].as_str() == "peer_left") {
             const std::string& peer = ev["peer_id"].as_str();
@@ -447,8 +446,7 @@ int main(int argc, char** argv) {
               requeue_task(peer, it->second, "agent died:");
               agents.erase(it);
               try_assign_pending();
-              fflush(stdout);
-            }
+                        }
           }
         });
     if (!alive) break;
@@ -492,8 +490,8 @@ int main(int argc, char** argv) {
                   || it->second.last_seen_ms < oldest->second.last_seen_ms))
             oldest = it;
         if (oldest == agents.end()) {
-          printf("⚠️  %zu agents exceed cap %zu but all are busy; "
-                 "deferring trim\n", agents.size(), max_agents);
+          log_warn("⚠️  %zu agents exceed cap %zu but all are busy; "
+                   "deferring trim\n", agents.size(), max_agents);
           break;
         }
         agents.erase(oldest);
@@ -502,18 +500,17 @@ int main(int argc, char** argv) {
         known_left.erase(known_left.begin());
       try_assign_pending();
       dc.trim(512);
-      printf("🧹 [CLEANUP] agents=%zu pending=%zu\n", agents.size(),
-             pending_tasks.size());
-      fflush(stdout);
-    }
+      log_info("🧹 [CLEANUP] agents=%zu pending=%zu\n", agents.size(),
+               pending_tasks.size());
+        }
   }
 
   if (const char* p = getenv("TASK_CSV_PATH"))
     save_csv(p, task_metrics.to_csv_string());
   if (const char* p = getenv("PATH_CSV_PATH"))
     save_csv(p, path_metrics.to_csv_string());
-  printf("%s\n", task_metrics.statistics().to_string().c_str());
-  printf("manager: bye\n");
+  log_info("%s\n", task_metrics.statistics().to_string().c_str());
+  log_info("manager: bye\n");
   bus.close();
   return 0;
 }

@@ -34,6 +34,7 @@
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
+#include "../common/log.hpp"
 
 using namespace mapd;
 
@@ -46,6 +47,7 @@ void handle_stop(int) { g_stop = 1; }
 
 int main(int argc, char** argv) {
   Knobs knobs(argc, argv);
+  set_log_level(knobs);
   const std::string bus_host = knobs.get_str("--host", "MAPD_BUS_HOST",
                                              "127.0.0.1");
   const uint16_t port = static_cast<uint16_t>(
@@ -88,11 +90,10 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
-  printf("🧠 decentralized manager %s up (grid %dx%d)\n", my_id.c_str(),
-         grid.width, grid.height);
-  printf("Commands: task | tasks N | metrics | save <file> | "
-         "save path <file> | reset | quit\n");
-  fflush(stdout);
+  log_info("🧠 decentralized manager %s up (grid %dx%d)\n", my_id.c_str(),
+           grid.width, grid.height);
+  log_info("Commands: task | tasks N | metrics | save <file> | "
+           "save path <file> | reset | quit\n");
 
   std::set<std::string> subscribed_peers;
   std::set<std::string> known_left;  // --clean: never re-add these
@@ -116,8 +117,8 @@ int main(int argc, char** argv) {
     task_metrics.add_metric(m);
     peer_busy[peer] = t;
     bus.publish("mapd", t);
-    printf("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
-           peer.c_str());
+    log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
+             peer.c_str());
   };
 
   auto send_task_to = [&](const std::string& peer) {
@@ -148,8 +149,8 @@ int main(int argc, char** argv) {
       if (free_peer.empty()) return;
       Json t = requeue.front();
       requeue.pop_front();
-      printf("♻️  re-dispatching orphaned task %lld\n",
-             static_cast<long long>(t["task_id"].as_int()));
+      log_info("♻️  re-dispatching orphaned task %lld\n",
+               static_cast<long long>(t["task_id"].as_int()));
       dispatch_task(free_peer, std::move(t));
     }
   };
@@ -157,7 +158,7 @@ int main(int argc, char** argv) {
   auto assign_round_robin = [&](size_t count) {
     // ref :256-329: rounds over non-busy subscribed peers until count sent
     if (subscribed_peers.empty()) {
-      printf("⚠️  no subscribed peers\n");
+      log_warn("⚠️  no subscribed peers\n");
       return;
     }
     size_t sent = 0;
@@ -172,17 +173,17 @@ int main(int argc, char** argv) {
       }
       if (sent_this_round == 0) break;  // everyone busy
     }
-    printf("📦 dispatched %zu/%zu tasks\n", sent, count);
+    log_info("📦 dispatched %zu/%zu tasks\n", sent, count);
   };
 
   auto save_csv = [&](const std::string& path, const std::string& content) {
     std::ofstream out(path);
     if (!out) {
-      printf("⚠️  cannot write %s\n", path.c_str());
+      log_warn("⚠️  cannot write %s\n", path.c_str());
       return;
     }
     out << content;
-    printf("💾 saved %s\n", path.c_str());
+    log_info("💾 saved %s\n", path.c_str());
   };
 
   auto handle_command = [&](const std::string& line) -> bool {
@@ -197,17 +198,17 @@ int main(int argc, char** argv) {
           send_task_to(peer);
           return true;
         }
-      printf("⚠️  all peers busy\n");
+      log_warn("⚠️  all peers busy\n");
     } else if (cmd == "tasks") {
       size_t n = 0;
       in >> n;
       drain_requeue();
       assign_round_robin(n ? n : subscribed_peers.size());
     } else if (cmd == "metrics") {
-      printf("%s\n", task_metrics.statistics().to_string().c_str());
+      log_info("%s\n", task_metrics.statistics().to_string().c_str());
       if (auto ps = path_metrics.statistics())
-        printf("%s\n", ps->to_string().c_str());
-      printf("%s\n", bus.net_metrics().to_string().c_str());
+        log_info("%s\n", ps->to_string().c_str());
+      log_info("%s\n", bus.net_metrics().to_string().c_str());
     } else if (cmd == "save") {
       std::string a, b;
       in >> a >> b;
@@ -222,14 +223,13 @@ int main(int argc, char** argv) {
       path_metrics.clear();
       peer_busy.clear();
       requeue.clear();
-      printf("🔄 state reset\n");
+      log_info("🔄 state reset\n");
     } else if (!cmd.empty()) {
       Json raw;  // unknown lines broadcast raw (ref :389-395)
       raw.set("raw", line);
       bus.publish("mapd", raw);
     }
-    fflush(stdout);
-    return true;
+      return true;
   };
 
   bus.query_peers("mapd");
@@ -310,8 +310,8 @@ int main(int argc, char** argv) {
             // closed loop: fresh task for that peer immediately (ref :527-560)
             const std::string& peer = m.from;
             peer_busy.erase(peer);
-            printf("🎉 %s finished task %lld\n", peer.c_str(),
-                   static_cast<long long>(d["task_id"].as_int()));
+            log_info("🎉 %s finished task %lld\n", peer.c_str(),
+                     static_cast<long long>(d["task_id"].as_int()));
             if (!requeue.empty())
               drain_requeue();  // orphans take priority over fresh tasks
             if (!peer_busy.count(peer) && subscribed_peers.count(peer))
@@ -324,8 +324,8 @@ int main(int argc, char** argv) {
             const std::string& peer = ev["peer_id"].as_str();
             if (clean && known_left.count(peer)) return;
             subscribed_peers.insert(peer);
-            printf("🔍 peer joined: %s (%zu peers)\n", peer.c_str(),
-                   subscribed_peers.size());
+            log_info("🔍 peer joined: %s (%zu peers)\n", peer.c_str(),
+                     subscribed_peers.size());
             drain_requeue();
           } else if (op == "peer_left") {
             const std::string& peer = ev["peer_id"].as_str();
@@ -338,21 +338,20 @@ int main(int argc, char** argv) {
               // only cleans the mapping and the task is lost
               // (src/bin/decentralized/manager.rs:185-189, documented
               // flaw; SURVEY §5).
-              printf("♻️  peer %s died with task %lld in flight, "
-                     "re-queueing\n", peer.c_str(),
-                     static_cast<long long>(
-                         busy->second["task_id"].as_int()));
+              log_info("♻️  peer %s died with task %lld in flight, "
+                       "re-queueing\n", peer.c_str(),
+                       static_cast<long long>(
+                       busy->second["task_id"].as_int()));
               requeue.push_back(std::move(busy->second));
               peer_busy.erase(busy);
               drain_requeue();
             }
-            printf("👋 peer left: %s\n", peer.c_str());
+            log_info("👋 peer left: %s\n", peer.c_str());
           } else if (op == "peers") {
             for (const auto& p : ev["peers"].as_array())
               subscribed_peers.insert(p.as_str());
           }
-          fflush(stdout);
-        });
+                });
     if (!alive) break;
 
     int64_t now = mono_ms();
@@ -362,11 +361,10 @@ int main(int argc, char** argv) {
         subscribed_peers.erase(subscribed_peers.begin());
       while (peer_positions.size() > max_positions)
         peer_positions.erase(peer_positions.begin());
-      printf("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu requeue=%zu\n",
-             subscribed_peers.size(), peer_positions.size(),
-             peer_busy.size(), requeue.size());
-      fflush(stdout);
-    }
+      log_info("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu requeue=%zu\n",
+               subscribed_peers.size(), peer_positions.size(),
+               peer_busy.size(), requeue.size());
+        }
   }
 
   // graceful exit: env-var CSV auto-save (ref :48-50, :570-584)
@@ -374,8 +372,8 @@ int main(int argc, char** argv) {
     save_csv(p, task_metrics.to_csv_string());
   if (const char* p = getenv("PATH_CSV_PATH"))
     save_csv(p, path_metrics.to_csv_string());
-  printf("%s\n", task_metrics.statistics().to_string().c_str());
-  printf("manager: bye\n");
+  log_info("%s\n", task_metrics.statistics().to_string().c_str());
+  log_info("manager: bye\n");
   bus.close();
   return 0;
 }

@@ -23,6 +23,7 @@
 #include "../common/bus.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
+#include "../common/log.hpp"
 
 using namespace mapd;
 
@@ -33,6 +34,7 @@ void handle_stop(int) { g_stop = 1; }
 
 int main(int argc, char** argv) {
   Knobs knobs(argc, argv);
+  set_log_level(knobs);
   const std::string host = knobs.get_str("--host", "MAPD_BUS_HOST",
                                          "127.0.0.1");
   const uint16_t port = static_cast<uint16_t>(
@@ -53,10 +55,9 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe(topic);
-  printf("💬 chat probe %s on topic \"%s\" — type to broadcast, "
-         "/post <text> for an sns-style post, /quit to exit\n",
-         my_id.c_str(), topic.c_str());
-  fflush(stdout);
+  log_info("💬 chat probe %s on topic \"%s\" — type to broadcast, "
+           "/post <text> for an sns-style post, /quit to exit\n",
+           my_id.c_str(), topic.c_str());
 
   std::string stdin_buf;
   bool running = true;
@@ -102,6 +103,8 @@ int main(int argc, char** argv) {
     bool alive = bus.pump(
         [&](const BusClient::Msg& msg) {
           const Json& d = msg.data;
+          // received messages are the probe's product output, not
+          // diagnostics: always print, independent of --log-level
           if (d["type"].as_str() == "post")
             printf("📝 [%s] %s\n", d["author"].as_str().c_str(),
                    d["content"].as_str().c_str());
@@ -111,18 +114,17 @@ int main(int argc, char** argv) {
           else
             printf("📦 %s\n", d.dump().c_str());
           fflush(stdout);
-        },
+                },
         [&](const Json& ev) {
           const std::string& op = ev["op"].as_str();
           if (op == "peer_joined")
-            printf("🔍 peer joined: %s\n", ev["peer_id"].as_str().c_str());
+            log_info("🔍 peer joined: %s\n", ev["peer_id"].as_str().c_str());
           else if (op == "peer_left")
-            printf("👋 peer left: %s\n", ev["peer_id"].as_str().c_str());
-          fflush(stdout);
-        });
+            log_info("👋 peer left: %s\n", ev["peer_id"].as_str().c_str());
+                });
     if (!alive) break;
   }
-  printf("chat: bye\n");
+  log_info("chat: bye\n");
   bus.close();
   return 0;
 }

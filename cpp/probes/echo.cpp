@@ -24,6 +24,7 @@
 #include "../common/bus.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
+#include "../common/log.hpp"
 
 using namespace mapd;
 
@@ -46,6 +47,7 @@ std::string random_hex(std::mt19937_64& rng, size_t nbytes) {
 
 int main(int argc, char** argv) {
   Knobs knobs(argc, argv);
+  set_log_level(knobs);
   const std::string host = knobs.get_str("--host", "MAPD_BUS_HOST",
                                          "127.0.0.1");
   const uint16_t port = static_cast<uint16_t>(
@@ -76,10 +78,9 @@ int main(int argc, char** argv) {
   bus.subscribe(topic);
 
   if (server) {
-    printf("🔁 echo server %s on topic \"%s\"\n", my_id.c_str(),
-           topic.c_str());
-    fflush(stdout);
-    while (!g_stop && bus.connected()) {
+    log_info("🔁 echo server %s on topic \"%s\"\n", my_id.c_str(),
+             topic.c_str());
+      while (!g_stop && bus.connected()) {
       pollfd pfd{bus.fd(),
                  static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)),
                  0};
@@ -142,10 +143,9 @@ int main(int argc, char** argv) {
       printf("✅ echo %d/%d verified (%zu bytes)\n", k + 1, count,
              payload.size());
     } else {
-      printf("❌ echo %d/%d FAILED (timeout or mismatch)\n", k + 1, count);
+      log_warn("❌ echo %d/%d FAILED (timeout or mismatch)\n", k + 1, count);
     }
-    fflush(stdout);
-  }
+    }
   bus.close();
   printf("echo client: %d/%d verified\n", ok, count);
   return ok == count ? 0 : 1;

@@ -109,6 +109,9 @@ class RuntimeConfig:
     bus_host: str = "127.0.0.1"
     bus_port: int = 7400
     topic: str = "mapd"
+    # C++ binaries' log verbosity: error | warn | info | debug
+    # (cpp/common/log.hpp; per-decision chatter sits at debug).
+    log_level: str = "info"
     # CSV auto-save on exit (ref env vars TASK_CSV_PATH / PATH_CSV_PATH,
     # src/bin/decentralized/manager.rs:48-50).
     task_csv_path: Optional[str] = None
@@ -136,6 +139,7 @@ class RuntimeConfig:
             "MAPD_SWAP_TIMEOUT_MS": self.swap_timeout_ms,
             "MAPD_HEARTBEAT_MS": self.heartbeat_ms,
             "MAPD_AGENT_STALE_MS": self.agent_stale_ms,
+            "MAPD_LOG_LEVEL": self.log_level,
         }
         if self.task_csv_path:
             env["TASK_CSV_PATH"] = self.task_csv_path

@@ -45,7 +45,8 @@ class Fleet:
                  port: int = 7450, map_file: Optional[str] = None,
                  solver: str = "cpu", log_dir: Optional[str] = None,
                  env: Optional[dict] = None,
-                 config: Optional[RuntimeConfig] = None):
+                 config: Optional[RuntimeConfig] = None,
+                 solverd_args: Optional[List[str]] = None):
         assert mode in ("centralized", "decentralized")
         build = ensure_built()
         self.procs: List[subprocess.Popen] = []
@@ -80,7 +81,8 @@ class Fleet:
             spawn("solverd",
                   [sys.executable, "-m",
                    "p2p_distributed_tswap_tpu.runtime.solverd",
-                   "--port", str(port), *map_args])
+                   "--port", str(port), *map_args,
+                   *(solverd_args or [])])
             time.sleep(8)  # accelerator init headroom
         mgr_cmd = [str(build / f"mapd_manager_{mode}"), "--port", str(port),
                    *map_args]

@@ -192,6 +192,35 @@ def test_solverd_drops_stale_requests_and_reports_recompiles(built):
         bus.terminate()
 
 
+def test_centralized_tpu_solver_fleet(built, tiny_map, tmp_path):
+    """The north-star deployment shape (BASELINE.json): centralized manager
+    with --solver=tpu delegating each planning tick to the JAX solver
+    daemon over the bus, end to end until tasks complete.  solverd runs
+    --cpu here so CI needs no accelerator — the daemon's program is
+    backend-agnostic."""
+    log_dir = tmp_path / "logs"
+    with Fleet("centralized", num_agents=2, port=_free_port(),
+               map_file=tiny_map, solver="tpu", log_dir=str(log_dir),
+               solverd_args=["--cpu"]) as fleet:
+        time.sleep(4)
+        fleet.command("tasks 2")
+
+        def agents_done():
+            done = 0
+            for f in log_dir.glob("agent_*.log"):
+                done += f.read_text(errors="ignore").count("DONE")
+            return done >= 2
+
+        completed = _wait_for(agents_done, timeout=60)
+        fleet.quit()
+        solverd_log = (log_dir / "solverd.log").read_text(errors="ignore")
+        assert completed, "".join(
+            f.read_text(errors="ignore")[-500:]
+            for f in sorted(log_dir.glob("*.log")))
+        # the moves must actually have come from the daemon
+        assert "solverd up" in solverd_log
+
+
 def test_echo_probe_self_validates(built):
     """The C13 stream-demo equivalent: echo client sends random payloads and
     byte-verifies every echo (ref stream.rs:139-156 self-validation); exit 0
