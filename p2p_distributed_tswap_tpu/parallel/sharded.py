@@ -158,6 +158,13 @@ def make_sharded_runner(cfg: SolverConfig, mesh: Mesh | None = None,
             s = s.replace(task_used=jnp.ones(1, bool))
         else:
             s = init_state(cfg, starts, tasks.shape[0])
+        # pre-loop transitions + first assignment, matching
+        # mapd.prepare_state's ordering (an agent starting ON its assigned
+        # pickup flips to delivery in the first step's transitions) so
+        # sharded runs stay bit-identical to the single-device solver;
+        # both are replicated ops, no collectives needed
+        s = mapd_mod._transitions(cfg, s, tasks)
+        s = mapd_mod._assign(cfg, s, tasks)
         return run_shard(s, tasks, free)
 
     return run

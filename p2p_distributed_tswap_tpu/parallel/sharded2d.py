@@ -194,6 +194,11 @@ def make_sharded2d_runner(cfg: SolverConfig, mesh: Mesh):
             s = s.replace(task_used=jnp.ones(1, bool))
         else:
             s = init_state(cfg, starts, tasks.shape[0])
+        # match mapd.prepare_state's pre-loop transitions + assignment so
+        # sharded runs stay bit-identical to the single-device solver
+        # (see parallel/sharded.py for the ordering rationale)
+        s = mapd_mod._transitions(cfg, s, tasks)
+        s = mapd_mod._assign(cfg, s, tasks)
         return run_shard(s, tasks, free)
 
     return run

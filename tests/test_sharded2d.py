@@ -40,6 +40,24 @@ def test_sharded2d_matches_single_device(grid_fn, na, nt, mesh_shape):
     np.testing.assert_array_equal(s1, s2)
 
 
+def test_sharded2d_push_extension_bit_identical():
+    """Shared-delivery deadlock instance (two tasks, one delivery cell):
+    the push extension must fire identically under 2-D sharding."""
+    grid = Grid.from_ascii("\n".join(["." * 16] * 16))
+    starts = np.asarray([grid.idx((0, 0)), grid.idx((15, 0)),
+                         grid.idx((0, 15)), grid.idx((15, 15))], np.int32)
+    tasks = np.asarray([[grid.idx((0, 0)), grid.idx((8, 8))],
+                        [grid.idx((15, 0)), grid.idx((8, 8))],
+                        [grid.idx((0, 15)), grid.idx((8, 8))],
+                        [grid.idx((15, 15)), grid.idx((8, 8))]], np.int32)
+    p1, s1, mk1 = solve_offline(grid, starts, tasks)
+    assert 0 < mk1 < 200, "single-device solve must resolve the pile-up"
+    p2, s2, mk2 = solve_offline_sharded2d(grid, starts, tasks,
+                                          mesh=agent_tile_mesh(2, 4))
+    assert mk1 == mk2
+    np.testing.assert_array_equal(p1, p2)
+
+
 def test_sharded2d_rejects_bad_divisibility():
     grid = Grid.from_ascii("\n".join(["." * 32] * 30))  # H=30 not % 4
     starts, tasks = _scenario(grid, 8, 4, seed=0)
