@@ -90,6 +90,10 @@ int main(int argc, char** argv) {
                     1000));                                    // ref :752
   const int64_t agent_stale_ms =
       knobs.get_int("--agent-stale-ms", "MAPD_AGENT_STALE_MS", 60000);
+  // --solver=tpu resilience: plan natively while the solver daemon has
+  // been silent this long (the fleet must not stall if solverd dies).
+  const int64_t solver_failover_ms =
+      knobs.get_int("--solver-failover-ms", "MAPD_SOLVER_FAILOVER_MS", 5000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -274,8 +278,24 @@ int main(int argc, char** argv) {
     bus.publish("solver", req);
   };
 
+  int64_t last_plan_response = mono_ms();
+  bool failed_over = false;
+
   auto handle_plan_response = [&](const Json& d) {
     if (d["seq"].as_int() != plan_seq) return;  // stale tick
+    // Only FRESH (applied) responses prove the daemon useful: a daemon
+    // whose latency always exceeds the planning tick produces nothing but
+    // stale responses, and counting those as liveness would suppress the
+    // failover while no plan of its ever lands.
+    last_plan_response = mono_ms();
+    if (failed_over) {
+      failed_over = false;
+      log_info("🔌 solver daemon responding again; leaving native "
+               "failover\n");
+      // this tick's moves were already planned natively — applying the
+      // daemon's plan too would send agents two conflicting instructions
+      return;
+    }
     int64_t us = d["duration_micros"].as_int();
     path_metrics.record_micros(us, unix_ms());
     std::vector<std::string> ids;
@@ -456,10 +476,23 @@ int main(int argc, char** argv) {
       last_plan = now;
       pickup_transitions();
       if (!agents.empty()) {
-        if (solver == "tpu")
+        if (solver == "tpu") {
+          // keep requesting so a revived daemon ends the failover, but
+          // plan natively while it is silent — the fleet must keep moving
+          // (the reference has no comparable resilience path)
           plan_request_tpu();
-        else
+          if (now - last_plan_response > solver_failover_ms) {
+            if (!failed_over) {
+              failed_over = true;
+              log_warn("⚠️  solver daemon silent for %lld ms; planning "
+                       "natively until it responds\n",
+                       static_cast<long long>(now - last_plan_response));
+            }
+            plan_native();
+          }
+        } else {
           plan_native();
+        }
       }
     }
     if (now - last_cleanup > cleanup_ms) {
