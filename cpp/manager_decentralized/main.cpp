@@ -68,6 +68,10 @@ int main(int argc, char** argv) {
   const size_t max_positions = static_cast<size_t>(
       knobs.get_int("--max-cached-positions", "MAPD_MAX_CACHED_POSITIONS",
                     60));
+  // busy peers silent this long are treated as dead: task re-queued, peer
+  // dropped (a mute-but-connected peer never emits peer_left)
+  const int64_t agent_stale_ms =
+      knobs.get_int("--agent-stale-ms", "MAPD_AGENT_STALE_MS", 60000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -98,6 +102,7 @@ int main(int argc, char** argv) {
   std::set<std::string> subscribed_peers;
   std::set<std::string> known_left;  // --clean: never re-add these
   std::map<std::string, Cell> peer_positions;
+  std::map<std::string, int64_t> peer_last_seen;  // position_update times
   std::map<std::string, Json> peer_busy;   // peer -> active task (full JSON)
   std::deque<Json> requeue;                // tasks orphaned by dead peers
   TaskMetricsCollector task_metrics;
@@ -275,6 +280,7 @@ int main(int argc, char** argv) {
                 peer_positions[d["peer_id"].as_str()] = grid.cell(x, y);
             }
             subscribed_peers.insert(d["peer_id"].as_str());
+            peer_last_seen[d["peer_id"].as_str()] = mono_ms();
           } else if (type == "occupied_request") {
             // manager answers with ALL known positions (ref :441-468)
             Json occ;
@@ -332,6 +338,7 @@ int main(int argc, char** argv) {
             known_left.insert(peer);
             subscribed_peers.erase(peer);
             peer_positions.erase(peer);
+            peer_last_seen.erase(peer);
             auto busy = peer_busy.find(peer);
             if (busy != peer_busy.end()) {
               // Re-queue the dead peer's in-flight task — the reference
@@ -357,6 +364,27 @@ int main(int argc, char** argv) {
     int64_t now = mono_ms();
     if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;
+      // Mute-but-connected busy peers: re-queue their tasks and drop them,
+      // mirroring the centralized manager's stale age-out (the reference
+      // loses the task in every such case).
+      for (auto it = peer_busy.begin(); it != peer_busy.end();) {
+        auto seen = peer_last_seen.find(it->first);
+        if (seen != peer_last_seen.end()
+            && now - seen->second > agent_stale_ms) {
+          log_info("♻️  peer %s silent for %lld ms with task %lld in "
+                   "flight, re-queueing\n", it->first.c_str(),
+                   static_cast<long long>(now - seen->second),
+                   static_cast<long long>(it->second["task_id"].as_int()));
+          requeue.push_back(std::move(it->second));
+          subscribed_peers.erase(it->first);
+          peer_positions.erase(it->first);
+          peer_last_seen.erase(it->first);
+          it = peer_busy.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      drain_requeue();
       while (subscribed_peers.size() > max_peers)
         subscribed_peers.erase(subscribed_peers.begin());
       while (peer_positions.size() > max_positions)

@@ -223,6 +223,49 @@ def test_centralized_tpu_solver_fleet(built, tiny_map, tmp_path):
         assert "solverd up" in solverd_log
 
 
+def test_task_requeued_on_mute_agent(built, tiny_map, tmp_path):
+    """SIGSTOP an agent mid-task: its TCP stays open (no peer_left), but the
+    decentralized manager's stale sweep must re-queue the task so another
+    agent completes it.  The reference loses the task (and never detects
+    mute peers at all)."""
+    import signal as sig
+
+    from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
+
+    log_dir = tmp_path / "logs"
+    csv = tmp_path / "task_metrics.csv"
+    cfg = RuntimeConfig(agent_stale_ms=3000, cleanup_interval_ms=1500)
+    with Fleet("decentralized", num_agents=3, port=_free_port(),
+               map_file=tiny_map, log_dir=str(log_dir),
+               config=cfg) as fleet:
+        time.sleep(4)
+        fleet.command("tasks 3")
+        manager_log = log_dir / "manager.log"
+        assert _wait_for(
+            lambda: manager_log.read_text(errors="ignore").count("📤") >= 3,
+            timeout=15), "tasks not dispatched"
+        time.sleep(1.0)
+        victim = fleet.procs[2]
+        victim.send_signal(sig.SIGSTOP)  # mute, not dead: no peer_left
+
+        def initial_tasks_done():
+            fleet.command(f"save {csv}")
+            time.sleep(0.5)
+            if not csv.exists():
+                return False
+            done = {int(r.split(",")[0])
+                    for r in csv.read_text().splitlines()[1:]
+                    if r.endswith(",completed")}
+            return {1, 2, 3} <= done
+
+        completed = _wait_for(initial_tasks_done, timeout=60, interval=2)
+        log = manager_log.read_text(errors="ignore")
+        victim.send_signal(sig.SIGCONT)  # let close() terminate it cleanly
+        fleet.quit()
+        assert "silent for" in log and "re-queueing" in log, log[-1500:]
+        assert completed, log[-1500:]
+
+
 def test_tpu_solver_failover_to_native(built, tiny_map, tmp_path):
     """Kill the solver daemon mid-run: the manager must fail over to its
     native sequential TSWAP (logging the transition) and the fleet must
