@@ -121,6 +121,7 @@ int main(int argc, char** argv) {
     m.sent_time = unix_ms();
     task_metrics.add_metric(m);
     peer_busy[peer] = t;
+    peer_last_seen.emplace(peer, mono_ms());  // monitor from dispatch
     bus.publish("mapd", t);
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
              peer.c_str());
@@ -364,31 +365,41 @@ int main(int argc, char** argv) {
     int64_t now = mono_ms();
     if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;
-      // Mute-but-connected busy peers: re-queue their tasks and drop them,
-      // mirroring the centralized manager's stale age-out (the reference
-      // loses the task in every such case).
-      for (auto it = peer_busy.begin(); it != peer_busy.end();) {
-        auto seen = peer_last_seen.find(it->first);
-        if (seen != peer_last_seen.end()
-            && now - seen->second > agent_stale_ms) {
-          log_info("♻️  peer %s silent for %lld ms with task %lld in "
-                   "flight, re-queueing\n", it->first.c_str(),
-                   static_cast<long long>(now - seen->second),
-                   static_cast<long long>(it->second["task_id"].as_int()));
-          requeue.push_back(std::move(it->second));
-          subscribed_peers.erase(it->first);
-          peer_positions.erase(it->first);
-          peer_last_seen.erase(it->first);
-          it = peer_busy.erase(it);
-        } else {
+      // Mute-but-connected peers (no peer_left ever fires): drop ALL
+      // tracking — an idle frozen peer would otherwise haunt every
+      // occupied_response with a phantom position — and re-queue the
+      // tasks of busy ones, mirroring the centralized manager's stale
+      // age-out (the reference loses the task in every such case).
+      for (auto it = peer_last_seen.begin(); it != peer_last_seen.end();) {
+        if (now - it->second <= agent_stale_ms) {
           ++it;
+          continue;
         }
+        const std::string peer = it->first;
+        auto busy = peer_busy.find(peer);
+        if (busy != peer_busy.end()) {
+          log_info("♻️  peer %s silent for %lld ms with task %lld in "
+                   "flight, re-queueing\n", peer.c_str(),
+                   static_cast<long long>(now - it->second),
+                   static_cast<long long>(
+                       busy->second["task_id"].as_int()));
+          requeue.push_back(std::move(busy->second));
+          peer_busy.erase(busy);
+        } else {
+          log_info("🧹 dropping silent peer %s (%lld ms)\n", peer.c_str(),
+                   static_cast<long long>(now - it->second));
+        }
+        subscribed_peers.erase(peer);
+        peer_positions.erase(peer);
+        it = peer_last_seen.erase(it);
       }
       drain_requeue();
       while (subscribed_peers.size() > max_peers)
         subscribed_peers.erase(subscribed_peers.begin());
       while (peer_positions.size() > max_positions)
         peer_positions.erase(peer_positions.begin());
+      while (peer_last_seen.size() > max_peers)
+        peer_last_seen.erase(peer_last_seen.begin());
       log_info("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu requeue=%zu\n",
                subscribed_peers.size(), peer_positions.size(),
                peer_busy.size(), requeue.size());

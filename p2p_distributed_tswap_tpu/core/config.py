@@ -103,8 +103,12 @@ class RuntimeConfig:
     swap_timeout_ms: int = 2_000
     # Centralized agent position heartbeat (ref >=1 s, centralized/agent.rs:285-291).
     heartbeat_ms: int = 1_000
-    # Centralized manager drops agents unseen for this long (ref 60 s).
+    # Managers treat agents/peers unseen for this long as dead: tracking
+    # dropped and (beyond the reference) in-flight tasks re-queued.
     agent_stale_ms: int = 60_000
+    # Centralized --solver=tpu: plan natively while the solver daemon has
+    # produced no fresh response for this long (fleet must not stall).
+    solver_failover_ms: int = 5_000
     # Bus endpoint.
     bus_host: str = "127.0.0.1"
     bus_port: int = 7400
@@ -139,6 +143,7 @@ class RuntimeConfig:
             "MAPD_SWAP_TIMEOUT_MS": self.swap_timeout_ms,
             "MAPD_HEARTBEAT_MS": self.heartbeat_ms,
             "MAPD_AGENT_STALE_MS": self.agent_stale_ms,
+            "MAPD_SOLVER_FAILOVER_MS": self.solver_failover_ms,
             "MAPD_LOG_LEVEL": self.log_level,
         }
         if self.task_csv_path:

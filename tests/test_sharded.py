@@ -36,6 +36,25 @@ def test_sharded_matches_single_device(grid_fn, na, nt):
     np.testing.assert_array_equal(s1, s8)
 
 
+def test_sharded_push_extension_bit_identical():
+    """Shared-delivery deadlock instance: the push extension must fire
+    identically under agent-axis sharding (pre-loop assignment ordering
+    included)."""
+    grid = Grid.from_ascii("\n".join(["." * 16] * 16))
+    starts = np.asarray([grid.idx((0, 0)), grid.idx((15, 0)),
+                         grid.idx((0, 15)), grid.idx((15, 15)),
+                         grid.idx((7, 0)), grid.idx((8, 15)),
+                         grid.idx((0, 7)), grid.idx((15, 8))], np.int32)
+    tasks = np.asarray([[int(s), grid.idx((8, 8))] for s in starts],
+                       np.int32)
+    p1, s1, mk1 = solve_offline(grid, starts, tasks)
+    assert 0 < mk1 < 300
+    p2, s2, mk2 = solve_offline_sharded(grid, starts, tasks)
+    assert mk1 == mk2
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_array_equal(s1, s2)
+
+
 def test_mesh_and_uneven_agents_rejected():
     grid = Grid.from_ascii("\n".join(["." * 10] * 10))
     starts = start_positions_array(grid, 6, seed=0)  # 6 % 8 != 0

@@ -56,6 +56,7 @@ def test_sharded2d_push_extension_bit_identical():
                                           mesh=agent_tile_mesh(2, 4))
     assert mk1 == mk2
     np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_array_equal(s1, s2)
 
 
 def test_sharded2d_rejects_bad_divisibility():
