@@ -398,8 +398,20 @@ int main(int argc, char** argv) {
         subscribed_peers.erase(subscribed_peers.begin());
       while (peer_positions.size() > max_positions)
         peer_positions.erase(peer_positions.begin());
-      while (peer_last_seen.size() > max_peers)
-        peer_last_seen.erase(peer_last_seen.begin());
+      // cap the liveness clock map by evicting the OLDEST non-busy entry
+      // (id-order eviction would blind mute-detection for arbitrary peers;
+      // busy peers must stay monitored or their tasks could be lost)
+      while (peer_last_seen.size() > max_peers) {
+        auto oldest = peer_last_seen.end();
+        for (auto it = peer_last_seen.begin(); it != peer_last_seen.end();
+             ++it)
+          if (!peer_busy.count(it->first)
+              && (oldest == peer_last_seen.end()
+                  || it->second < oldest->second))
+            oldest = it;
+        if (oldest == peer_last_seen.end()) break;  // all busy: soft cap
+        peer_last_seen.erase(oldest);
+      }
       log_info("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu requeue=%zu\n",
                subscribed_peers.size(), peer_positions.size(),
                peer_busy.size(), requeue.size());

@@ -1,0 +1,70 @@
+"""Checkpoint/resume: a solve interrupted, saved, reloaded, and continued
+must be bit-identical to the uninterrupted solve (the solver is fully
+deterministic).  The reference has no persistence at all (SURVEY §5)."""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.core.sampling import start_positions_array
+from p2p_distributed_tswap_tpu.core.tasks import TaskGenerator
+from p2p_distributed_tswap_tpu.solver import mapd
+from p2p_distributed_tswap_tpu.solver.checkpoint import load_state, save_state
+
+
+def _stepwise_solve(cfg, s, tasks_j, free_j, step):
+    done = jax.jit(functools.partial(mapd._finished, cfg))
+    while not bool(done(s)):
+        s = step(s, tasks_j, free_j)
+    return s
+
+
+def test_save_resume_bit_identical(tmp_path):
+    grid = Grid.random_obstacles(24, 24, 0.15, seed=2)
+    n, t = 8, 10
+    cfg = SolverConfig(height=24, width=24, num_agents=n)
+    starts = start_positions_array(grid, n, seed=0)
+    tasks = TaskGenerator(grid, seed=1).generate_task_arrays(t)
+    free_j = jnp.asarray(grid.free)
+    step = jax.jit(functools.partial(mapd.mapd_step, cfg))
+    prep = jax.jit(functools.partial(mapd.prepare_state, cfg))
+
+    # uninterrupted reference run
+    s_ref, tasks_j = prep(jnp.asarray(starts, jnp.int32),
+                          jnp.asarray(tasks, jnp.int32), free_j)
+    s_ref = _stepwise_solve(cfg, s_ref, tasks_j, free_j, step)
+
+    # interrupted run: step 5 times, checkpoint, reload, continue
+    s, tasks_j2 = prep(jnp.asarray(starts, jnp.int32),
+                       jnp.asarray(tasks, jnp.int32), free_j)
+    for _ in range(5):
+        s = step(s, tasks_j2, free_j)
+    ckpt = str(tmp_path / "solve.npz")
+    save_state(ckpt, s)
+    restored = load_state(ckpt)
+    # the restored tree matches what was saved, dtypes included
+    for name in ("pos", "goal", "slot", "dirs", "phase", "task_used", "t"):
+        a, b = getattr(s, name), getattr(restored, name)
+        assert a.dtype == b.dtype, name
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s2 = _stepwise_solve(cfg, restored, tasks_j2, free_j, step)
+
+    assert int(s_ref.t) == int(s2.t)
+    np.testing.assert_array_equal(np.asarray(s_ref.paths_pos),
+                                  np.asarray(s2.paths_pos))
+    np.testing.assert_array_equal(np.asarray(s_ref.paths_state),
+                                  np.asarray(s2.paths_state))
+    np.testing.assert_array_equal(np.asarray(s_ref.pos), np.asarray(s2.pos))
+
+
+def test_load_rejects_bad_archive(tmp_path):
+    import pytest
+
+    p = str(tmp_path / "bad.npz")
+    np.savez_compressed(p, __format_version__=999, pos=np.zeros(3))
+    with pytest.raises(ValueError, match="format"):
+        load_state(p)
