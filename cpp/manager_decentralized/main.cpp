@@ -26,6 +26,7 @@
 #include <set>
 #include <sstream>
 #include <string>
+#include <type_traits>
 #include <vector>
 
 #include <deque>
@@ -394,24 +395,45 @@ int main(int argc, char** argv) {
         it = peer_last_seen.erase(it);
       }
       drain_requeue();
+      // Cap enforcement evicts the chosen peer from ALL tracking maps at
+      // once so they never drift apart (a peer dropped from the liveness
+      // clock but kept in peer_positions would haunt occupied_response
+      // unmonitored).  Victim = oldest-seen non-busy peer (unknown
+      // last-seen counts as oldest); busy peers stay monitored — their
+      // tasks could be lost otherwise — making each cap soft when every
+      // remaining peer is busy.
+      auto evict_one_nonbusy = [&](auto& over_cap_map) -> bool {
+        auto peer_of = [](const auto& entry) -> const std::string& {
+          if constexpr (std::is_same_v<std::decay_t<decltype(entry)>,
+                                       std::string>)
+            return entry;  // std::set<std::string>
+          else
+            return entry.first;  // std::map<std::string, ...>
+        };
+        std::string victim;
+        int64_t victim_seen = 0;
+        for (const auto& entry : over_cap_map) {
+          const std::string& peer = peer_of(entry);
+          if (peer_busy.count(peer)) continue;
+          auto it = peer_last_seen.find(peer);
+          int64_t seen = it == peer_last_seen.end() ? 0 : it->second;
+          if (victim.empty() || seen < victim_seen) {
+            victim = peer;
+            victim_seen = seen;
+          }
+        }
+        if (victim.empty()) return false;  // all busy: soft cap
+        subscribed_peers.erase(victim);
+        peer_positions.erase(victim);
+        peer_last_seen.erase(victim);
+        return true;
+      };
       while (subscribed_peers.size() > max_peers)
-        subscribed_peers.erase(subscribed_peers.begin());
+        if (!evict_one_nonbusy(subscribed_peers)) break;
       while (peer_positions.size() > max_positions)
-        peer_positions.erase(peer_positions.begin());
-      // cap the liveness clock map by evicting the OLDEST non-busy entry
-      // (id-order eviction would blind mute-detection for arbitrary peers;
-      // busy peers must stay monitored or their tasks could be lost)
-      while (peer_last_seen.size() > max_peers) {
-        auto oldest = peer_last_seen.end();
-        for (auto it = peer_last_seen.begin(); it != peer_last_seen.end();
-             ++it)
-          if (!peer_busy.count(it->first)
-              && (oldest == peer_last_seen.end()
-                  || it->second < oldest->second))
-            oldest = it;
-        if (oldest == peer_last_seen.end()) break;  // all busy: soft cap
-        peer_last_seen.erase(oldest);
-      }
+        if (!evict_one_nonbusy(peer_positions)) break;
+      while (peer_last_seen.size() > max_peers)
+        if (!evict_one_nonbusy(peer_last_seen)) break;
       log_info("🧹 [CLEANUP] peers=%zu positions=%zu busy=%zu requeue=%zu\n",
                subscribed_peers.size(), peer_positions.size(),
                peer_busy.size(), requeue.size());

@@ -1,0 +1,70 @@
+// mapd_tswap_trace — golden-trace harness for the native sequential TSWAP.
+//
+// Reads one JSON instance from stdin:
+//   {"map": "....\n.@..\n....", "v": [c0, c1, ...], "g": [c0, c1, ...],
+//    "steps": N}
+// (v/g are flat cell indices), runs N sequential tswap_step calls
+// (cpp/common/tswap.hpp — the solver behind the centralized manager's
+// --solver=cpu mode), and prints one JSON line per step:
+//   {"v": [...], "g": [...]}
+//
+// tests/test_tswap_trace.py feeds scripted instances (Rule-3 swaps, Rule-4
+// cycles, the push extension) and asserts the traces are IDENTICAL to the
+// Python oracle's tswap_step — the two independent transcriptions of the
+// reference's sequential semantics must agree exactly.
+
+#include <cstdio>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../common/grid.hpp"
+#include "../common/json.hpp"
+#include "../common/tswap.hpp"
+
+using namespace mapd;
+
+int main() {
+  std::stringstream buf;
+  buf << std::cin.rdbuf();
+  auto parsed = Json::parse(buf.str());
+  if (!parsed) {
+    fprintf(stderr, "tswap_trace: cannot parse instance JSON\n");
+    return 2;
+  }
+  const Json& in = *parsed;
+  auto grid_opt = Grid::from_ascii(in["map"].as_str());
+  if (!grid_opt) {
+    fprintf(stderr, "tswap_trace: bad map\n");
+    return 2;
+  }
+  Grid grid = *grid_opt;
+  DistanceCache dc(grid);
+
+  std::vector<TswapAgent> agents;
+  const auto& vs = in["v"].as_array();
+  const auto& gs = in["g"].as_array();
+  if (vs.size() != gs.size() || vs.empty()) {
+    fprintf(stderr, "tswap_trace: v/g size mismatch\n");
+    return 2;
+  }
+  for (size_t i = 0; i < vs.size(); ++i)
+    agents.push_back(TswapAgent{static_cast<int>(i),
+                                static_cast<Cell>(vs[i].as_int()),
+                                static_cast<Cell>(gs[i].as_int())});
+
+  int64_t steps = in["steps"].as_int();
+  for (int64_t t = 0; t < steps; ++t) {
+    tswap_step(agents, dc);
+    Json v, g;
+    for (const auto& a : agents) {
+      v.push_back(Json(static_cast<int64_t>(a.v)));
+      g.push_back(Json(static_cast<int64_t>(a.g)));
+    }
+    Json line;
+    line.set("v", v).set("g", g);
+    printf("%s\n", line.dump().c_str());
+  }
+  return 0;
+}

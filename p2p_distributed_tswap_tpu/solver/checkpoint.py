@@ -22,6 +22,8 @@ import dataclasses
 import jax.numpy as jnp
 import numpy as np
 
+from p2p_distributed_tswap_tpu.core.config import SolverConfig
+from p2p_distributed_tswap_tpu.ops.distance import packed_cells
 from p2p_distributed_tswap_tpu.solver.mapd import MapdState
 
 FORMAT_VERSION = 1
@@ -35,9 +37,17 @@ def save_state(path: str, state: MapdState) -> None:
     np.savez_compressed(path, __format_version__=FORMAT_VERSION, **arrays)
 
 
-def load_state(path: str) -> MapdState:
-    """Restore a :class:`MapdState` saved by :func:`save_state`."""
+def load_state(path: str, cfg: SolverConfig | None = None) -> MapdState:
+    """Restore a :class:`MapdState` saved by :func:`save_state`.
+
+    Pass the ``cfg`` the state will be stepped under to fail fast on a
+    mismatch (wrong agent count, grid size, path recording) instead of an
+    opaque shape error — or silently wrong gathers — deep inside the
+    jitted step."""
     with np.load(path) as z:
+        if "__format_version__" not in z:
+            raise ValueError(
+                f"{path} is not a solver checkpoint (no format version)")
         version = int(z["__format_version__"])
         if version != FORMAT_VERSION:
             raise ValueError(
@@ -45,4 +55,22 @@ def load_state(path: str) -> MapdState:
         missing = [n for n in _FIELDS if n not in z]
         if missing:
             raise ValueError(f"checkpoint missing fields: {missing}")
-        return MapdState(**{name: jnp.asarray(z[name]) for name in _FIELDS})
+        state = MapdState(**{name: jnp.asarray(z[name]) for name in _FIELDS})
+    if cfg is not None:
+        n = state.pos.shape[0]
+        if n != cfg.num_agents:
+            raise ValueError(
+                f"checkpoint has {n} agents, config expects "
+                f"{cfg.num_agents}")
+        if state.dirs.shape != (n, packed_cells(cfg.num_cells)):
+            raise ValueError(
+                f"checkpoint field shape {state.dirs.shape} does not match "
+                f"a {cfg.height}x{cfg.width} grid "
+                f"({(n, packed_cells(cfg.num_cells))} expected)")
+        want_tdim = cfg.max_timesteps + 1 if cfg.record_paths else 1
+        if state.paths_pos.shape[0] != want_tdim:
+            raise ValueError(
+                f"checkpoint path buffer has {state.paths_pos.shape[0]} "
+                f"rows, config (record_paths={cfg.record_paths}, "
+                f"max_timesteps={cfg.max_timesteps}) expects {want_tdim}")
+    return state

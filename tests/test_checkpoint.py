@@ -45,7 +45,7 @@ def test_save_resume_bit_identical(tmp_path):
         s = step(s, tasks_j2, free_j)
     ckpt = str(tmp_path / "solve.npz")
     save_state(ckpt, s)
-    restored = load_state(ckpt)
+    restored = load_state(ckpt, cfg)  # cfg validation path
     # the restored tree matches what was saved, dtypes included
     for name in ("pos", "goal", "slot", "dirs", "phase", "task_used", "t"):
         a, b = getattr(s, name), getattr(restored, name)
@@ -68,3 +68,25 @@ def test_load_rejects_bad_archive(tmp_path):
     np.savez_compressed(p, __format_version__=999, pos=np.zeros(3))
     with pytest.raises(ValueError, match="format"):
         load_state(p)
+    p2 = str(tmp_path / "notackpt.npz")
+    np.savez_compressed(p2, whatever=np.zeros(3))
+    with pytest.raises(ValueError, match="not a solver checkpoint"):
+        load_state(p2)
+
+
+def test_load_rejects_config_mismatch(tmp_path):
+    import pytest
+
+    grid = Grid.random_obstacles(16, 16, 0.1, seed=0)
+    cfg = SolverConfig(height=16, width=16, num_agents=4)
+    starts = start_positions_array(grid, 4, seed=0)
+    s = mapd.init_state(cfg, jnp.asarray(starts, jnp.int32), 3)
+    p = str(tmp_path / "c.npz")
+    save_state(p, s)
+    with pytest.raises(ValueError, match="agents"):
+        load_state(p, SolverConfig(height=16, width=16, num_agents=8))
+    with pytest.raises(ValueError, match="grid"):
+        load_state(p, SolverConfig(height=32, width=32, num_agents=4))
+    with pytest.raises(ValueError, match="path buffer"):
+        load_state(p, SolverConfig(height=16, width=16, num_agents=4,
+                                   record_paths=False))

@@ -1,0 +1,89 @@
+"""Golden traces: the native sequential TSWAP (cpp/common/tswap.hpp, the
+centralized manager's --solver=cpu engine) must agree EXACTLY, step by step,
+with the Python oracle (solver/oracle.py) — two independent transcriptions
+of the reference's sequential semantics, including the push extension.
+
+Next-hop tie-breaking matches by construction (both take the first strict
+minimum in the reference's neighbor order), so the traces are deterministic
+and comparable bit-for-bit."""
+
+import json
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.runtime.fleet import ensure_built
+from p2p_distributed_tswap_tpu.solver.oracle import OracleSim
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("cmake") is None or shutil.which("ninja") is None,
+    reason="C++ toolchain unavailable")
+
+
+def _cpp_trace(grid_text, v, g, steps):
+    build = ensure_built()
+    inst = json.dumps({"map": grid_text, "v": [int(x) for x in v],
+                       "g": [int(x) for x in g], "steps": steps})
+    out = subprocess.run([str(build / "mapd_tswap_trace")], input=inst,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    return [json.loads(line) for line in out.stdout.strip().splitlines()]
+
+
+def _oracle_trace(grid_text, v, g, steps):
+    grid = Grid.from_ascii(grid_text)
+    sim = OracleSim(grid, np.asarray(v, np.int64),
+                    np.zeros((0, 2), np.int64))
+    sim.g = np.asarray(g, np.int64)
+    trace = []
+    for _ in range(steps):
+        sim.tswap_step()
+        trace.append({"v": [int(x) for x in sim.v],
+                      "g": [int(x) for x in sim.g]})
+    return trace
+
+
+CASES = [
+    # plain movement toward distinct goals
+    ("move", "\n".join(["." * 8] * 8),
+     [0, 63], [7, 56], 8),
+    # Rule 3: blocker parked on its own (distinct) goal in the mover's way
+    ("rule3", "." * 8,
+     [0, 5], [7, 5], 6),
+    # Rule 4: head-on pair in a one-wide corridor (2-cycle rotation)
+    ("rule4-headon", "." * 8,
+     [2, 3], [6, 0], 6),
+    # Rule 4: 4-cycle rotational deadlock around a 2x2 block
+    ("rule4-ring", "\n".join(["." * 4] * 4),
+     [5, 6, 10, 9], [6, 10, 9, 5], 4),
+    # congested mix on an obstacle map
+    ("congested", "\n".join(["......", ".@@...", "...@..", "......"]),
+     [0, 5, 18, 23], [23, 18, 5, 0], 16),
+]
+
+
+@pytest.mark.parametrize("name,grid_text,v,g,steps", CASES,
+                         ids=[c[0] for c in CASES])
+def test_cpp_matches_oracle(name, grid_text, v, g, steps):
+    got = _cpp_trace(grid_text, v, g, steps)
+    want = _oracle_trace(grid_text, v, g, steps)
+    assert len(got) == len(want)
+    for t, (a, b) in enumerate(zip(got, want)):
+        assert a == b, f"{name}: divergence at step {t}: cpp={a} oracle={b}"
+
+
+def test_push_extension_diverges_from_oracle_by_design():
+    """Parked blocker sharing the mover's goal: the oracle (faithful
+    reference semantics) deadlocks forever; the native solver's push
+    extension must resolve it — the one DOCUMENTED divergence
+    (ARCHITECTURE.md #6, mirrored from solver/step.py)."""
+    grid_text, v, g, steps = "." * 8, [0, 4], [4, 4], 10
+    want = _oracle_trace(grid_text, v, g, steps)
+    # oracle: the mover parks adjacent and never reaches its goal
+    assert want[-1]["v"][0] != 4 and want[-1]["v"][1] == 4
+    got = _cpp_trace(grid_text, v, g, steps)
+    # native: the pair mutual-swaps; the mover PHYSICALLY reaches cell 4
+    assert any(step["v"][0] == 4 for step in got), got
