@@ -32,10 +32,12 @@ class SolverConfig:
     # Max direction-field recomputations processed per replan round; rounds
     # repeat until the dirty set drains. Static so replan has fixed shapes.
     replan_chunk: int = 64
-    # Narrow chunk used (via lax.cond) when few fields are dirty — the
-    # steady-state case of a handful of task arrivals per step; a wide
-    # round would burn a full (replan_chunk, H, W) sweep on them.
-    replan_chunk_small: int = 8
+    # Narrow chunk for the in-step replan loop — steady state dirties only
+    # a handful of fields per step (task arrivals), and sweep cost is
+    # O(chunk * H * W) per round regardless of how few rows are dirty.
+    # Tuned on the FLAGSHIP rung: 4 -> 152 ms/step, 8 -> 206, 12 -> 328
+    # (extra rounds at narrower chunks are cheaper than wasted sweep width).
+    replan_chunk_small: int = 4
     # Rule-4 deadlock cycles are detected exactly up to this length
     # (ref walks unbounded chains, src/algorithm/tswap.rs:204-249; cycles
     # longer than this simply wait and retry next step).
