@@ -53,6 +53,8 @@ def configs(quick: bool):
     return [
         # (name, grid factory, agents, tasks, seeds)
         ("ref-envelope 50a 100x100 empty", Grid.default, 50, 50, n_seeds),
+        # double the reference's fleet on its own grid
+        ("dense 100a 100x100 empty", Grid.default, 100, 100, n_seeds),
         ("warehouse 64x64 40a (congested)",
          lambda: Grid.warehouse(64, 64), 40, 40, n_seeds),
         ("random-obstacles 32x32 p=0.2 16a",
