@@ -29,11 +29,20 @@ class Knobs {
   Knobs(int argc, char** argv) : argc_(argc), argv_(argv) {}
 
   // "--flag value" / "--flag=value", else $env, else def.
+  // A value starting with "--" is rejected (so "--map --clean" fails loudly
+  // instead of yielding map="--clean"), and a trailing value-less flag is an
+  // error rather than a silent fall-through to env/default.
   std::string get_str(const char* flag, const char* env,
                       const std::string& def) const {
     size_t flen = strlen(flag);
     for (int i = 1; i < argc_; ++i) {
-      if (!strcmp(argv_[i], flag) && i + 1 < argc_) return argv_[i + 1];
+      if (!strcmp(argv_[i], flag)) {
+        if (i + 1 >= argc_ || !strncmp(argv_[i + 1], "--", 2)) {
+          fprintf(stderr, "knobs: flag %s requires a value\n", flag);
+          exit(2);
+        }
+        return argv_[i + 1];
+      }
       if (!strncmp(argv_[i], flag, flen) && argv_[i][flen] == '=')
         return argv_[i] + flen + 1;
     }

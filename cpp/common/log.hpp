@@ -45,10 +45,13 @@ inline void vlog_at(LogLevel lv, const char* fmt, va_list ap) {
   tm t;
   localtime_r(&tv.tv_sec, &t);
   static const char* tags[] = {"E", "W", "I", "D"};
-  printf("%02d:%02d:%02d.%03d %s ", t.tm_hour, t.tm_min, t.tm_sec,
-         static_cast<int>(tv.tv_usec / 1000), tags[static_cast<int>(lv)]);
-  vprintf(fmt, ap);
-  fflush(stdout);
+  // Error/Warn go to stderr so failures reach harnesses watching stderr and
+  // never interleave with machine-readable stdout (probe/trace output).
+  FILE* out = lv <= LogLevel::Warn ? stderr : stdout;
+  fprintf(out, "%02d:%02d:%02d.%03d %s ", t.tm_hour, t.tm_min, t.tm_sec,
+          static_cast<int>(tv.tv_usec / 1000), tags[static_cast<int>(lv)]);
+  vfprintf(out, fmt, ap);
+  fflush(out);
 }
 
 inline void log_info(const char* fmt, ...) {

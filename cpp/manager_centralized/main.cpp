@@ -127,6 +127,12 @@ int main(int argc, char** argv) {
   std::map<std::string, AgentInfo> agents;
   std::set<std::string> known_left;
   std::deque<Json> pending_tasks;  // pending_task_requests (ref :367-436)
+  // Task ids that were re-queued from a dead/stale agent (at-least-once
+  // hazard: the original agent may still be alive and complete the task).
+  // A later `done` for such an id cancels the pending duplicate, or — if
+  // already re-dispatched — is counted once and never double-refilled.
+  std::set<long long> requeued_ids;
+  std::set<long long> completed_ids;
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
@@ -186,9 +192,10 @@ int main(int argc, char** argv) {
                           const char* why) {
     if (!a.task) return;
     Json t = *a.task;
-    log_info("♻️  %s %s, re-queueing task %lld\n", why, peer.c_str(),
-             static_cast<long long>(t["task_id"].as_int()));
+    long long id = t["task_id"].as_int();
+    log_info("♻️  %s %s, re-queueing task %lld\n", why, peer.c_str(), id);
     t.set("peer_id", Json());
+    requeued_ids.insert(id);  // at-least-once: dedupe a late done (see below)
     pending_tasks.push_front(std::move(t));
   };
 
@@ -440,20 +447,49 @@ int main(int argc, char** argv) {
                 d["timestamp_ms"].as_int());
           } else if (d["status"].as_str() == "done") {
             const std::string& peer = m.from;
+            const long long tid = d["task_id"].as_int();
             auto it = agents.find(peer);
-            if (it != agents.end()) {
+            if (it != agents.end() && it->second.task
+                && (*it->second.task)["task_id"].as_int() == tid) {
               it->second.task.reset();
               it->second.phase = Phase::None;
               it->second.goal = it->second.pos;
             }
-            log_info("🎉 %s finished task %lld\n", peer.c_str(),
-                     static_cast<long long>(d["task_id"].as_int()));
-            // auto-reassign on completion (ref :908-950): queued tasks
-            // (incl. ones re-queued from dead agents) drain before a fresh
-            // task is generated, so orphans cannot starve behind auto-refill
-            if (it != agents.end() && pending_tasks.empty())
-              assign_task(peer, make_task());
-            try_assign_pending();
+            if (completed_ids.count(tid)) {
+              // second completion of a re-dispatched task (at-least-once
+              // re-queue): counted once already — free the reporter and
+              // keep it in the work loop, but don't count the duplicate
+              log_warn("⚠️  duplicate done for task %lld (%s) ignored\n",
+                       tid, peer.c_str());
+              if (it != agents.end() && pending_tasks.empty())
+                assign_task(peer, make_task());
+              try_assign_pending();
+            } else {
+              if (requeued_ids.erase(tid)) {
+                // the presumed-dead agent finished after all: cancel the
+                // queued duplicate if it is still pending.  The id goes
+                // into completed_ids EITHER WAY — the task may have been
+                // re-queued more than once (another copy already
+                // dispatched, or re-queued again later), and any
+                // subsequent done for it must dedupe.
+                completed_ids.insert(tid);
+                for (auto q = pending_tasks.begin();
+                     q != pending_tasks.end(); ++q)
+                  if ((*q)["task_id"].as_int() == tid) {
+                    pending_tasks.erase(q);
+                    log_info("♻️  task %lld done by its original agent; "
+                             "queued duplicate cancelled\n", tid);
+                    break;
+                  }
+              }
+              log_info("🎉 %s finished task %lld\n", peer.c_str(), tid);
+              // auto-reassign on completion (ref :908-950): queued tasks
+              // (incl. ones re-queued from dead agents) drain before a fresh
+              // task is generated, so orphans cannot starve behind auto-refill
+              if (it != agents.end() && pending_tasks.empty())
+                assign_task(peer, make_task());
+              try_assign_pending();
+            }
           }
                 },
         [&](const Json& ev) {
@@ -499,9 +535,13 @@ int main(int argc, char** argv) {
       last_cleanup = now;
       // Stale age-out re-queues in-flight tasks just like peer_left does: a
       // live-but-silent agent never emits peer_left, and its task must not
-      // be lost on this path either.  The cap trim below deliberately does
-      // NOT re-queue — it evicts agents that are still live and working, so
-      // re-dispatching their task would run it twice.
+      // be lost on this path either.  This is AT-LEAST-ONCE delivery: the
+      // silent agent may still be alive (e.g. a transient bus stall) and
+      // finish the task anyway — the done handler dedupes by task_id
+      // (requeued_ids/completed_ids), cancelling the queued duplicate or
+      // counting a double completion once.  The cap trim below deliberately
+      // does NOT re-queue — it evicts agents that are still live and
+      // working, so re-dispatching their task would run it twice.
       for (auto it = agents.begin(); it != agents.end();) {
         if (now - it->second.last_seen_ms > agent_stale_ms) {
           requeue_task(it->first, it->second, "evicting stale agent");

@@ -25,6 +25,11 @@ class Scenario:
     num_agents: int
     num_tasks: int
     replan_chunk: int = 64
+    # None = centralized global view; 15 = the reference's decentralized
+    # radius (src/bin/decentralized/agent.rs:796-801).  Same solver, masked
+    # visibility inside the kernel — the TPU analog of the reference's
+    # central experiment (compare_path_metrics.py:33-106).
+    visibility_radius: int | None = None
 
     def build(self, seed: int = 0):
         grid = self.grid_fn()
@@ -33,8 +38,15 @@ class Scenario:
             self.num_tasks)
         cfg = SolverConfig(height=grid.height, width=grid.width,
                            num_agents=self.num_agents,
-                           replan_chunk=min(self.replan_chunk, self.num_agents))
+                           replan_chunk=min(self.replan_chunk, self.num_agents),
+                           visibility_radius=self.visibility_radius)
         return grid, starts, tasks, cfg
+
+    def decentralized(self, radius: int = 15) -> "Scenario":
+        """The same configuration solved under the reference's radius-15
+        local-view semantics (suffix ``-decent``)."""
+        return dataclasses.replace(self, name=f"{self.name}-decent",
+                                   visibility_radius=radius)
 
 
 # BASELINE.json config ladder
@@ -54,5 +66,21 @@ FLAGSHIP = Scenario(                # north-star config: 10k agents, 1024^2
 EXTREME = Scenario(                 # v5e-16 territory, agent-axis sharded
     "100k-4096", lambda: Grid.warehouse(4096, 4096), 100_000, 100_000,
     replan_chunk=512)
+# EXTREME-lite: the 4096^2 grid axis on ONE chip at reduced agent count
+# (VERDICT r2 missing item 3) — de-risks the EXTREME field working set
+# before multi-chip hardware exists.  Memory: packed fields are
+# HW/2 = 8 MB/agent at 4096^2, so 768 agents = 6 GB persistent (x2 resident
+# across the undonated per-step dispatch, see bench.py) on a 16 GB chip;
+# replan_chunk 8 keeps the sweep transient (chunk * HW * 4 B int32 plus
+# temporaries) ~2 GB.
+EXTREME_LITE = Scenario(
+    "768a-4096-warehouse", lambda: Grid.warehouse(4096, 4096), 768, 768,
+    replan_chunk=8)
 
 LADDER = [REFERENCE_DEMO, SMALL, MEDIUM, FLAGSHIP, EXTREME]
+
+# Decentralized (radius-15) counterparts for the cent-vs-decent table —
+# the reference's core experiment at TPU scale (VERDICT r2 missing item 2).
+REFERENCE_DEMO_DECENT = REFERENCE_DEMO.decentralized()
+MEDIUM_DECENT = MEDIUM.decentralized()
+FLAGSHIP_DECENT = FLAGSHIP.decentralized()

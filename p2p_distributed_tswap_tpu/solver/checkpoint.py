@@ -37,13 +37,18 @@ def save_state(path: str, state: MapdState) -> None:
     np.savez_compressed(path, __format_version__=FORMAT_VERSION, **arrays)
 
 
-def load_state(path: str, cfg: SolverConfig | None = None) -> MapdState:
+def load_state(path: str, cfg: SolverConfig | None = None,
+               expected_num_tasks: int | None = None) -> MapdState:
     """Restore a :class:`MapdState` saved by :func:`save_state`.
 
     Pass the ``cfg`` the state will be stepped under to fail fast on a
     mismatch (wrong agent count, grid size, path recording) instead of an
     opaque shape error — or silently wrong gathers — deep inside the
-    jitted step."""
+    jitted step.  Pass ``expected_num_tasks`` (``tasks.shape[0]`` of the
+    array the resumed solve will step with) to catch a tasks/checkpoint
+    mismatch: ``task_used``'s length comes from the checkpoint, so stepping
+    with a different tasks array mis-indexes inside jit (wrong gathers,
+    not a shape error)."""
     with np.load(path) as z:
         if "__format_version__" not in z:
             raise ValueError(
@@ -73,4 +78,11 @@ def load_state(path: str, cfg: SolverConfig | None = None) -> MapdState:
                 f"checkpoint path buffer has {state.paths_pos.shape[0]} "
                 f"rows, config (record_paths={cfg.record_paths}, "
                 f"max_timesteps={cfg.max_timesteps}) expects {want_tdim}")
+    if expected_num_tasks is not None:
+        t = state.task_used.shape[0]
+        if t != expected_num_tasks:
+            raise ValueError(
+                f"checkpoint was saved against {t} tasks, resumed solve "
+                f"steps with {expected_num_tasks} — same tasks array "
+                f"required for a valid resume")
     return state

@@ -45,7 +45,9 @@ def test_save_resume_bit_identical(tmp_path):
         s = step(s, tasks_j2, free_j)
     ckpt = str(tmp_path / "solve.npz")
     save_state(ckpt, s)
-    restored = load_state(ckpt, cfg)  # cfg validation path
+    # cfg + task-count validation path (tasks_j2 is what we resume with)
+    restored = load_state(ckpt, cfg,
+                          expected_num_tasks=int(tasks_j2.shape[0]))
     # the restored tree matches what was saved, dtypes included
     for name in ("pos", "goal", "slot", "dirs", "phase", "task_used", "t"):
         a, b = getattr(s, name), getattr(restored, name)
@@ -90,3 +92,7 @@ def test_load_rejects_config_mismatch(tmp_path):
     with pytest.raises(ValueError, match="path buffer"):
         load_state(p, SolverConfig(height=16, width=16, num_agents=4,
                                    record_paths=False))
+    # resuming against a different tasks array than the one saved with
+    # would mis-index task_used/agent_task inside jit — caught up front
+    with pytest.raises(ValueError, match="tasks"):
+        load_state(p, cfg, expected_num_tasks=7)
