@@ -125,6 +125,12 @@ int main(int argc, char** argv) {
   // 3x initial broadcast for startup robustness (ref :232-269)
   for (int i = 0; i < 3; ++i) broadcast_position();
 
+  // survive a bus restart: resubscribe happens inside BusClient; the agent
+  // re-announces its position so the manager re-tracks it immediately
+  bus.set_reconnect([&]() {
+    for (int i = 0; i < 3; ++i) broadcast_position();
+  });
+
   int64_t last_broadcast = mono_ms();
   while (!g_stop && bus.connected()) {
     pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};

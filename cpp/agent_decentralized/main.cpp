@@ -230,6 +230,10 @@ int main(int argc, char** argv) {
   int64_t last_tick = 0;
   int64_t last_metrics_print = mono_ms();
 
+  // survive a bus restart: resubscription is internal to BusClient; the
+  // agent re-announces position+goal so peers and the manager re-track it
+  bus.set_reconnect([&]() { publish_position(); });
+
   while (!g_stop && bus.connected()) {
     pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
     int64_t now = mono_ms();

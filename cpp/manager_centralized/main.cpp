@@ -118,6 +118,10 @@ int main(int argc, char** argv) {
   }
   bus.subscribe("mapd");
   if (solver == "tpu") bus.subscribe("solver");
+  // survive a bus restart (reconnect + resubscribe inside BusClient);
+  // agents re-announce themselves on their own reconnect, so tracking
+  // repopulates within a heartbeat
+  bus.set_reconnect([]() {});
   log_info("🧠 centralized manager %s up (grid %dx%d, solver=%s%s)\n",
            my_id.c_str(), grid.width, grid.height, solver.c_str(),
            clean ? ", clean" : "");

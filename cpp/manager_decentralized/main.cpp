@@ -95,6 +95,9 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
+  // survive a bus restart (reconnect + resubscribe inside BusClient);
+  // agents re-announce position+goal on their own reconnect
+  bus.set_reconnect([]() {});
   log_info("🧠 decentralized manager %s up (grid %dx%d)\n", my_id.c_str(),
            grid.width, grid.height);
   log_info("Commands: task | tasks N | metrics | save <file> | "

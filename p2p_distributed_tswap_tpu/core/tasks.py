@@ -79,6 +79,35 @@ class TaskGenerator:
             out[k, 1] = self.grid.idx(t.delivery)
         return out
 
+    def generate_distinct_task_arrays(self, count: int,
+                                      exclude: Optional[np.ndarray] = None
+                                      ) -> np.ndarray:
+        """Like :meth:`generate_task_arrays`, but ALL 2*count endpoints are
+        distinct cells (optionally also disjoint from ``exclude``, e.g.
+        agent start cells).
+
+        Shared endpoints trigger the reference's shared-delivery deadlock
+        (Rule-3 swap of identical goals no-ops forever, tswap.rs:197-202) —
+        with random endpoints the birthday bound makes that near-certain
+        once tasks number in the hundreds, which would starve the
+        makespan-parity comparison of oracle-completing seeds
+        (analysis/parity_table.py).  Distinct endpoints model the
+        warehouse-station setting and keep the *sequential semantics*
+        comparable at scale.
+        """
+        free_idx = np.array([self.grid.idx(p) for p in self._free],
+                            dtype=np.int32)
+        if exclude is not None and len(exclude):
+            free_idx = np.setdiff1d(free_idx, np.asarray(exclude,
+                                                         dtype=np.int32))
+        need = 2 * count
+        assert len(free_idx) >= need, (
+            f"{need} distinct endpoints requested but only {len(free_idx)} "
+            "eligible free cells")
+        cells = self.rng.choice(free_idx, size=need, replace=False)
+        self._next_id += count
+        return cells.reshape(count, 2).astype(np.int32)
+
 
 def tasks_to_arrays(grid: Grid, tasks: List[Task]) -> np.ndarray:
     out = np.empty((len(tasks), 2), dtype=np.int32)

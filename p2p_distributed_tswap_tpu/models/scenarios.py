@@ -69,12 +69,14 @@ EXTREME = Scenario(                 # v5e-16 territory, agent-axis sharded
 # EXTREME-lite: the 4096^2 grid axis on ONE chip at reduced agent count
 # (VERDICT r2 missing item 3) — de-risks the EXTREME field working set
 # before multi-chip hardware exists.  Memory: packed fields are
-# HW/2 = 8 MB/agent at 4096^2, so 768 agents = 6 GB persistent (x2 resident
-# across the undonated per-step dispatch, see bench.py) on a 16 GB chip;
-# replan_chunk 8 keeps the sweep transient (chunk * HW * 4 B int32 plus
-# temporaries) ~2 GB.
+# HW/2 = 8 MB/agent at 4096^2, so 512 agents = 4 GB persistent — x2
+# resident across undonated dispatches (both the host-driven prime burst,
+# mapd.host_prime_fields, and the per-step loop) = 8 GB, leaving the
+# (8, 4096^2) sweep transient ~2 GB of slack on a 16 GB chip.  The fused
+# one-program prime at this grid reliably crashes the axon TPU worker;
+# bench.py primes this rung host-side chunk by chunk.
 EXTREME_LITE = Scenario(
-    "768a-4096-warehouse", lambda: Grid.warehouse(4096, 4096), 768, 768,
+    "512a-4096-warehouse", lambda: Grid.warehouse(4096, 4096), 512, 512,
     replan_chunk=8)
 
 LADDER = [REFERENCE_DEMO, SMALL, MEDIUM, FLAGSHIP, EXTREME]

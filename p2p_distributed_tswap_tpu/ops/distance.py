@@ -83,12 +83,28 @@ def _sweep(d: jnp.ndarray, free: jnp.ndarray, axis: int, reverse: bool,
     """One directional sweep: propagate ``d`` along ``axis`` in one direction
     with unit step cost, not crossing obstacles.
 
+    On TPU with lane-aligned grids this dispatches to the Pallas
+    sequential-scan kernel (ops/sweep_pallas.py — one memory pass instead
+    of the doubling scan's ~50; bit-identical integer results).  The XLA
+    doubling-scan below is the portable path (CPU, unaligned grids).
+
     Uses the affine trick: along the scan direction, reachability from an
     earlier cell k at position x costs (x - k), so minimizing ``d[k] - k``
     with a segmented scan and adding back the coordinate gives the relaxed
     distance.  ``coord`` is the (broadcastable) position along ``axis``,
     negated by the caller for reverse sweeps.
     """
+    if d.ndim == 3 and free.ndim == 3:
+        from p2p_distributed_tswap_tpu.ops import sweep_pallas
+
+        if sweep_pallas.sweep_eligible(d.shape[1], d.shape[2]):
+            return sweep_pallas.sweep(d, free[0], axis, reverse)
+    return _sweep_xla(d, free, axis, reverse, coord)
+
+
+def _sweep_xla(d: jnp.ndarray, free: jnp.ndarray, axis: int, reverse: bool,
+               coord: jnp.ndarray) -> jnp.ndarray:
+    """The portable XLA doubling-scan sweep (see _sweep)."""
     blocked = ~free
     # Blocked sentinel must stay >= INF after the coordinate shift below for
     # any position in the axis, else it would leak as a fake INF-eps distance.

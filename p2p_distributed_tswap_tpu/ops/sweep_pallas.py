@@ -1,0 +1,133 @@
+"""Pallas TPU kernel for the fast-sweeping directional relax.
+
+The round-3 flagship step profile (analysis/step_profile.py, SCALING.md)
+put the replan sweeps at ~88% of step time, so this is THE kernel worth
+hand-writing (VERDICT r2 item 6).  The XLA path implements each
+directional sweep as a Hillis-Steele doubling scan — log2(axis) rounds of
+roll/where/minimum over the whole (R, H, W) batch, ~50 full-array memory
+passes per sweep.  A TPU core can instead hold a (H, 128-lane) strip in
+VMEM and run the TRUE sequential min-plus recurrence along the scan axis,
+vectorized across 128 lanes: one read + one write of the array per sweep,
+a ~25x traffic reduction at the 1024^2 flagship.
+
+Recurrence per scan step (segmented min-plus with unit cost; identical
+integer math to ops.distance._sweep's affine-trick scan, bit-for-bit):
+
+    run    = min(run + 1, d[i])           # relax from predecessor
+    run    = INF            if blocked[i]  # obstacles reset the segment
+    out[i] = min(run, INF)  if free else INF
+
+Layout: grid (R, W // 128); each program owns a (H, 128) block of one
+field row and scans the full H extent (no cross-program dependency along
+the scan axis, so results are exact in one pass — the outer fixpoint loop
+in distance_fields is unchanged).  The W-axis sweeps reuse the same kernel
+on a transposed view; XLA's transpose costs two passes, still far below
+the doubling scan.
+
+Eligibility (``sweep_eligible``): TPU backend, H and W multiples of 128
+(covers the 256/512/1024/4096 benchmark grids; the reference's 100x100
+falls back to the XLA path, which is already sub-millisecond there).
+Kill-switch: MAPD_NO_PALLAS=1.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INF = np.int32(1 << 30)
+LANES = 128
+# Tests set this to run the kernel through the Pallas interpreter on CPU
+# (the compiled path needs a real TPU); production leaves it False.
+INTERPRET = False
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    if os.environ.get("MAPD_NO_PALLAS") == "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def sweep_eligible(h: int, w: int) -> bool:
+    """Both axes get scanned (W via transpose), so both must be
+    lane-aligned."""
+    return _on_tpu() and h % LANES == 0 and w % LANES == 0
+
+
+SUBLANES = 8  # VPU tile height for int32; also the fori_loop stride
+
+
+def _scan_kernel(reverse: bool, h: int, d_ref, m_ref, o_ref):
+    # Tile-strided scan: one (8, 128) aligned VMEM read/write per loop
+    # iteration, with the sequential recurrence unrolled statically across
+    # the 8 sublanes — 8x fewer loop iterations than a per-row loop and
+    # aligned tile accesses instead of (1, 128) slices.
+    nt = h // SUBLANES
+
+    def body(t, run):
+        base = ((nt - 1 - t) if reverse else t) * SUBLANES
+        tile_d = d_ref[pl.ds(base, SUBLANES), :]
+        tile_b = m_ref[pl.ds(base, SUBLANES), :] != 0
+        rows = [None] * SUBLANES
+        order = range(SUBLANES - 1, -1, -1) if reverse else range(SUBLANES)
+        for k in order:
+            run = jnp.minimum(run + 1, tile_d[k:k + 1, :])
+            run = jnp.where(tile_b[k:k + 1, :], INF, run)
+            rows[k] = jnp.where(tile_b[k:k + 1, :], INF,
+                                jnp.minimum(run, INF))
+        o_ref[pl.ds(base, SUBLANES), :] = jnp.concatenate(rows, axis=0)
+        return run
+
+    jax.lax.fori_loop(0, nt, body, jnp.full((1, LANES), INF, jnp.int32))
+
+
+def _sweep_rows(d: jnp.ndarray, blocked: jnp.ndarray,
+                reverse: bool) -> jnp.ndarray:
+    """Sequential segmented min-plus scan along axis 1 of ``d`` (R, H, W),
+    128 lanes at a time.  ``blocked``: (H, W) int32, nonzero = obstacle."""
+    r, h, w = d.shape
+    kernel = functools.partial(_scan_kernel, reverse, h)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((r, h, w), jnp.int32),
+        grid=(r, w // LANES),
+        in_specs=[
+            pl.BlockSpec((None, h, LANES), lambda ri, si: (ri, 0, si),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, LANES), lambda ri, si: (0, si),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((None, h, LANES), lambda ri, si: (ri, 0, si),
+                               memory_space=pltpu.VMEM),
+        interpret=INTERPRET,
+    )(d, blocked)
+
+
+def sweep(d: jnp.ndarray, free2d: jnp.ndarray, axis: int,
+          reverse: bool) -> jnp.ndarray:
+    """Drop-in directional sweep: exact replacement for
+    ops.distance._sweep's result on eligible shapes.
+
+    Args:
+      d: (R, H, W) int32 distance batch.
+      free2d: (H, W) bool, True = traversable.
+      axis: 1 (scan along H) or 2 (scan along W, via transpose).
+      reverse: scan direction.
+    """
+    blocked = (~free2d).astype(jnp.int32)
+    if axis == 1:
+        return _sweep_rows(d, blocked, reverse)
+    assert axis == 2
+    out = _sweep_rows(d.swapaxes(1, 2), blocked.T, reverse)
+    return out.swapaxes(1, 2)

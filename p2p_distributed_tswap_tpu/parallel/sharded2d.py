@@ -84,16 +84,53 @@ def _next_hops_2d(cfg: SolverConfig, dirs_local: jnp.ndarray,
     return apply_direction(pos, codes, cfg.width)
 
 
+def _prime_2d(cfg: SolverConfig, s: MapdState, free_local: jnp.ndarray
+              ) -> MapdState:
+    """The t=0 field burst on the 2-D mesh: every agent block computes ALL
+    its rows in WIDE ``replan_chunk`` batches, each tiles-axis device
+    sweeping its band (see parallel/sharded.py::_sharded_prime for why the
+    burst is hoisted off the steady-state path).  The trip count
+    ceil(rows_local / r) is identical on every device — rows_local is
+    uniform — so the tiled sweep's collective schedule lines up with no
+    pmax needed."""
+    n = cfg.num_agents
+    dirs_local = s.dirs
+    rows_local, words_local = dirs_local.shape
+    a_shard = jax.lax.axis_index(AGENTS_AXIS)
+    inv = jnp.zeros(n, jnp.int32).at[s.slot].set(
+        jnp.arange(n, dtype=jnp.int32))
+    r = min(cfg.replan_chunk, rows_local)
+    nchunks = -(-rows_local // r)
+    lane = jnp.arange(r, dtype=jnp.int32)
+
+    def chunk(dirs_local, ci):
+        row_local = jnp.clip(ci * r + lane, 0, rows_local - 1)
+        holder = inv[a_shard * rows_local + row_local]
+        fields = tiled_direction_fields(
+            free_local, s.goal[holder], cfg.width, axis_name=TILES_AXIS,
+            max_rounds=cfg.max_sweep_rounds,
+            fixpoint_axes=(AGENTS_AXIS, TILES_AXIS))
+        dirs_local = dirs_local.at[row_local].set(
+            pack_directions(fields.reshape(r, -1)))
+        return dirs_local, None
+
+    dirs_local, _ = jax.lax.scan(chunk, dirs_local,
+                                 jnp.arange(nchunks, dtype=jnp.int32))
+    return s.replace(dirs=dirs_local,
+                     need_replan=jnp.zeros_like(s.need_replan))
+
+
 def _replan_2d(cfg: SolverConfig, s: MapdState, free_local: jnp.ndarray
                ) -> MapdState:
     """Drain stale field rows owned by this agent block; each tiles-axis
-    device computes its band via the halo-exchanged tiled sweep."""
+    device computes its band via the halo-exchanged tiled sweep.  Narrow
+    steady-state chunk — the t=0 burst goes through _prime_2d."""
     n = cfg.num_agents
     dirs_local = s.dirs
     rows_local, words_local = dirs_local.shape
     a_shard = jax.lax.axis_index(AGENTS_AXIS)
     idx = jnp.arange(n, dtype=jnp.int32)
-    r = min(cfg.replan_chunk, n)
+    r = min(cfg.replan_chunk_small, n)
     own = s.need_replan & (s.slot // rows_local == a_shard)
 
     # The loop body runs tiles-axis collectives (halo exchange + fixpoint
@@ -178,6 +215,8 @@ def make_sharded2d_runner(cfg: SolverConfig, mesh: Mesh):
         in_specs=(specs, P(), P(TILES_AXIS, None)), out_specs=specs,
         check_vma=False)
     def run_shard(s, tasks, free_local):
+        s = _prime_2d(cfg, s, free_local)  # wide t=0 burst, off the hot loop
+
         def cond(s):
             return ~mapd_mod._finished(cfg, s)
 

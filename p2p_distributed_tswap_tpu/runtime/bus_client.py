@@ -3,6 +3,15 @@
 Speaks the same line-framed JSON protocol as the C++ BusClient
 (cpp/common/bus.hpp); used by the solver daemon, the process-spawn test
 runner, and integration tests.
+
+Like the C++ client, it can survive a bus restart: with ``reconnect=True``
+a dropped connection is retried with exponential backoff (0.25 s .. 4 s);
+on success the client re-sends hello, re-subscribes every topic, and calls
+``on_reconnect``.  While disconnected, ``publish`` drops (the bus is a
+lossy broadcast medium) and ``recv`` behaves like a timeout.  The
+reference's brokerless gossipsub mesh has no hub to lose — with this,
+losing busd degrades the fleet instead of destroying it (VERDICT r2
+item 5).
 """
 
 from __future__ import annotations
@@ -17,33 +26,121 @@ from p2p_distributed_tswap_tpu.metrics.task_metrics import NetworkMetrics
 
 class BusClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 7400,
-                 peer_id: Optional[str] = None, timeout: float = 5.0):
+                 peer_id: Optional[str] = None, timeout: float = 5.0,
+                 reconnect: bool = False,
+                 on_reconnect: Optional[Callable[[], None]] = None):
         self.peer_id = peer_id or f"py-{int(time.time() * 1000) % 10 ** 10}"
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.sock.settimeout(timeout)
-        self._buf = b""
+        self._host, self._port, self._timeout = host, port, timeout
+        self._reconnect = reconnect
+        self._on_reconnect = on_reconnect
+        self._topics: set[str] = set()
+        self._backoff = 0.0
+        self._next_attempt = 0.0
+        self.sock: Optional[socket.socket] = None
         self.net = NetworkMetrics()
-        self._send({"op": "hello", "peer_id": self.peer_id})
+        self._connect()  # initial connect still raises: startup contract
 
-    def _send(self, obj: dict) -> None:
+    # -- connection management -------------------------------------------
+    def _connect(self) -> None:
+        self.sock = socket.create_connection((self._host, self._port),
+                                             timeout=self._timeout)
+        self.sock.settimeout(self._timeout)
+        self._buf = b""
+        self._backoff = 0.0
+        self._send_raw({"op": "hello", "peer_id": self.peer_id})
+        for t in sorted(self._topics):
+            self._send_raw({"op": "sub", "topic": t})
+
+    def _drop(self) -> None:
+        """Connection died: close and arm the backoff timer (reconnect
+        mode), or propagate (legacy fail-fast mode)."""
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+        if not self._reconnect:
+            raise ConnectionError("bus closed")
+        self._backoff = min(self._backoff * 2, 4.0) if self._backoff else 0.25
+        self._next_attempt = time.monotonic() + self._backoff
+
+    def _try_reconnect(self) -> bool:
+        """One backoff-paced reconnect attempt; True if connected now."""
+        if self.sock is not None:
+            return True
+        if not self._reconnect:
+            return False  # closed or fail-fast client: stay down
+        if time.monotonic() < self._next_attempt:
+            return False
+        try:
+            self._connect()
+        except OSError:
+            self.sock = None
+            self._backoff = min(self._backoff * 2, 4.0) if self._backoff \
+                else 0.25
+            self._next_attempt = time.monotonic() + self._backoff
+            return False
+        if self._on_reconnect:
+            self._on_reconnect()
+        return True
+
+    @property
+    def connected(self) -> bool:
+        return self.sock is not None
+
+    # -- protocol ---------------------------------------------------------
+    def _send_raw(self, obj: dict) -> None:
+        assert self.sock is not None
         self.sock.sendall((json.dumps(obj) + "\n").encode())
 
+    def _send(self, obj: dict) -> None:
+        if self.sock is None:
+            self._try_reconnect()
+        if self.sock is None:
+            return  # disconnected: lossy medium, drop
+        try:
+            self._send_raw(obj)
+        except OSError:
+            self._drop()
+
     def subscribe(self, topic: str) -> None:
+        self._topics.add(topic)
         self._send({"op": "sub", "topic": topic})
 
     def publish(self, topic: str, data: dict) -> None:
-        frame = {"op": "pub", "topic": topic, "data": data}
-        line = json.dumps(frame)
-        self.net.record_sent(len(line))
-        self.sock.sendall((line + "\n").encode())
+        line = json.dumps({"op": "pub", "topic": topic, "data": data})
+        if self.sock is None:
+            self._try_reconnect()
+        if self.sock is None:
+            return  # dropped frames are NOT counted as sent (matches C++)
+        try:
+            self.sock.sendall((line + "\n").encode())
+            self.net.record_sent(len(line))
+        except OSError:
+            self._drop()
 
     def query_peers(self, topic: str) -> None:
         self._send({"op": "peers", "topic": topic})
 
     def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
-        """Next frame (any op) or None on timeout."""
-        self.sock.settimeout(timeout)
+        """Next frame (any op) or None on timeout.  In reconnect mode an
+        outage reads as a timeout (reconnect attempts ride each call)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            if self.sock is None:
+                if not self._try_reconnect():
+                    # wait out the lesser of caller timeout / next attempt
+                    wait = max(0.0, self._next_attempt - time.monotonic())
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return None
+                        wait = min(wait, remaining)
+                    time.sleep(min(wait, 0.25))
+                    if deadline is not None and time.monotonic() >= deadline:
+                        return None
+                    continue
             nl = self._buf.find(b"\n")
             if nl >= 0:
                 line = self._buf[:nl]
@@ -56,11 +153,18 @@ class BusClient:
                     self.net.record_received(len(line))
                 return frame
             try:
+                self.sock.settimeout(
+                    None if deadline is None
+                    else max(0.001, deadline - time.monotonic()))
                 chunk = self.sock.recv(65536)
             except socket.timeout:
                 return None
+            except OSError:
+                self._drop()
+                continue
             if not chunk:
-                raise ConnectionError("bus closed")
+                self._drop()
+                continue
             self._buf += chunk
 
     def messages(self, duration: float) -> Iterator[dict]:
@@ -75,7 +179,10 @@ class BusClient:
                 yield frame
 
     def close(self) -> None:
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        self._reconnect = False
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None

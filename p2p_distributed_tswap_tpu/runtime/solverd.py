@@ -49,16 +49,32 @@ from p2p_distributed_tswap_tpu.solver.step import step_parallel
 class PlanService:
     """Batched one-step planner with goal-field caching."""
 
+    # Fresh-goal sweeps per jitted program call: new goals arrive a few per
+    # tick (task churn), so a fixed small chunk keeps the program cached
+    # while bounding padding waste.  The startup burst just loops chunks.
+    FIELD_CHUNK = 8
+    # Packed field-cache memory ceiling: rows are preallocated at FULL
+    # budget up front so the step program's dirs shape never changes — the
+    # round-3 stress run showed each cache-growth recompile stalling whole
+    # ticks (tests/test_solverd_stress.py).
+    CACHE_BYTES = 256 << 20
+
     def __init__(self, grid: Grid, capacity_min: int = 16,
                  field_cache: int = 4096):
         self.grid = grid
         self.free = jnp.asarray(grid.free)
         self.capacity_min = capacity_min
-        self.max_fields = field_cache
+        pc = packed_cells(grid.num_cells)
+        self.max_fields = max(capacity_min,
+                              min(field_cache, self.CACHE_BYTES // (4 * pc)))
         # goal cell -> row index into the dirs buffer
         self.goal_rows: "OrderedDict[int, int]" = OrderedDict()
         self.dirs: jnp.ndarray | None = None  # (rows, ceil(HW/8)) packed uint32
         self._step = functools.partial(jax.jit, static_argnums=0)(step_parallel)
+        # jitted fixed-chunk sweep: eager per-op dispatch of the doubling
+        # scan cost ~5 s/tick on a 1-core host (stress test, round 3)
+        self._fields = jax.jit(lambda goals: pack_directions(
+            direction_fields(self.free, goals).reshape(goals.shape[0], -1)))
         self._last_cap = 0
         self._seen_programs = 0
 
@@ -71,33 +87,29 @@ class PlanService:
     def _ensure_fields(self, goals: List[int]) -> None:
         missing = [g for g in dict.fromkeys(goals) if g not in self.goal_rows]
         pc = packed_cells(self.grid.num_cells)
-        if self.dirs is None:
-            rows = max(self._capacity(len(missing)), self.capacity_min)
-            self.dirs = jnp.full((rows, pc), PACKED_STAY, jnp.uint32)
-        needed = len(self.goal_rows) + len(missing)
-        if needed > self.dirs.shape[0]:
-            grow = self.dirs.shape[0]
-            while grow < needed:
-                grow *= 2
-            self.dirs = jnp.concatenate(
-                [self.dirs,
-                 jnp.full((grow - self.dirs.shape[0], pc), PACKED_STAY,
-                          jnp.uint32)])
+        rows_budget = max(self.max_fields, self._capacity(len(goals)))
+        if self.dirs is None or self.dirs.shape[0] < rows_budget:
+            old = self.dirs
+            self.dirs = jnp.full((rows_budget, pc), PACKED_STAY, jnp.uint32)
+            if old is not None:  # only on a capacity jump past the budget
+                self.dirs = self.dirs.at[:old.shape[0]].set(old)
         if not missing:
             return
         # evict LRU rows when over budget — never a goal of the current
         # request (they sit at the LRU tail because plan() touches them
-        # before calling us, and the budget is clamped to the request size)
-        budget = max(self.max_fields, len(goals))
-        while len(self.goal_rows) + len(missing) > budget:
+        # before calling us, and the budget covers the request size)
+        while len(self.goal_rows) + len(missing) > self.dirs.shape[0]:
             self.goal_rows.popitem(last=False)
         used = set(self.goal_rows.values())
         free_rows = [r for r in range(self.dirs.shape[0]) if r not in used]
-        fields = direction_fields(self.free,
-                                  jnp.asarray(missing, jnp.int32))
-        fields = pack_directions(fields.reshape(len(missing), -1))
         rows = free_rows[:len(missing)]
-        self.dirs = self.dirs.at[jnp.asarray(rows)].set(fields)
+        c = self.FIELD_CHUNK
+        for o in range(0, len(missing), c):
+            chunk = missing[o:o + c]
+            padded = chunk + [chunk[-1]] * (c - len(chunk))
+            fields = self._fields(jnp.asarray(padded, jnp.int32))
+            crows = jnp.asarray(rows[o:o + len(chunk)], jnp.int32)
+            self.dirs = self.dirs.at[crows].set(fields[:len(chunk)])
         for g, r in zip(missing, rows):
             self.goal_rows[g] = r
 
@@ -173,7 +185,10 @@ def main(argv=None) -> int:
     # probe): accelerator init through the tunnel can take many seconds, and
     # plan_requests published meanwhile would be lost (the bus does not
     # replay).  The banner below is the readiness signal harnesses wait for.
-    bus = BusClient(port=args.port, peer_id="solverd")
+    # reconnect=True: a busd restart must not kill the planning daemon —
+    # it resubscribes and resumes answering plan_requests (the manager
+    # plans natively during the gap via its failover path)
+    bus = BusClient(port=args.port, peer_id="solverd", reconnect=True)
     bus.subscribe("solver")
 
     try:

@@ -15,6 +15,7 @@ looping until the set drains.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
@@ -313,6 +314,63 @@ def validate_tasks(grid: Grid, tasks) -> None:
         raise ValueError("task pickup/delivery cell on an obstacle")
 
 
+def prepare_state_unprimed(cfg: SolverConfig, starts: jnp.ndarray,
+                           tasks: jnp.ndarray
+                           ) -> Tuple[MapdState, jnp.ndarray]:
+    """:func:`prepare_state` minus the field burst: init + pre-loop
+    transitions + first assignment.  Callers that cannot run the burst as
+    one fused program (see :func:`host_prime_fields`) start here."""
+    if tasks.shape[0] == 0:
+        tasks = jnp.zeros((1, 2), jnp.int32)
+        s = init_state(cfg, starts, 1)
+        s = s.replace(task_used=jnp.ones(1, bool))
+    else:
+        s = init_state(cfg, starts, tasks.shape[0])
+    s = _transitions(cfg, s, tasks)
+    s = _assign(cfg, s, tasks)
+    return s, tasks
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _prime_chunk(cfg: SolverConfig, r: int, free: jnp.ndarray,
+                 goals: jnp.ndarray) -> jnp.ndarray:
+    f = direction_fields(free, goals, max_rounds=cfg.max_sweep_rounds)
+    return pack_directions(f.reshape(r, cfg.num_cells))
+
+
+# Donating the packed-fields buffer halves peak residency (4 GB instead of
+# 8 at the 4096^2 rung, where undonated updates still OOM after the
+# superseded-buffer fix).  The axon tunnel rejects donation on large fused
+# programs (bench.py docs), but this single-scatter program is
+# donation-clean — verified at 4 GiB on the real chip.
+@functools.partial(jax.jit, donate_argnums=0)
+def _prime_update(dirs, rows, fields):
+    return dirs.at[rows].set(fields)
+
+
+def host_prime_fields(cfg: SolverConfig, s: MapdState,
+                      free: jnp.ndarray) -> MapdState:
+    """The t=0 field burst as a HOST-driven loop of per-chunk device
+    programs instead of :func:`prime_fields`'s one fused scan.
+
+    Needed at EXTREME-class grids on the axon tunnel: a single program
+    scanning ~100 sweep chunks at (chunk, 4096, 4096) reliably crashes the
+    TPU worker (the same fused-multi-step fault class bench.py documents),
+    while the identical math dispatched chunk-by-chunk is stable.  The
+    jitted chunk programs live at module scope so repeated bursts (e.g.
+    bench.py's measure + completion passes) reuse the compiled sweep.
+    """
+    n, r = cfg.num_agents, min(cfg.replan_chunk, cfg.num_agents)
+    nchunks = -(-n // r)
+    for ci in range(nchunks):
+        sel = np.clip(np.arange(ci * r, (ci + 1) * r), 0, n - 1)
+        sel_j = jnp.asarray(sel, jnp.int32)
+        fields = _prime_chunk(cfg, r, free, s.goal[sel_j])
+        # rebind through s so the superseded dirs reference drops each chunk
+        s = s.replace(dirs=_prime_update(s.dirs, s.slot[sel_j], fields))
+    return s.replace(need_replan=jnp.zeros(cfg.num_agents, bool))
+
+
 def prepare_state(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
                   free: jnp.ndarray) -> Tuple[MapdState, jnp.ndarray]:
     """Initial state ready for stepping: init, first task assignment, and
@@ -327,14 +385,7 @@ def prepare_state(cfg: SolverConfig, starts: jnp.ndarray, tasks: jnp.ndarray,
     pickup phase) — so makespan can shrink by 1 for such agents and no
     PICKING step is recorded for them.  Collision-freedom is unaffected;
     the makespan-parity suite bounds the effect."""
-    if tasks.shape[0] == 0:
-        tasks = jnp.zeros((1, 2), jnp.int32)
-        s = init_state(cfg, starts, 1)
-        s = s.replace(task_used=jnp.ones(1, bool))
-    else:
-        s = init_state(cfg, starts, tasks.shape[0])
-    s = _transitions(cfg, s, tasks)
-    s = _assign(cfg, s, tasks)
+    s, tasks = prepare_state_unprimed(cfg, starts, tasks)
     return prime_fields(cfg, s, free), tasks
 
 

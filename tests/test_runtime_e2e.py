@@ -374,3 +374,120 @@ def test_manager_cli_metrics_and_reset(built, tiny_map, tmp_path):
         log = (tmp_path / "manager.log").read_text(errors="ignore")
         assert "Task Statistics" in log
         assert "state reset" in log
+
+
+@pytest.mark.parametrize("mode", ["decentralized", "centralized"])
+def test_fleet_survives_bus_restart(built, tiny_map, tmp_path, mode):
+    """Kill busd mid-run and restart it on the same port: every role must
+    reconnect with backoff, resubscribe, re-announce, and the fleet must
+    complete NEW tasks after the outage.  The reference's brokerless
+    gossipsub mesh has no hub to lose (manager.rs:94-98); this closes the
+    equivalent single-point-of-failure gap of the hub design (VERDICT r2
+    item 5)."""
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    new_bus = None
+    with Fleet(mode, num_agents=2, port=port, map_file=tiny_map,
+               log_dir=str(log_dir)) as fleet:
+        try:
+            time.sleep(4)  # discovery + initial positions
+            fleet.command("tasks 2")
+
+            def done_count():
+                return sum(
+                    f.read_text(errors="ignore").count("DONE")
+                    for f in log_dir.glob("agent_*.log"))
+
+            assert _wait_for(lambda: done_count() >= 1, timeout=45), (
+                "fleet not functional before the outage")
+
+            fleet.procs[0].kill()  # busd is the first spawned process
+            time.sleep(1.5)        # let every role notice and start backoff
+            new_bus = subprocess.Popen(
+                [str(BUILD_DIR / "mapd_bus"), str(port)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+            def all_reconnected():
+                logs = [f.read_text(errors="ignore")
+                        for f in log_dir.glob("*.log")
+                        if f.name != "bus.log"]
+                return all("bus: reconnected" in t for t in logs) and logs
+
+            assert _wait_for(all_reconnected, timeout=20), (
+                "roles did not reconnect: " + "".join(
+                    f.read_text(errors="ignore")[-300:]
+                    for f in sorted(log_dir.glob("*.log"))))
+
+            base = done_count()
+            fleet.command("tasks 2")
+            completed = _wait_for(lambda: done_count() >= base + 2,
+                                  timeout=60)
+            fleet.quit()
+            assert completed, (
+                "no task completions after bus restart: " + "".join(
+                    f.read_text(errors="ignore")[-400:]
+                    for f in sorted(log_dir.glob("*.log"))))
+        finally:
+            if new_bus is not None:
+                new_bus.kill()
+
+
+def test_python_bus_client_reconnects(built):
+    """The Python BusClient (solverd's transport) must also survive a busd
+    restart: resubscribe and resume delivery (VERDICT r2 item 5)."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    port = _free_port()
+    bus = subprocess.Popen([str(BUILD_DIR / "mapd_bus"), str(port)],
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+    bus2 = None
+    reconnects = []
+    try:
+        time.sleep(0.3)
+        sub = BusClient(port=port, peer_id="sub", reconnect=True,
+                        on_reconnect=lambda: reconnects.append(1))
+        pub = BusClient(port=port, peer_id="pub", reconnect=True)
+        sub.subscribe("t")
+        time.sleep(0.2)
+
+        def next_msg(client, timeout):
+            # skip non-msg frames (welcome handshake, peer events)
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                f = client.recv(
+                    timeout=max(0.05, deadline - time.monotonic()))
+                if f and f.get("op") == "msg":
+                    return f
+            return None
+
+        pub.publish("t", {"x": 1})
+        frame = next_msg(sub, 3.0)
+        assert frame and frame["data"]["x"] == 1
+
+        bus.kill()
+        bus.wait()
+        time.sleep(0.6)  # let both clients notice the outage
+        assert sub.recv(timeout=0.3) is None  # outage reads as timeout
+        bus2 = subprocess.Popen([str(BUILD_DIR / "mapd_bus"), str(port)],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        # publish until the resubscribed client sees a frame again
+        got = None
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and got is None:
+            pub.publish("t", {"x": 2})
+            f = next_msg(sub, 0.5)
+            if f and f["data"].get("x") == 2:
+                got = f
+        assert got, "no delivery after busd restart"
+        assert reconnects, "on_reconnect callback did not fire"
+        sub.close()
+        pub.close()
+    finally:
+        for p in (bus, bus2):
+            if p is not None and p.poll() is None:
+                p.kill()

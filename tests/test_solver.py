@@ -156,3 +156,32 @@ def test_congested_corridor_resolves():
     paths_pos, _, makespan = solve_offline(grid, starts, tasks)
     assert makespan <= 2000
     _check_paths(grid, paths_pos)
+
+
+def test_host_prime_matches_fused_prime():
+    """mapd.host_prime_fields (the axon-safe per-chunk burst used at
+    EXTREME-class grids) must produce bit-identical fields to the fused
+    prime_fields scan."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_distributed_tswap_tpu.core.sampling import start_positions_array
+    from p2p_distributed_tswap_tpu.core.tasks import TaskGenerator
+    from p2p_distributed_tswap_tpu.solver import mapd
+
+    grid = Grid.random_obstacles(24, 24, 0.1, seed=1)
+    n = 10
+    cfg = SolverConfig(height=24, width=24, num_agents=n, replan_chunk=4)
+    starts = start_positions_array(grid, n, seed=0)
+    tasks = TaskGenerator(grid, seed=1).generate_task_arrays(12)
+    free = jnp.asarray(grid.free)
+    s0, _ = jax.jit(functools.partial(mapd.prepare_state_unprimed, cfg))(
+        jnp.asarray(starts, jnp.int32), jnp.asarray(tasks, jnp.int32))
+    fused = mapd.prime_fields(cfg, s0, free)
+    hosted = mapd.host_prime_fields(cfg, s0, free)
+    np.testing.assert_array_equal(np.asarray(fused.dirs),
+                                  np.asarray(hosted.dirs))
+    assert not np.asarray(hosted.need_replan).any()

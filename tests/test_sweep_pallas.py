@@ -1,0 +1,57 @@
+"""ops/sweep_pallas.py — the Pallas sequential sweep kernel.
+
+The compiled kernel needs a real TPU; CI runs it through the Pallas
+interpreter (sweep_pallas.INTERPRET) and checks BIT-IDENTITY against the
+XLA doubling-scan sweep on random obstacle fields — the two formulations
+are the same integer recurrence, so any mismatch is a bug, not noise.
+On-chip bit-identity at 256²/512² was verified during round 3
+(SCALING.md "Pallas: GO").
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.ops import distance, sweep_pallas
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    sweep_pallas.INTERPRET = True
+    yield
+    sweep_pallas.INTERPRET = False
+
+
+def _xla_sweep(d, free_b, axis, reverse):
+    h, w = d.shape[1], d.shape[2]
+    xc = jnp.arange(w, dtype=jnp.int32).reshape(1, 1, w)
+    yc = jnp.arange(h, dtype=jnp.int32).reshape(1, h, 1)
+    coord = xc if axis == 2 else yc
+    return distance._sweep_xla(d, free_b, axis, reverse,
+                               -coord if reverse else coord)
+
+
+@pytest.mark.parametrize("axis,reverse", [(1, False), (1, True),
+                                          (2, False), (2, True)])
+def test_kernel_matches_xla_sweep(axis, reverse):
+    rng = np.random.default_rng(axis * 2 + reverse)
+    h = w = 128  # one lane strip, 16 sublane tiles: exercises the tiling
+    free = rng.random((h, w)) > 0.25
+    d = np.where(rng.random((3, h, w)) > 0.97,
+                 rng.integers(0, 50, (3, h, w)), int(distance.INF))
+    d = np.where(free[None], d, int(distance.INF)).astype(np.int32)
+    free_j = jnp.asarray(free)
+    free_b = jnp.broadcast_to(free_j[None], d.shape)
+    ref = np.asarray(_xla_sweep(jnp.asarray(d), free_b, axis, reverse))
+    pal = np.asarray(sweep_pallas.sweep(jnp.asarray(d), free_j, axis,
+                                        reverse))
+    np.testing.assert_array_equal(ref, pal)
+
+
+def test_eligibility_gate():
+    # CPU backend: never eligible (compiled kernel needs the TPU)
+    assert not sweep_pallas.sweep_eligible(256, 256) or \
+        sweep_pallas._on_tpu()
+    # unaligned grids never eligible regardless of backend
+    assert not sweep_pallas.sweep_eligible(100, 100)
+    assert not sweep_pallas.sweep_eligible(256, 100)
