@@ -150,10 +150,17 @@ def worst_case_distribution(quick: bool):
         if r > worst[0]:
             worst = (r, seed)
     arr = np.sort(np.array(ratios))
+    if not len(arr):  # every sampled seed deadlocked the oracle
+        print("worst-case distribution: no oracle-completing seeds",
+              flush=True)
+        return {"seeds": n, "completing": 0, "mean": float("nan"),
+                "median": float("nan"), "p90": float("nan"),
+                "max": float("nan"), "min": float("nan"),
+                "frac_below_1": float("nan"), "worst_seed": None}, []
     stats = {
         "seeds": n, "completing": len(arr),
         "mean": float(arr.mean()), "median": float(np.median(arr)),
-        "p90": float(arr[int(0.9 * len(arr))]),
+        "p90": float(arr[min(int(0.9 * len(arr)), len(arr) - 1)]),
         "max": float(arr.max()), "min": float(arr.min()),
         "frac_below_1": float((arr < 1.0).mean()),
         "worst_seed": worst[1],

@@ -76,9 +76,8 @@ def bench_single(cfg, starts, tasks, free, steps):
 
 
 def _prep_replicated(cfg, starts, tasks):
-    s = mapd.init_state(cfg, starts, tasks.shape[0])
-    s = mapd._transitions(cfg, s, tasks)
-    return mapd._assign(cfg, s, tasks)
+    s, _ = mapd.prepare_state_unprimed(cfg, starts, tasks)
+    return s
 
 
 def bench_sharded(cfg, starts, tasks, free, steps):

@@ -193,15 +193,26 @@ def bench_step_window(scn, seed: int = 0, no_full: bool = False):
     if full:
         # run to completion STEP-WISE as well: the fused whole-solve
         # program trips the same backend fault the step window avoids.
-        # The done flag is fetched per step (~RTT each), which does not
-        # distort the makespan — only this extra's wall time.
+        # The tunnel charges a ~100 ms floor per SYNC fetch, so the done
+        # flag is fetched only every DONE_EVERY steps; the exact makespan
+        # comes from a device-resident register that latches s.t at the
+        # first finished step (steps past completion are harmless no-ops
+        # for positions — tasks stay done, agents stay parked).
+        DONE_EVERY = 8
         done = jax.jit(functools.partial(mapd._finished, cfg))
+        mark = jax.jit(lambda s, dt: jnp.where(
+            (dt < 0) & mapd._finished(cfg, s), s.t, dt))
         s2, t2 = prepare(jnp.asarray(tasks, jnp.int32))
-        while not bool(done(s2)):
-            prev = s2.pos
-            s2 = step(s2, t2, free_j)
-            ok = ok & check(prev, s2.pos, free_j)
-        makespan = int(s2.t)
+        done_t = jnp.int32(-1)
+        finished = False
+        while not finished:
+            for _ in range(DONE_EVERY):
+                prev = s2.pos
+                s2 = step(s2, t2, free_j)
+                ok = ok & check(prev, s2.pos, free_j)
+                done_t = mark(s2, done_t)
+            finished = bool(done(s2))
+        makespan = int(done_t)
     return 1000.0 * elapsed / MEASURE_STEPS, makespan, bool(ok)
 
 

@@ -181,7 +181,9 @@ class BusClient {
     if (!reconnect_) return false;
     int64_t now = mono_ms();
     if (now < next_attempt_ms_) return true;  // not due yet
-    int fd = tcp_connect(host_, port_);
+    // bounded connect: a silently-unreachable bus host must not freeze
+    // the single-threaded role loop for the kernel SYN timeout
+    int fd = tcp_connect_timeout(host_, port_, 250);
     if (fd < 0) {
       backoff_ms_ = backoff_ms_ ? std::min<int64_t>(backoff_ms_ * 2, 4000)
                                 : 250;

@@ -104,12 +104,17 @@ class PlanService:
         free_rows = [r for r in range(self.dirs.shape[0]) if r not in used]
         rows = free_rows[:len(missing)]
         c = self.FIELD_CHUNK
+        # compute in fixed chunks (cached program), scatter ONCE: each
+        # .at[].set on the preallocated buffer copies the whole cache, so a
+        # startup burst must not pay one copy per chunk
+        parts = []
         for o in range(0, len(missing), c):
             chunk = missing[o:o + c]
             padded = chunk + [chunk[-1]] * (c - len(chunk))
-            fields = self._fields(jnp.asarray(padded, jnp.int32))
-            crows = jnp.asarray(rows[o:o + len(chunk)], jnp.int32)
-            self.dirs = self.dirs.at[crows].set(fields[:len(chunk)])
+            parts.append(self._fields(jnp.asarray(padded,
+                                                  jnp.int32))[:len(chunk)])
+        fields = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        self.dirs = self.dirs.at[jnp.asarray(rows, jnp.int32)].set(fields)
         for g, r in zip(missing, rows):
             self.goal_rows[g] = r
 
@@ -145,7 +150,7 @@ class PlanService:
             active[k] = True
         new_pos, new_goal, _ = self._step(
             cfg, jnp.asarray(pos), jnp.asarray(goal), jnp.asarray(slot),
-            self.dirs[:, :], jnp.asarray(active))
+            self.dirs, jnp.asarray(active))
         new_pos = np.asarray(new_pos)
         new_goal = np.asarray(new_goal)
         new_cache = getattr(self._step, "_cache_size", lambda: None)()
