@@ -199,7 +199,19 @@ def directions_from_distance(dist: jnp.ndarray, free: jnp.ndarray) -> jnp.ndarra
 
 def direction_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
                      max_rounds: int = 128) -> jnp.ndarray:
-    """(G, H, W) uint8 next-hop directions toward each goal."""
+    """(G, H, W) uint8 next-hop directions toward each goal.
+
+    Default path: the sweep/extract pipeline below (whose directional
+    sweeps dispatch to the Pallas strip kernel on eligible TPU shapes).
+    With MAPD_FUSED=1 (experimental, measured slower — see
+    ops/field_fused.py) VMEM-resident fields instead run as one fused
+    seed -> fixpoint -> codes kernel launch per field."""
+    from p2p_distributed_tswap_tpu.ops import field_fused
+
+    h, w = free.shape
+    if field_fused.fused_eligible(h, w):
+        return field_fused.fused_direction_fields(free, goals_idx,
+                                                  max_rounds)
     return directions_from_distance(distance_fields(free, goals_idx, max_rounds),
                                     free)
 

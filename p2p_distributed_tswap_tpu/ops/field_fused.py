@@ -1,0 +1,255 @@
+"""Fully-fused per-field Pallas kernel: goal seed -> BFS fixpoint ->
+next-hop direction codes, one kernel launch per direction field.
+
+STATUS: a validated experiment, DISABLED by default (see fused_eligible).
+Hypothesis was that the replan's per-field cost (~3.5 ms vs a ~0.2 ms
+bandwidth bound) was launch/transpose/fixpoint-round-trip overhead that
+one fused launch would eliminate; measurement says otherwise — real
+steps got SLOWER (medium 35 -> 66 ms/step, flagship 127 -> 156) because
+grid programs serialize per core and the per-(8, W)-tile loop bodies
+underfill the VPU, while the XLA pipeline overlaps its doubling scans
+across the whole field batch.  The replan's floor is vector-issue bound,
+not HBM or launch bound.  Kept (with interpreter + on-chip bit-identity
+tests) as the base for a future multi-field-per-program variant.
+
+The kernel keeps one whole field resident in VMEM and does EVERYTHING
+on-chip:
+
+- seeds the distance field from the goal cell,
+- iterates fast-sweeping rounds (4 directional passes) to the exact BFS
+  fixpoint with an on-chip convergence flag,
+- derives the reference-ordered next-hop codes (DIR_DXDY tie-break,
+  stay conditions) — emitting (H, W) uint8 codes per field.
+
+Per-field HBM traffic drops to: read mask once + write codes once.
+
+Layout: grid (G,); each program owns one field.  The distance scratch is
+(H+16, W): one full 8-row INF halo TILE above and below the field, so
+every ref access — sweeps, and the neighbor-tile reads in the code
+extraction — is an 8-aligned (8, W) block (Mosaic requires dynamic
+sublane indices provably divisible by the tile height; single-row halos
+do not lower).  Row (y) passes run the sequential min-plus recurrence
+over (8, W) sublane tiles; lane (x) passes run an in-register segmented
+doubling scan along lanes per (8, W) tile (all VMEM, no HBM traffic).
+Row-neighbor values for the code extraction come from statically sliced
+register concatenations of the adjacent aligned tiles.
+
+Eligibility (``fused_eligible``): TPU backend, H % 8 == 0,
+W % 128 == 0, and the VMEM working set (distance scratch + mask + codes
++ doubling temporaries) fits — fields up to ~1024x1024.  Larger grids
+(4096^2) keep the strip kernel.  Kill-switch shared with the strip
+kernel: MAPD_NO_PALLAS=1.
+
+Bit-identity: the integer math is the same recurrence as
+ops.distance._sweep_xla + directions_from_distance; verified in
+interpreter mode (tests/test_field_fused.py) and on-chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from p2p_distributed_tswap_tpu.ops.sweep_pallas import _on_tpu
+
+INF = np.int32(1 << 30)
+DIR_STAY = np.uint8(4)
+SUB = 8          # sublane tile height
+LANES = 128
+# VMEM budget for the (H+16, W) int32 distance scratch; leaves room for
+# the mask, codes, and doubling temporaries inside ~16 MB of VMEM.
+MAX_SCRATCH_BYTES = 6 << 20
+HALO = SUB  # one aligned tile of INF halo rows above and below
+
+# Tests flip this to run through the Pallas interpreter on CPU.
+INTERPRET = False
+
+
+def fused_eligible(h: int, w: int) -> bool:
+    """OPT-IN only (MAPD_FUSED=1): measured SLOWER than the strip-kernel
+    pipeline in real steps (medium 35 -> 66 ms/step, flagship 127 -> 156;
+    round 3) — one program per field serializes on the single TensorCore
+    and the per-tile fori loops starve the VPU, while the XLA pipeline
+    overlaps its doubling scans across the whole batch.  Kept as a
+    validated (bit-identical on-chip) experiment and a base for a future
+    multi-field-per-program variant."""
+    import os
+
+    if os.environ.get("MAPD_FUSED") != "1":
+        return False
+    return (_on_tpu() and h % SUB == 0 and w % LANES == 0
+            and (h + 2 * HALO) * w * 4 <= MAX_SCRATCH_BYTES)
+
+
+def _lane_seg_scan(v, blocked, reverse: bool, w: int):
+    """Segmented min-scan along lanes (axis 1) of an (8, W) tile with
+    resets at blocked cells — the in-register doubling form of
+    ops.distance._seg_min_scan.  The reset flags ride as int32 0/1:
+    Mosaic cannot rotate sub-32-bit vectors."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    r = blocked.astype(jnp.int32)
+    off = 1
+    while off < w:
+        if reverse:
+            # pltpu.roll requires a non-negative shift; w - off is the
+            # circular equivalent of rolling by -off
+            valid = lane < w - off
+            shift = w - off
+        else:
+            valid = lane >= off
+            shift = off
+        sv = jnp.where(valid, pltpu.roll(v, shift, 1), INF + w)
+        sr = jnp.where(valid, pltpu.roll(r, shift, 1), 0)
+        v = jnp.where(r != 0, v, jnp.minimum(v, sv))
+        r = r | sr
+        off *= 2
+    return v
+
+
+def _kernel(h: int, w: int, max_rounds: int,
+            goal_ref, m_ref, o_ref, d_ref):
+    nt = h // SUB
+    lane = jax.lax.broadcasted_iota(jnp.int32, (SUB, w), 1)
+    row_in_tile = jax.lax.broadcasted_iota(jnp.int32, (SUB, w), 0)
+
+    # ---- seed: halo tiles INF, interior = 0 at the goal cell (if free) ----
+    g = goal_ref[pl.program_id(0)]
+
+    def seed_tile(t, _):
+        base = t * SUB
+        cell = (base + row_in_tile) * w + lane
+        blocked = m_ref[pl.ds(base, SUB), :] != 0
+        d_ref[pl.ds(HALO + base, SUB), :] = jnp.where(
+            (cell == g) & ~blocked, jnp.int32(0), INF)
+        return 0
+
+    jax.lax.fori_loop(0, nt, seed_tile, 0)
+    inf_tile = jnp.full((SUB, w), INF, jnp.int32)
+    d_ref[pl.ds(0, SUB), :] = inf_tile
+    d_ref[pl.ds(HALO + h, SUB), :] = inf_tile
+
+    # ---- one directional pass along rows (y), sequential recurrence ----
+    def y_pass(reverse: bool):
+        def tile_body(t, carry):
+            run, changed = carry
+            tt = (nt - 1 - t) if reverse else t
+            base = tt * SUB
+            tile_d = d_ref[pl.ds(HALO + base, SUB), :]
+            tile_b = m_ref[pl.ds(base, SUB), :] != 0
+            rows = [None] * SUB
+            order = range(SUB - 1, -1, -1) if reverse else range(SUB)
+            for k in order:
+                run = jnp.minimum(run + 1, tile_d[k:k + 1, :])
+                run = jnp.where(tile_b[k:k + 1, :], INF, run)
+                rows[k] = jnp.where(tile_b[k:k + 1, :], INF,
+                                    jnp.minimum(run, INF))
+            out = jnp.concatenate(rows, axis=0)
+            changed = changed | jnp.any(out != tile_d)
+            d_ref[pl.ds(HALO + base, SUB), :] = out
+            return run, changed
+
+        init = jnp.full((1, w), INF, jnp.int32)
+        _, changed = jax.lax.fori_loop(0, nt, tile_body,
+                                       (init, jnp.bool_(False)))
+        return changed
+
+    # ---- one directional pass along lanes (x), per (8, W) tile ----
+    def x_pass(reverse: bool):
+        coord = jnp.where(jnp.bool_(reverse), -lane, lane)
+
+        def tile_body(t, changed):
+            base = t * SUB
+            tile_d = d_ref[pl.ds(HALO + base, SUB), :]
+            tile_b = m_ref[pl.ds(base, SUB), :] != 0
+            v = jnp.where(tile_b, INF + w, tile_d - coord)
+            m = _lane_seg_scan(v, tile_b, reverse, w)
+            relaxed = jnp.where(tile_b, INF,
+                                jnp.minimum(tile_d, m + coord))
+            relaxed = jnp.minimum(relaxed, INF)
+            changed = changed | jnp.any(relaxed != tile_d)
+            d_ref[pl.ds(HALO + base, SUB), :] = relaxed
+            return changed
+
+        return jax.lax.fori_loop(0, nt, tile_body, jnp.bool_(False))
+
+    # ---- fixpoint: sweep rounds until no pass changes anything ----
+    def round_cond(carry):
+        changed, i = carry
+        return changed & (i < max_rounds)
+
+    def round_body(carry):
+        _, i = carry
+        c = x_pass(False)
+        c = c | x_pass(True)
+        c = c | y_pass(False)
+        c = c | y_pass(True)
+        return c, i + 1
+
+    jax.lax.while_loop(round_cond, round_body,
+                       (jnp.bool_(True), jnp.int32(0)))
+
+    # ---- next-hop codes (reference neighbor order, first-min strict) ----
+    def code_tile(t, _):
+        base = t * SUB
+        cur = d_ref[pl.ds(HALO + base, SUB), :]
+        # adjacent tiles are aligned reads (halo tiles cover t=0 / t=nt-1);
+        # the +-1-row neighbor views are register concatenations
+        prev_t = d_ref[pl.ds(base, SUB), :]
+        next_t = d_ref[pl.ds(HALO + SUB + base, SUB), :]
+        up = jnp.concatenate([prev_t[SUB - 1:SUB, :], cur[:SUB - 1, :]],
+                             axis=0)                    # row - 1 (dy = -1)
+        down = jnp.concatenate([cur[1:, :], next_t[0:1, :]],
+                               axis=0)                  # row + 1 (dy = +1)
+        right = jnp.where(lane < w - 1, pltpu.roll(cur, w - 1, 1), INF)
+        left = jnp.where(lane >= 1, pltpu.roll(cur, 1, 1), INF)
+        blocked = m_ref[pl.ds(base, SUB), :] != 0
+
+        # codes ride as int32 inside the kernel: Mosaic rejects the
+        # relayouts that mixing i1 masks with 8-bit vectors requires
+        best = jnp.full((SUB, w), int(DIR_STAY), jnp.int32)
+        best_val = jnp.full((SUB, w), INF, jnp.int32)
+        # DIR_DXDY order: (0,1)=down, (1,0)=right, (0,-1)=up, (-1,0)=left
+        for k, nv in enumerate((down, right, up, left)):
+            better = nv < best_val
+            best = jnp.where(better, jnp.int32(k), best)
+            best_val = jnp.minimum(best_val, nv)
+        stay = ((cur == 0) | (cur >= INF) | (best_val >= INF)
+                | (best_val >= cur) | blocked)
+        o_ref[pl.ds(base, SUB), :] = jnp.where(stay, jnp.int32(DIR_STAY),
+                                               best)
+        return 0
+
+    jax.lax.fori_loop(0, nt, code_tile, 0)
+
+
+def fused_direction_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
+                           max_rounds: int = 128) -> jnp.ndarray:
+    """(G, H, W) uint8 next-hop codes — drop-in replacement for
+    ops.distance.direction_fields on eligible shapes."""
+    h, w = free.shape
+    g = goals_idx.shape[0]
+    mask = (~free).astype(jnp.int8)
+    kernel = functools.partial(_kernel, h, w, max_rounds)
+    codes = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((g, h, w), jnp.int32),
+        grid=(g,),
+        in_specs=[
+            # whole goals vector in SMEM; each program picks its own entry
+            # (rank-1 SMEM blocks must cover the array on TPU)
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((h, w), lambda gi: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((None, h, w), lambda gi: (gi, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((h + 2 * HALO, w), jnp.int32)],
+        interpret=INTERPRET,
+    )(goals_idx.astype(jnp.int32), mask)
+    return codes.astype(jnp.uint8)

@@ -54,7 +54,17 @@ def _on_tpu() -> bool:
     if os.environ.get("MAPD_NO_PALLAS") == "1":
         return False
     try:
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() != "tpu":
+            return False
+        # CPU-pinned processes (tests/conftest.py, pin_cpu_backend) keep
+        # the TPU plugin registered, so default_backend() alone lies:
+        # honor the configured default device.  It may be a Device object
+        # OR a platform string ('cpu') — treat both forms.
+        dd = jax.config.jax_default_device
+        if dd is None:
+            return True
+        platform = dd if isinstance(dd, str) else getattr(dd, "platform", "")
+        return platform == "tpu"
     except RuntimeError:
         return False
 

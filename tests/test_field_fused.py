@@ -1,0 +1,57 @@
+"""ops/field_fused.py — the fully-fused per-field kernel.
+
+Interpreter-mode bit-identity against the portable pipeline
+(distance_fields + directions_from_distance) on adversarial inputs:
+random obstacles, unreachable pockets, goal on an obstacle, goal in a
+corner.  On-chip bit-identity at 256^2/1024^2 was verified in round 3.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.ops import distance, field_fused
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    field_fused.INTERPRET = True
+    yield
+    field_fused.INTERPRET = False
+
+
+def _reference(free, goals):
+    return np.asarray(distance.directions_from_distance(
+        distance.distance_fields(free, goals), free))
+
+
+def _fused(free, goals):
+    return np.asarray(field_fused.fused_direction_fields(free, goals))
+
+
+def test_random_obstacles_bit_identical():
+    rng = np.random.default_rng(0)
+    free_np = rng.random((128, 128)) > 0.3  # dense walls: pockets exist
+    free = jnp.asarray(free_np)
+    cells = np.flatnonzero(free_np.reshape(-1))
+    goals = jnp.asarray(rng.choice(cells, 3), jnp.int32)
+    np.testing.assert_array_equal(_reference(free, goals),
+                                  _fused(free, goals))
+
+
+def test_goal_on_obstacle_and_corner():
+    rng = np.random.default_rng(1)
+    free_np = rng.random((64, 128)) > 0.2
+    free_np[0, 0] = True       # corner goal
+    free_np[5, 7] = False      # obstacle goal
+    free = jnp.asarray(free_np)
+    goals = jnp.asarray([0, 5 * 128 + 7, 63 * 128 + 127], jnp.int32)
+    np.testing.assert_array_equal(_reference(free, goals),
+                                  _fused(free, goals))
+
+
+def test_empty_grid_single_goal():
+    free = jnp.ones((8, 128), bool)
+    goals = jnp.asarray([3 * 128 + 64], jnp.int32)
+    np.testing.assert_array_equal(_reference(free, goals),
+                                  _fused(free, goals))
