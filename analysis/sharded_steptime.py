@@ -41,7 +41,7 @@ from p2p_distributed_tswap_tpu.core.tasks import TaskGenerator  # noqa: E402
 from p2p_distributed_tswap_tpu.parallel import (  # noqa: E402
     sharded, sharded2d)
 from p2p_distributed_tswap_tpu.parallel.mesh import (  # noqa: E402
-    AGENTS_AXIS, TILES_AXIS, agent_mesh, agent_tile_mesh)
+    TILES_AXIS, agent_mesh, agent_tile_mesh)
 from p2p_distributed_tswap_tpu.solver import mapd  # noqa: E402
 
 WARMUP = 8
@@ -82,10 +82,7 @@ def _prep_replicated(cfg, starts, tasks):
 
 def bench_sharded(cfg, starts, tasks, free, steps):
     mesh = agent_mesh(devices=DEVICES)
-    specs = sharded.MapdState(
-        pos=P(), goal=P(), slot=P(), dirs=P(AGENTS_AXIS, None), phase=P(),
-        agent_task=P(), task_used=P(), need_replan=P(), t=P(),
-        paths_pos=P(), paths_state=P())
+    specs = sharded.agent_state_specs()
     sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
     step = jax.jit(sm(functools.partial(sharded.sharded_mapd_step, cfg),
                       in_specs=(specs, P(), P()), out_specs=specs))

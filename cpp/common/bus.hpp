@@ -182,8 +182,14 @@ class BusClient {
     int64_t now = mono_ms();
     if (now < next_attempt_ms_) return true;  // not due yet
     // bounded connect: a silently-unreachable bus host must not freeze
-    // the single-threaded role loop for the kernel SYN timeout
-    int fd = tcp_connect_timeout(host_, port_, 250);
+    // the single-threaded role loop for the kernel SYN timeout.  The
+    // timeout scales with the backoff (250 ms first try, up to 1 s) so a
+    // reachable-but-slow link (SYN+accept > 250 ms) converges instead of
+    // aborting every attempt forever.
+    int fd = tcp_connect_timeout(
+        host_, port_,
+        static_cast<int>(std::min<int64_t>(std::max<int64_t>(backoff_ms_, 250),
+                                           1000)));
     if (fd < 0) {
       backoff_ms_ = backoff_ms_ ? std::min<int64_t>(backoff_ms_ * 2, 4000)
                                 : 250;

@@ -465,7 +465,11 @@ int main(int argc, char** argv) {
               // keep it in the work loop, but don't count the duplicate
               log_warn("⚠️  duplicate done for task %lld (%s) ignored\n",
                        tid, peer.c_str());
-              if (it != agents.end() && pending_tasks.empty())
+              // only refill a task-FREE reporter: a late done for an old
+              // task (original agent of a requeued task reporting after
+              // re-dispatch) must not overwrite an in-flight assignment
+              if (it != agents.end() && !it->second.task
+                  && pending_tasks.empty())
                 assign_task(peer, make_task());
               try_assign_pending();
             } else {
@@ -490,7 +494,10 @@ int main(int argc, char** argv) {
               // auto-reassign on completion (ref :908-950): queued tasks
               // (incl. ones re-queued from dead agents) drain before a fresh
               // task is generated, so orphans cannot starve behind auto-refill
-              if (it != agents.end() && pending_tasks.empty())
+              // guarded on !task for the same late-duplicate-done reason
+              // as the branch above: never clobber an in-flight assignment
+              if (it != agents.end() && !it->second.task
+                  && pending_tasks.empty())
                 assign_task(peer, make_task());
               try_assign_pending();
             }

@@ -16,6 +16,16 @@ import dataclasses
 from typing import Optional
 
 
+def stale_knobs_active(visibility_radius, view_refresh_steps,
+                       view_ttl_steps, swap_commit_delay) -> bool:
+    """THE definition of "stale decentralized semantics engaged" — shared
+    by SolverConfig.stale_mode (kernel selection) and the scenario/bench
+    mode labels so the two can never disagree."""
+    return visibility_radius is not None and (
+        view_refresh_steps > 1 or swap_commit_delay > 0
+        or view_ttl_steps is not None)
+
+
 @dataclasses.dataclass(frozen=True)
 class SolverConfig:
     """Static (compile-time) solver parameters.
@@ -45,6 +55,31 @@ class SolverConfig:
     # Decentralized-mode visibility radius (Manhattan); None = centralized
     # global view. Ref: TSWAP_RADIUS=15, src/bin/decentralized/agent.rs:796-801.
     visibility_radius: Optional[int] = None
+    # --- stale/async decentralized semantics (ref agent.rs:156-167,
+    # 730-789, 1041-1087) ----------------------------------------------
+    # Neighbor-view refresh period in steps (the 500 ms position-broadcast
+    # cadence analog): agent i re-publishes its (pos, goal) into the shared
+    # view every ``view_refresh_steps`` steps on a per-agent phase offset
+    # (i mod K), so cadences are decoupled like the reference's
+    # per-process timers.  1 = every step (fresh views).
+    view_refresh_steps: int = 1
+    # View age-out in steps (the 10 s neighbor TTL analog, ref
+    # agent.rs:156-167): view entries older than this are invisible
+    # (their agent effectively absent).  None = no expiry.
+    view_ttl_steps: Optional[int] = None
+    # Goal-swap / rotation commit latency in steps: 1 = decisions taken at
+    # step t commit at the START of step t+1 — the non-atomic wire
+    # coordination analog (ref agent.rs:1041-1087: both sides mutate goals
+    # at message-receipt time, not decision time); 0 = atomic in-step.
+    # Only {0, 1} are meaningful (the pending buffer holds ONE step of
+    # in-flight exchanges); validated in __post_init__.
+    swap_commit_delay: int = 0
+
+    def __post_init__(self):
+        if self.swap_commit_delay not in (0, 1):
+            raise ValueError(
+                f"swap_commit_delay={self.swap_commit_delay}: only 0 "
+                "(atomic) or 1 (one-step wire latency) are supported")
     # Rounds of the (Rule 3, Rule 4) goal-swapping phase per step.  The
     # reference's sequential pass lets swaps cascade within one step
     # (src/algorithm/tswap.rs:180-252); extra parallel rounds approximate that.
@@ -66,6 +101,18 @@ class SolverConfig:
     @property
     def num_cells(self) -> int:
         return self.height * self.width
+
+    @property
+    def stale_mode(self) -> bool:
+        """True when the decentralized kernel must model stale views and/or
+        asynchronous coordination (the reference's actual decentralized
+        reality) instead of the fresh-atomic radius mask.  Requires a
+        visibility radius: staleness is a property of the neighbor view,
+        and the centralized solver has no view — it has the truth."""
+        return stale_knobs_active(self.visibility_radius,
+                                  self.view_refresh_steps,
+                                  self.view_ttl_steps,
+                                  self.swap_commit_delay)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -94,11 +94,17 @@ def _sweep(d: jnp.ndarray, free: jnp.ndarray, axis: int, reverse: bool,
     distance.  ``coord`` is the (broadcastable) position along ``axis``,
     negated by the caller for reverse sweeps.
     """
-    if d.ndim == 3 and free.ndim == 3:
+    if d.ndim == 3 and free.ndim == 2:
+        # A 2-D ``free`` is the explicit "one mask shared by the whole
+        # (R, H, W) field batch" contract the Pallas kernel requires (it
+        # sweeps every field against this single mask).  A caller with
+        # genuinely per-field masks must pass a 3-D ``free`` and falls
+        # through to the XLA path — it cannot silently get wrong sweeps.
         from p2p_distributed_tswap_tpu.ops import sweep_pallas
 
         if sweep_pallas.sweep_eligible(d.shape[1], d.shape[2]):
-            return sweep_pallas.sweep(d, free[0], axis, reverse)
+            return sweep_pallas.sweep(d, free, axis, reverse)
+        free = jnp.broadcast_to(free[None], d.shape)
     return _sweep_xla(d, free, axis, reverse, coord)
 
 
@@ -138,7 +144,7 @@ def distance_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
 
     xcoord = jnp.arange(w, dtype=jnp.int32).reshape(1, 1, w)
     ycoord = jnp.arange(h, dtype=jnp.int32).reshape(1, h, 1)
-    free_b = jnp.broadcast_to(free[None], (g, h, w))
+    free_b = free  # 2-D: one mask shared by the whole batch (see _sweep)
 
     def one_round(d):
         d = _sweep(d, free_b, axis=2, reverse=False, coord=xcoord)

@@ -101,7 +101,7 @@ def tiled_distance_fields(free_local: jnp.ndarray, goals_idx: jnp.ndarray,
 
     xcoord = jnp.arange(w, dtype=jnp.int32).reshape(1, 1, w)
     ycoord = jnp.arange(h_local, dtype=jnp.int32).reshape(1, h_local, 1)
-    free_b = jnp.broadcast_to(free_local[None], (g, h_local, w))
+    free_b = free_local  # 2-D shared-mask contract (ops.distance._sweep)
 
     def one_round(d):
         d = _sweep(d, free_b, axis=2, reverse=False, coord=xcoord)

@@ -155,6 +155,19 @@ def sharded_mapd_step(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray,
                               nh_factory=_nh_factory)
 
 
+def agent_state_specs() -> MapdState:
+    """shard_map partition specs for MapdState on the 1-D agent mesh: only
+    the direction-field rows shard (the dominant buffer); every (N,) vector
+    and the stale-view fields are replicated (they feed replicated rule
+    phases).  Single source of truth for every 1-D-mesh entry point
+    (__graft_entry__, analysis/sharded_steptime.py)."""
+    return MapdState(
+        pos=P(), goal=P(), slot=P(), dirs=P(AGENTS_AXIS, None), phase=P(),
+        agent_task=P(), task_used=P(), need_replan=P(), t=P(),
+        paths_pos=P(), paths_state=P(),
+        vpos=P(), vgoal=P(), vstamp=P(), pend_from=P(), pend_push=P())
+
+
 def make_sharded_runner(cfg: SolverConfig, mesh: Mesh | None = None,
                         num_tasks: int | None = None):
     """Build a jitted sharded end-to-end MAPD solve over ``mesh``.
@@ -167,10 +180,7 @@ def make_sharded_runner(cfg: SolverConfig, mesh: Mesh | None = None,
     assert cfg.num_agents % n_dev == 0, (
         f"num_agents={cfg.num_agents} must divide over {n_dev} devices")
 
-    state_specs = MapdState(
-        pos=P(), goal=P(), slot=P(), dirs=P(AGENTS_AXIS, None), phase=P(),
-        agent_task=P(), task_used=P(), need_replan=P(), t=P(),
-        paths_pos=P(), paths_state=P())
+    state_specs = agent_state_specs()
 
     @functools.partial(
         jax.shard_map, mesh=mesh,

@@ -188,7 +188,8 @@ def state_specs_2d() -> MapdState:
         pos=P(), goal=P(), slot=P(),
         dirs=P(AGENTS_AXIS, TILES_AXIS), phase=P(),
         agent_task=P(), task_used=P(), need_replan=P(), t=P(),
-        paths_pos=P(), paths_state=P())
+        paths_pos=P(), paths_state=P(),
+        vpos=P(), vgoal=P(), vstamp=P(), pend_from=P(), pend_push=P())
 
 
 def make_sharded2d_runner(cfg: SolverConfig, mesh: Mesh):

@@ -26,8 +26,25 @@ from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.ops.distance import packed_cells
 from p2p_distributed_tswap_tpu.solver.mapd import MapdState
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 _FIELDS = [f.name for f in dataclasses.fields(MapdState)]
+# Fields added by format 2 (stale decentralized view, round 4); a format-1
+# archive restores with these at their inert defaults.
+_V2_FIELDS = ("vpos", "vgoal", "vstamp", "pend_from", "pend_push")
+
+
+def _v1_defaults(n: int, pos: np.ndarray, goal: np.ndarray) -> dict:
+    # Seed the view from the archived TRUTH (as if everyone broadcast at
+    # the restore step): vgoal must come from the goal array — seeding it
+    # from pos would make every mid-route agent look parked-on-goal and
+    # trigger spurious Rule-3 swaps on a stale-mode resume.
+    return {
+        "vpos": pos.astype(np.int32),
+        "vgoal": goal.astype(np.int32),
+        "vstamp": np.zeros(n, np.int32),
+        "pend_from": np.arange(n, dtype=np.int32),
+        "pend_push": np.full(n, -1, np.int32),
+    }
 
 
 def save_state(path: str, state: MapdState) -> None:
@@ -54,13 +71,20 @@ def load_state(path: str, cfg: SolverConfig | None = None,
             raise ValueError(
                 f"{path} is not a solver checkpoint (no format version)")
         version = int(z["__format_version__"])
-        if version != FORMAT_VERSION:
+        if version not in (1, FORMAT_VERSION):
             raise ValueError(
                 f"checkpoint format {version} != supported {FORMAT_VERSION}")
-        missing = [n for n in _FIELDS if n not in z]
+        required = [n for n in _FIELDS
+                    if not (version == 1 and n in _V2_FIELDS)]
+        missing = [n for n in required if n not in z]
         if missing:
             raise ValueError(f"checkpoint missing fields: {missing}")
-        state = MapdState(**{name: jnp.asarray(z[name]) for name in _FIELDS})
+        arrays = {name: z[name] for name in required}
+        if version == 1:
+            arrays.update(_v1_defaults(arrays["pos"].shape[0],
+                                       arrays["pos"], arrays["goal"]))
+        state = MapdState(**{name: jnp.asarray(arrays[name])
+                             for name in _FIELDS})
     if cfg is not None:
         n = state.pos.shape[0]
         if n != cfg.num_agents:

@@ -32,6 +32,7 @@ from p2p_distributed_tswap_tpu.ops.distance import (
     pack_directions,
     packed_cells,
 )
+from p2p_distributed_tswap_tpu.solver import step as step_mod
 from p2p_distributed_tswap_tpu.solver.step import (
     step_parallel,
     step_with_next_hops,
@@ -53,6 +54,13 @@ class MapdState:
     t: jnp.ndarray            # () int32 timestep counter
     paths_pos: jnp.ndarray    # (Tmax+1, N) int32 recorded positions
     paths_state: jnp.ndarray  # (Tmax+1, N) int8 recorded AgentState
+    # --- stale/async decentralized view (cfg.stale_mode; inert otherwise,
+    # see solver/step.py step_stale) ---
+    vpos: jnp.ndarray         # (N,) int32 last-broadcast position
+    vgoal: jnp.ndarray        # (N,) int32 last-broadcast goal
+    vstamp: jnp.ndarray       # (N,) int32 step of last broadcast
+    pend_from: jnp.ndarray    # (N,) int32 pending goal-source permutation
+    pend_push: jnp.ndarray    # (N,) int32 pending pushed-goal cell or -1
 
 
 def init_state(cfg: SolverConfig, starts: jnp.ndarray,
@@ -75,6 +83,13 @@ def init_state(cfg: SolverConfig, starts: jnp.ndarray,
         t=jnp.int32(0),
         paths_pos=jnp.zeros((tdim, n), jnp.int32),
         paths_state=jnp.zeros((tdim, n), jnp.int8),
+        # everyone "broadcast" at t=0 from their start cell (the reference's
+        # occupied/initial-position protocol seeds every cache)
+        vpos=jnp.asarray(starts, jnp.int32),
+        vgoal=jnp.asarray(starts, jnp.int32),
+        vstamp=jnp.zeros(n, jnp.int32),
+        pend_from=jnp.arange(n, dtype=jnp.int32),
+        pend_push=jnp.full(n, -1, jnp.int32),
     )
 
 
@@ -270,19 +285,74 @@ def _record(cfg: SolverConfig, s: MapdState) -> MapdState:
         t=s.t + 1)
 
 
+def _commit_pending(cfg: SolverConfig, s: MapdState) -> MapdState:
+    """Apply the delayed goal exchanges decided ``swap_commit_delay`` steps
+    ago (solver/step.py step_stale): permute (goal, slot, need_replan) by
+    ``pend_from`` — exchanged rows stay consistent with exchanged goals —
+    then land pushed goals, whose rows ARE stale and flagged for replan.
+    Identity pend is a no-op, so calling unconditionally is safe."""
+    p = s.pend_from
+    goal, slot, need = s.goal[p], s.slot[p], s.need_replan[p]
+    pushed = s.pend_push >= 0
+    goal = jnp.where(pushed, s.pend_push, goal)
+    n = cfg.num_agents
+    return s.replace(goal=goal, slot=slot, need_replan=need | pushed,
+                     pend_from=jnp.arange(n, dtype=jnp.int32),
+                     pend_push=jnp.full(n, -1, jnp.int32))
+
+
+def _broadcast_view(cfg: SolverConfig, s: MapdState) -> MapdState:
+    """Refresh the shared neighbor view for agents whose broadcast is due
+    this step — every ``view_refresh_steps`` steps on a per-agent phase
+    offset, the decoupled-cadence analog of the reference's per-process
+    500 ms position timers (agent.rs:730-789)."""
+    n, k = cfg.num_agents, cfg.view_refresh_steps
+    phase = jnp.arange(n, dtype=jnp.int32) % k
+    due = (s.t + phase) % k == 0
+    return s.replace(vpos=jnp.where(due, s.pos, s.vpos),
+                     vgoal=jnp.where(due, s.goal, s.vgoal),
+                     vstamp=jnp.where(due, s.t, s.vstamp))
+
+
 def mapd_step(cfg: SolverConfig, s: MapdState, tasks: jnp.ndarray,
               free: jnp.ndarray, replan_fn=None, nh_factory=None) -> MapdState:
-    """One full MAPD timestep: transitions -> assignment -> replan -> TSWAP
-    step -> record.
+    """One full MAPD timestep: (pending-commit) -> transitions ->
+    assignment -> replan -> TSWAP step -> record.
 
     ``replan_fn(cfg, s, free)`` and ``nh_factory(cfg, dirs) -> nh_fn`` let the
     sharded solver (parallel/sharded.py) substitute its distributed field
     machinery while the MAPD sequencing lives in exactly one place.
+
+    Stale mode (cfg.stale_mode): last step's pending goal exchanges commit
+    FIRST (they were "on the wire" during the previous step), then the
+    normal task lifecycle runs, then the stale-view decision/movement step
+    replaces the fresh-atomic kernel.  With ``swap_commit_delay == 0`` the
+    exchange instead commits at the END of the same step (decisions were
+    still taken on the stale view, but no in-flight window exists).
     """
+    stale = cfg.stale_mode
+    if stale:
+        s = _commit_pending(cfg, s)
     s = _transitions(cfg, s, tasks)
     any_idle = jnp.any((s.phase == AgentPhase.IDLE) & ~jnp.all(s.task_used))
     s = jax.lax.cond(any_idle, lambda s: _assign(cfg, s, tasks), lambda s: s, s)
     s = (replan_fn or _replan)(cfg, s, free)
+    if stale:
+        s = _broadcast_view(cfg, s)
+        if nh_factory is None:
+            nh_fn = lambda sl, po: step_mod.next_hops(cfg, s.dirs, sl, po)
+        else:
+            nh_fn = nh_factory(cfg, s.dirs)
+        visible = (jnp.ones(cfg.num_agents, bool)
+                   if cfg.view_ttl_steps is None
+                   else (s.t - s.vstamp) <= cfg.view_ttl_steps)
+        pos, pend_from, pend_push = step_mod.step_stale(
+            cfg, s.pos, s.goal, s.slot, nh_fn, s.vpos, s.vgoal, visible,
+            jnp.ones(cfg.num_agents, bool))
+        s = s.replace(pos=pos, pend_from=pend_from, pend_push=pend_push)
+        if cfg.swap_commit_delay == 0:
+            s = _commit_pending(cfg, s)
+        return _record(cfg, s)
     if nh_factory is None:
         pos, goal, slot = step_parallel(cfg, s.pos, s.goal, s.slot, s.dirs)
     else:
