@@ -14,7 +14,14 @@ that produced the shipped numbers):
                   only the pairwise Manhattan arithmetic.  (Behavior
                   changes — swaps ignore distance — so makespan may drift;
                   the number is a cost-structure probe, not a benchmark.)
-  stale         — FLAGSHIP_DECENT_STALE (round-4 stale/async semantics)
+  stale         — FLAGSHIP_DECENT_STALE (round-4 stale/async semantics:
+                  ONE decision round instead of swap_rounds x (Rule3+Rule4),
+                  which is why it is CHEAPER than the fresh mask)
+
+Each variant runs in a FRESH SUBPROCESS: flagship programs hold ~5 GB of
+field buffers, and several variants resident in one process poison the
+later measurements (first in-process attempt read 163 ms/step for a
+variant that measures 40 in isolation).
 
 Usage: python analysis/decent_premium.py [--rung flagship]
 Prints a markdown table for SCALING.md.
@@ -23,71 +30,107 @@ Prints a markdown table for SCALING.md.
 from __future__ import annotations
 
 import argparse
+import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from p2p_distributed_tswap_tpu.models import scenarios
-from p2p_distributed_tswap_tpu.solver import mapd, step as step_mod
+VARIANTS = ("cent", "decent", "decent_nomask", "stale")
 
 
-def solve_ms(scn):
-    grid, starts, tasks, cfg = scn.build(seed=0)
-    args = (cfg, jnp.asarray(starts, jnp.int32), jnp.asarray(tasks, jnp.int32),
-            jnp.asarray(grid.free))
-    run = jax.jit(mapd.run_mapd, static_argnums=0)  # fresh jit per variant:
-    final = run(*args)                              # monkeypatches must not
-    jax.block_until_ready(final)                    # hit a stale cache
-    t0 = time.perf_counter()
-    final = run(*args)
-    jax.block_until_ready(final)
-    steps = int(final.t)
-    completed = bool(np.asarray(final.task_used).all())
-    return 1000.0 * (time.perf_counter() - t0) / steps, steps, completed
+def run_variant(rung: str, variant: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_distributed_tswap_tpu.models import scenarios
+    from p2p_distributed_tswap_tpu.solver import mapd, step as step_mod
+
+    base = {"medium": scenarios.MEDIUM,
+            "flagship": scenarios.FLAGSHIP}[rung]
+    scn = {"cent": base,
+           "decent": base.decentralized(),
+           "decent_nomask": base.decentralized(),
+           "stale": base.stale()}[variant]
+    # fresh jit per call + finally-restore: 'decent' and 'decent_nomask'
+    # share an identical static cfg, so the shared _run_mapd_jit cache
+    # would silently serve one variant's trace to the other if anything
+    # ever runs two variants in one process (main() subprocesses them, but
+    # the guard belongs here, not implicitly in the caller)
+    run = jax.jit(mapd.run_mapd, static_argnums=0)
+    orig_wr = step_mod._within_radius
+    try:
+        if variant == "decent_nomask":
+            step_mod._within_radius = (
+                lambda cfg, pos, i_idx, j_idx: jnp.ones_like(i_idx, bool))
+        grid, starts, tasks, cfg = scn.build(seed=0)
+        args = (cfg, jnp.asarray(starts, jnp.int32),
+                jnp.asarray(tasks, jnp.int32), jnp.asarray(grid.free))
+        final = run(*args)
+        jax.block_until_ready(final)
+        t0 = time.perf_counter()
+        final = run(*args)
+        jax.block_until_ready(final)
+        steps = int(final.t)
+    finally:
+        step_mod._within_radius = orig_wr
+    return {"variant": variant,
+            "ms_per_step": round(1000.0 * (time.perf_counter() - t0) / steps,
+                                 2),
+            "makespan": steps,
+            "completed": bool(np.asarray(final.task_used).all())}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rung", default="flagship",
                     choices=["medium", "flagship"])
+    ap.add_argument("--variant", default=None, help="(internal) child mode")
     args = ap.parse_args()
-    base = {"medium": scenarios.MEDIUM,
-            "flagship": scenarios.FLAGSHIP}[args.rung]
+
+    if args.variant:
+        print(json.dumps(run_variant(args.rung, args.variant)), flush=True)
+        return
 
     rows = []
+    for v in VARIANTS:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--rung",
+                 args.rung, "--variant", v],
+                capture_output=True, text=True, timeout=3600)
+        except subprocess.TimeoutExpired:
+            print(f"# {v}: FAILED (timeout 3600s)", file=sys.stderr)
+            continue
+        out = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                out = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if out is None:
+            print(f"# {v}: FAILED\n{(proc.stderr or '')[-400:]}",
+                  file=sys.stderr)
+            continue
+        rows.append(out)
+        print(f"# {v}: {out['ms_per_step']} ms/step, makespan "
+              f"{out['makespan']}, completed={out['completed']}", flush=True)
 
-    def run(name, scn):
-        ms, steps, done = solve_ms(scn)
-        rows.append((name, ms, steps, done))
-        print(f"# {name}: {ms:.2f} ms/step, makespan {steps}, "
-              f"completed={done}", flush=True)
-
-    run("cent", base)
-    run("decent", base.decentralized())
-
-    orig_wr = step_mod._within_radius
-    try:
-        step_mod._within_radius = (
-            lambda cfg, pos, i_idx, j_idx: jnp.ones_like(i_idx, bool))
-        run("decent_nomask", base.decentralized())
-    finally:
-        step_mod._within_radius = orig_wr
-
-    run("stale", base.stale())
-
-    cent_ms = rows[0][1]
+    if not rows or rows[0]["variant"] != "cent":
+        sys.exit(1)
+    cent_ms = rows[0]["ms_per_step"]
     print("\n| variant | ms/step | makespan | vs cent |")
     print("|---|---|---|---|")
-    for name, ms, steps, done in rows:
-        note = "" if done else " (horizon)"
-        print(f"| {name} | {ms:.2f} | {steps}{note} | "
-              f"{ms / cent_ms:.2f}x |")
+    for r in rows:
+        note = "" if r["completed"] else " (horizon)"
+        print(f"| {r['variant']} | {r['ms_per_step']} "
+              f"| {r['makespan']}{note} | "
+              f"{r['ms_per_step'] / cent_ms:.2f}x |")
 
 
 if __name__ == "__main__":

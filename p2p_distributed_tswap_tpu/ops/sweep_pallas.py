@@ -129,6 +129,12 @@ def sweep(d: jnp.ndarray, free2d: jnp.ndarray, axis: int,
     """Drop-in directional sweep: exact replacement for
     ops.distance._sweep's result on eligible shapes.
 
+    Dispatches to the FULL-ROW kernel (_sweep8_rows: segments of one grid
+    row packed onto the 8 VPU sublanes, any batch size) when the row shape
+    supports it — W a multiple of 1024 or at most 1024, H compatible with
+    the HBLK streaming — falling back to the round-3 single-field-strip
+    kernel otherwise.
+
     Args:
       d: (R, H, W) int32 distance batch.
       free2d: (H, W) bool, True = traversable.
@@ -137,7 +143,122 @@ def sweep(d: jnp.ndarray, free2d: jnp.ndarray, axis: int,
     """
     blocked = (~free2d).astype(jnp.int32)
     if axis == 1:
-        return _sweep_rows(d, blocked, reverse)
+        return _dispatch_rows(d, blocked, reverse)
     assert axis == 2
-    out = _sweep_rows(d.swapaxes(1, 2), blocked.T, reverse)
+    out = _dispatch_rows(d.swapaxes(1, 2), blocked.T, reverse)
     return out.swapaxes(1, 2)
+
+
+def _dispatch_rows(d: jnp.ndarray, blocked: jnp.ndarray,
+                   reverse: bool) -> jnp.ndarray:
+    r, h, w = d.shape
+    if sweep8_eligible(h, w):
+        return _sweep8_rows(d, blocked, reverse)
+    return _sweep_rows(d, blocked, reverse)
+
+
+# --- full-row kernel (round 4) ----------------------------------------
+#
+# The roofline (analysis/roofline.py, SCALING.md) puts the flagship step at
+# ~6% of the HBM bound: the sweep is VECTOR-ISSUE bound, because the
+# single-field kernel's recurrence advances on (1, 128)-wide row slices —
+# 7/8 of every VPU issue wasted, and a separate program per 128-lane strip.
+# The fix needs NO data movement: viewing each grid row's W cells as
+# (S segments x 128 lanes) — a pure reshape — makes ONE aligned (S, 128)
+# tile hold up to 1024 consecutive cells of a row, so each scan step
+# advances a whole row per issue (every (segment, lane) cell's column scan
+# is independent; the recurrence only chains along H).  H streams through
+# a sequential grid dimension with the running minimum carried in VMEM
+# scratch, so VMEM stays ~6 MB/program and ANY lane-aligned H works
+# (including 4096).  Fields are a parallel grid dimension — no multiple-
+# of-8 batch restriction.
+#
+# (Two rejected designs, measured on-chip: transposing fields onto the
+# sublane dim costs a 56 ms/32 MB leading-dim relayout that dwarfs the
+# win, and dynamic per-row ref indexing inside the kernel lowers ~27x
+# slower than chunked pl.ds access.)
+
+HBLK = 512     # rows per sequential block: 3 x 2 MB VMEM at S = 8
+MAX_SEGS = 8   # sublane packing: segments of one row per tile
+
+
+def _segments(w: int) -> int:
+    """Sublane segment count for a W-cell row; 0 = row shape unsupported."""
+    q = w // LANES
+    if q >= MAX_SEGS and q % MAX_SEGS == 0:
+        return MAX_SEGS
+    if 1 <= q <= MAX_SEGS:
+        return q
+    return 0
+
+
+def sweep8_eligible(h: int, w: int) -> bool:
+    """Row-shape gate for the full-row kernel: batch size is unrestricted
+    (fields are a parallel grid dimension)."""
+    return _segments(w) > 0 and (h % HBLK == 0 or h <= HBLK)
+
+
+def _scan8_kernel(reverse: bool, hblk: int, segs: int,
+                  d_ref, m_ref, o_ref, run_ref):
+    hi = pl.program_id(2)
+
+    @pl.when(hi == 0)
+    def _init():
+        run_ref[...] = jnp.full((segs, LANES), INF, jnp.int32)
+
+    nt = hblk // SUBLANES
+
+    def body(t, run):
+        base = ((nt - 1 - t) if reverse else t) * SUBLANES
+        chunk = d_ref[0, pl.ds(base, SUBLANES), 0]      # (8, S, 128)
+        mrows = m_ref[pl.ds(base, SUBLANES), 0] != 0    # (8, S, 128)
+        rows = [None] * SUBLANES
+        order = range(SUBLANES - 1, -1, -1) if reverse else range(SUBLANES)
+        for k in order:
+            bl = mrows[k]
+            run = jnp.minimum(run + 1, chunk[k])
+            run = jnp.where(bl, INF, run)
+            rows[k] = jnp.where(bl, INF, jnp.minimum(run, INF))
+        o_ref[0, pl.ds(base, SUBLANES), 0] = jnp.stack(rows, axis=0)
+        return run
+
+    run_ref[...] = jax.lax.fori_loop(0, nt, body, run_ref[...])
+
+
+def _sweep8_rows(d: jnp.ndarray, blocked: jnp.ndarray,
+                 reverse: bool) -> jnp.ndarray:
+    """Segmented min-plus scan along axis 1 of ``d`` (R, H, W), one full
+    row (up to S x 128 cells) per issue.  Bit-identical to _sweep_rows."""
+    r, h, w = d.shape
+    segs = _segments(w)
+    nchunk = w // (segs * LANES)
+    hblk = min(h, HBLK)
+    nh = h // hblk
+    d5 = d.reshape(r, h, nchunk, segs, LANES)          # pure view
+    m4 = blocked.reshape(h, nchunk, segs, LANES)
+    kernel = functools.partial(_scan8_kernel, reverse, hblk, segs)
+
+    def hmap(hi):
+        return (nh - 1 - hi) if reverse else hi
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(d5.shape, jnp.int32),
+        grid=(r, nchunk, nh),
+        in_specs=[
+            pl.BlockSpec((1, hblk, 1, segs, LANES),
+                         lambda ri, ci, hi: (ri, hmap(hi), ci, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hblk, 1, segs, LANES),
+                         lambda ri, ci, hi: (hmap(hi), ci, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, hblk, 1, segs, LANES),
+                               lambda ri, ci, hi: (ri, hmap(hi), ci, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((segs, LANES), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=INTERPRET,
+    )(d5, m4)
+    return out.reshape(r, h, w)

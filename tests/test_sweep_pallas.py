@@ -48,6 +48,54 @@ def test_kernel_matches_xla_sweep(axis, reverse):
     np.testing.assert_array_equal(ref, pal)
 
 
+@pytest.mark.parametrize("axis,reverse", [(1, False), (1, True),
+                                          (2, False), (2, True)])
+@pytest.mark.parametrize("w", [128, 1024])
+def test_fullrow_kernel_matches_xla_sweep(axis, reverse, w):
+    """The round-4 full-row kernel (segments of one row packed onto the
+    sublanes) must stay bit-identical to the XLA doubling scan.  w=128
+    degenerates to one segment; w=1024 exercises the full 8-segment tile
+    packing (the production flagship shape)."""
+    rng = np.random.default_rng(10 + axis * 2 + reverse + w)
+    h = 128
+    r = 3  # odd batch: the kernel has no batch-size restriction
+    free = rng.random((h, w)) > 0.25
+    d = np.where(rng.random((r, h, w)) > 0.95,
+                 rng.integers(0, 60, (r, h, w)), int(distance.INF))
+    d = np.where(free[None], d, int(distance.INF)).astype(np.int32)
+    free_j = jnp.asarray(free)
+    free_b = jnp.broadcast_to(free_j[None], d.shape)
+    ref = np.asarray(_xla_sweep(jnp.asarray(d), free_b, axis, reverse))
+    blocked = (~free_j).astype(jnp.int32)
+    if axis == 1:
+        pal = sweep_pallas._sweep8_rows(jnp.asarray(d), blocked, reverse)
+    else:
+        pal = sweep_pallas._sweep8_rows(
+            jnp.asarray(d).swapaxes(1, 2), blocked.T, reverse).swapaxes(1, 2)
+    np.testing.assert_array_equal(ref, np.asarray(pal))
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_fullrow_kernel_carries_across_hblocks(monkeypatch, reverse):
+    """Shrink HBLK so the 128-row grid needs multiple sequential blocks,
+    AND use w=2048 so the lane-chunk grid dimension (nchunk=2) is
+    exercised: the running minimum must carry across block boundaries in
+    scratch, independently per (field, chunk)."""
+    monkeypatch.setattr(sweep_pallas, "HBLK", 32)
+    rng = np.random.default_rng(99 + reverse)
+    h, w = 128, 2048
+    free = rng.random((h, w)) > 0.2
+    d = np.where(rng.random((2, h, w)) > 0.9,
+                 rng.integers(0, 40, (2, h, w)), int(distance.INF))
+    d = np.where(free[None], d, int(distance.INF)).astype(np.int32)
+    free_j = jnp.asarray(free)
+    free_b = jnp.broadcast_to(free_j[None], d.shape)
+    ref = np.asarray(_xla_sweep(jnp.asarray(d), free_b, 1, reverse))
+    pal = sweep_pallas._sweep8_rows(
+        jnp.asarray(d), (~free_j).astype(jnp.int32), reverse)
+    np.testing.assert_array_equal(ref, np.asarray(pal))
+
+
 def test_eligibility_gate(monkeypatch):
     # Backend gate, tested under controlled conditions instead of the
     # tautological "eligible implies _on_tpu": with the kill-switch set
