@@ -288,6 +288,11 @@ def bench_step_window(scn, seed: int = 0, no_full: bool = False, built=None):
         done = jax.jit(functools.partial(mapd._finished, cfg))
         mark = jax.jit(lambda s, dt: jnp.where(
             (dt < 0) & mapd._finished(cfg, s), s.t, dt))
+        # the measured window's state still pins its (up to 4 GB at 4096^2)
+        # field buffers; release them BEFORE preparing the completion
+        # state or the chip holds three copies and OOMs (seen live at
+        # extreme_lite_full, round 4)
+        del s, prev
         s2, t2 = prepare(jnp.asarray(tasks, jnp.int32))
         done_t = jnp.int32(-1)
         finished = False
