@@ -31,6 +31,19 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+import os  # noqa: E402,F811
+
+# The 8 virtual devices serialize on this host's ONE core, so a device
+# thread can reach a collective minutes after its peers.  XLA CPU's
+# rendezvous aborts the process after 40 s by default (rendezvous.cc
+# "Termination timeout ... Exiting to ensure a consistent program
+# state" — crashed the first full run); raise the limits far above the
+# serialized skew.  Must be set before the CPU client exists.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_cpu_collective_timeout_seconds=7200"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=7200").strip()
+
 from p2p_distributed_tswap_tpu.parallel.virtual_mesh import pin_cpu_backend  # noqa: E402
 
 pin_cpu_backend(8)
@@ -87,18 +100,29 @@ def main():
     sweep_dev_mb = (args.replan_chunk * (args.side // args.tiles)
                     * args.side * 4) / 2**20
 
-    step = jax.jit(jax.shard_map(
+    step_shard = jax.shard_map(
         functools.partial(sharded2d.sharded2d_mapd_step, cfg),
         mesh=mesh, in_specs=(specs, P(), P(TILES_AXIS, None)),
-        out_specs=specs, check_vma=False))
+        out_specs=specs, check_vma=False)
     prime = jax.jit(jax.shard_map(
         functools.partial(sharded2d._prime_2d, cfg),
         mesh=mesh, in_specs=(specs, P(TILES_AXIS, None)), out_specs=specs,
         check_vma=False))
-    check = jax.jit(functools.partial(invariants.step_invariants, cfg))
-    done = jax.jit(functools.partial(mapd._finished, cfg))
-    mark = jax.jit(lambda s, dt: jnp.where(
-        (dt < 0) & mapd._finished(cfg, s), s.t, dt))
+
+    # ONE program per loop iteration: step + invariant fold + makespan
+    # latch + finished flag fused into a single jitted dispatch.  Separate
+    # jitted programs over sharded operands interleave their collectives
+    # across the serialized device threads in inconsistent order and
+    # DEADLOCK the CPU rendezvous (observed live: worker CPU time frozen
+    # mid-run); inside one program XLA orders every collective.
+    @jax.jit
+    def fused_iter(s, tasks, free, ok, done_t):
+        prev = s.pos
+        s = step_shard(s, tasks, free)
+        ok = ok & invariants.step_invariants(cfg, prev, s.pos, free)
+        done_t = jnp.where((done_t < 0) & mapd._finished(cfg, s),
+                           s.t, done_t)
+        return s, ok, done_t, mapd._finished(cfg, s)
 
     tasks_j = jnp.asarray(tasks, jnp.int32)
     s = mapd.init_state(cfg, jnp.asarray(starts, jnp.int32), len(tasks))
@@ -127,9 +151,7 @@ def main():
     t0 = time.perf_counter()
     if args.probe:
         for _ in range(args.probe):
-            prev = s.pos
-            s = step(s, tasks_j, free_j)
-            ok = ok & check(prev, s.pos, free_j)
+            s, ok, done_t, _ = fused_iter(s, tasks_j, free_j, ok, done_t)
             steps += 1
         int(s.t)
         ms = 1000.0 * (time.perf_counter() - t0) / steps
@@ -141,12 +163,9 @@ def main():
     finished = False
     while not finished and steps < cfg.max_timesteps + FETCH_EVERY:
         for _ in range(FETCH_EVERY):
-            prev = s.pos
-            s = step(s, tasks_j, free_j)
-            ok = ok & check(prev, s.pos, free_j)
-            done_t = mark(s, done_t)
+            s, ok, done_t, fin = fused_iter(s, tasks_j, free_j, ok, done_t)
             steps += 1
-        finished = bool(done(s))
+        finished = bool(fin)
         if steps % 512 == 0:
             print(f"# t={steps} elapsed={time.perf_counter()-t0:.0f}s",
                   flush=True)
