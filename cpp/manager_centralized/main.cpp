@@ -265,12 +265,16 @@ int main(int argc, char** argv) {
                      std::chrono::steady_clock::now() - t0)
                      .count();
     path_metrics.record_micros(us, unix_ms());
-    // goals may have been swapped/rotated by TSWAP: adopt them
+    // TSWAP may swap/rotate goals WITHIN the step, but manager state keeps
+    // the task-derived goal, exactly like the reference's plan_all_paths
+    // (manager.rs:131-141 writes back only current_pos).  Persisting
+    // swapped goals permanently freezes the fleet: after a swap between a
+    // task-carrying agent and a parked one, the carrier is steered to the
+    // wrong delivery cell, its positional done (agent-side, per ITS task)
+    // never fires, and every later plan says "stay" — observed live as a
+    // full-fleet deadlock in the solverd e2e.
     std::vector<Cell> next(ids.size());
-    for (size_t k = 0; k < ids.size(); ++k) {
-      agents[ids[k]].goal = ta[k].g;
-      next[k] = ta[k].v;
-    }
+    for (size_t k = 0; k < ids.size(); ++k) next[k] = ta[k].v;
     emit_moves(ids, next);
   };
 
@@ -317,9 +321,8 @@ int main(int argc, char** argv) {
       const std::string& peer = mv["peer_id"].as_str();
       auto it = agents.find(peer);
       if (it == agents.end()) continue;
-      if (mv.has("goal")) {  // solver-side swaps/rotations update goals
-        if (auto g = parse_point(mv["goal"])) it->second.goal = *g;
-      }
+      // the daemon's returned goals (post-swap) are deliberately NOT
+      // adopted — same reference-parity/freeze reasoning as plan_native
       ids.push_back(peer);
       next.push_back(*np);
     }
