@@ -80,6 +80,17 @@ class SolverConfig:
             raise ValueError(
                 f"swap_commit_delay={self.swap_commit_delay}: only 0 "
                 "(atomic) or 1 (one-step wire latency) are supported")
+        # Probe the knob clause of THE shared predicate with a dummy
+        # radius: true means "some stale knob is non-default", which is
+        # invalid without a real radius.
+        if self.visibility_radius is None and stale_knobs_active(
+                0, self.view_refresh_steps, self.view_ttl_steps,
+                self.swap_commit_delay):
+            raise ValueError(
+                "stale knobs (view_refresh_steps/view_ttl_steps/"
+                "swap_commit_delay) require visibility_radius: staleness is "
+                "a property of the neighbor view, and without a radius the "
+                "centralized fresh-atomic kernel would silently run instead")
     # Rounds of the (Rule 3, Rule 4) goal-swapping phase per step.  The
     # reference's sequential pass lets swaps cascade within one step
     # (src/algorithm/tswap.rs:180-252); extra parallel rounds approximate that.

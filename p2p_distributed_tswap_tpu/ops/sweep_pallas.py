@@ -194,8 +194,11 @@ def _segments(w: int) -> int:
 
 def sweep8_eligible(h: int, w: int) -> bool:
     """Row-shape gate for the full-row kernel: batch size is unrestricted
-    (fields are a parallel grid dimension)."""
-    return _segments(w) > 0 and (h % HBLK == 0 or h <= HBLK)
+    (fields are a parallel grid dimension).  H must be sublane-aligned —
+    _scan8_kernel iterates hblk // SUBLANES tiles and would silently drop
+    the last h % SUBLANES rows otherwise."""
+    return (_segments(w) > 0 and h % SUBLANES == 0
+            and (h % HBLK == 0 or h <= HBLK))
 
 
 def _scan8_kernel(reverse: bool, hblk: int, segs: int,

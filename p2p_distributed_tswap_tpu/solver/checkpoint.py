@@ -33,15 +33,18 @@ _FIELDS = [f.name for f in dataclasses.fields(MapdState)]
 _V2_FIELDS = ("vpos", "vgoal", "vstamp", "pend_from", "pend_push")
 
 
-def _v1_defaults(n: int, pos: np.ndarray, goal: np.ndarray) -> dict:
+def _v1_defaults(n: int, pos: np.ndarray, goal: np.ndarray,
+                 t: int) -> dict:
     # Seed the view from the archived TRUTH (as if everyone broadcast at
     # the restore step): vgoal must come from the goal array — seeding it
     # from pos would make every mid-route agent look parked-on-goal and
-    # trigger spurious Rule-3 swaps on a stale-mode resume.
+    # trigger spurious Rule-3 swaps on a stale-mode resume.  vstamp is the
+    # archived timestep, not zero: a zero stamp under view_ttl_steps would
+    # make the freshly-seeded truth view instantly TTL-expired.
     return {
         "vpos": pos.astype(np.int32),
         "vgoal": goal.astype(np.int32),
-        "vstamp": np.zeros(n, np.int32),
+        "vstamp": np.full(n, t, np.int32),
         "pend_from": np.arange(n, dtype=np.int32),
         "pend_push": np.full(n, -1, np.int32),
     }
@@ -82,7 +85,8 @@ def load_state(path: str, cfg: SolverConfig | None = None,
         arrays = {name: z[name] for name in required}
         if version == 1:
             arrays.update(_v1_defaults(arrays["pos"].shape[0],
-                                       arrays["pos"], arrays["goal"]))
+                                       arrays["pos"], arrays["goal"],
+                                       int(arrays["t"])))
         state = MapdState(**{name: jnp.asarray(arrays[name])
                              for name in _FIELDS})
     if cfg is not None:

@@ -63,6 +63,33 @@ def test_save_resume_bit_identical(tmp_path):
     np.testing.assert_array_equal(np.asarray(s_ref.pos), np.asarray(s2.pos))
 
 
+def test_v1_archive_restores_with_fresh_view_seed(tmp_path):
+    """A format-1 archive (pre-stale-mode) must restore with the truth view
+    seeded as FRESH at the archived timestep: vstamp == t, so a TTL'd
+    stale-mode resume doesn't start with an all-expired (invisible) view."""
+    grid = Grid.random_obstacles(16, 16, 0.1, seed=0)
+    cfg = SolverConfig(height=16, width=16, num_agents=4)
+    starts = start_positions_array(grid, 4, seed=0)
+    s = mapd.init_state(cfg, jnp.asarray(starts, jnp.int32), 3)
+    p = str(tmp_path / "v1.npz")
+    save_state(p, s)
+    # Rewrite the archive as format 1: drop the v2 view fields, fake t=42.
+    with np.load(p) as z:
+        arrays = {k: z[k] for k in z.files}
+    for name in ("vpos", "vgoal", "vstamp", "pend_from", "pend_push"):
+        del arrays[name]
+    arrays["__format_version__"] = 1
+    arrays["t"] = np.asarray(42, np.int32)
+    np.savez_compressed(p, **arrays)
+    restored = load_state(p)
+    np.testing.assert_array_equal(np.asarray(restored.vstamp),
+                                  np.full(4, 42, np.int32))
+    np.testing.assert_array_equal(np.asarray(restored.vpos),
+                                  np.asarray(restored.pos))
+    np.testing.assert_array_equal(np.asarray(restored.vgoal),
+                                  np.asarray(restored.goal))
+
+
 def test_load_rejects_bad_archive(tmp_path):
     import pytest
 

@@ -45,6 +45,20 @@ def _assert_legal(grid, paths):
             assert (d <= 1).all(), f"teleport at t={t}"
 
 
+def test_stale_knobs_require_radius():
+    # Each stale knob alone, without a radius, must be rejected loudly —
+    # silently running the centralized fresh-atomic kernel while the run's
+    # labels suggest staleness was advisor finding r4-2.
+    for knobs in ({"view_refresh_steps": 4}, {"view_ttl_steps": 20},
+                  {"swap_commit_delay": 1}):
+        with pytest.raises(ValueError, match="visibility_radius"):
+            SolverConfig(height=16, width=16, num_agents=4, **knobs)
+    # With a radius they are accepted and engage stale mode.
+    cfg = SolverConfig(height=16, width=16, num_agents=4,
+                       visibility_radius=15, view_refresh_steps=4)
+    assert cfg.stale_mode
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_stale_solve_completes_and_legal(seed):
     g = Grid.random_obstacles(16, 16, 0.1, seed=3)

@@ -112,3 +112,9 @@ def test_eligibility_gate(monkeypatch):
     # unaligned grids never eligible regardless of backend
     assert not sweep_pallas.sweep_eligible(100, 100)
     assert not sweep_pallas.sweep_eligible(256, 100)
+    # sweep8_eligible is an importable entry point of its own: H not a
+    # multiple of SUBLANES would silently truncate the last rows inside
+    # _scan8_kernel, so the gate must reject it directly (advisor r4-3)
+    assert not sweep_pallas.sweep8_eligible(100, 256)
+    assert not sweep_pallas.sweep8_eligible(12, 256)
+    assert sweep_pallas.sweep8_eligible(16, 256)
