@@ -46,6 +46,9 @@ int main(int argc, char** argv) {
   // >=1 s position heartbeat (ref :285-291), settable like every knob.
   const int64_t heartbeat_ms =
       knobs.get_int("--heartbeat-ms", "MAPD_HEARTBEAT_MS", 1000);
+  // done retransmit cadence until the manager acks (lost-done desync fix)
+  const int64_t done_retry_ms =
+      knobs.get_int("--done-retry-ms", "MAPD_DONE_RETRY_MS", 2000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -72,6 +75,16 @@ int main(int argc, char** argv) {
   Cell my_pos = grid.random_free_cell(rng);
   std::optional<Json> my_task;
 
+  // Done retransmit-until-ack (lost-done desync fix): a done published
+  // into a bus outage is silently dropped (bus.hpp: lossy medium), which
+  // would leave the manager steering a taskless agent forever.  The
+  // completed metric is stored verbatim so retransmits carry the ORIGINAL
+  // completion timestamp.
+  std::optional<Json> unacked_done;
+  Json unacked_done_metric;
+  long long unacked_done_id = -1;
+  int64_t done_last_sent_ms = 0;
+
   auto point_json = [&](Cell c) {
     Json p;
     p.push_back(Json(grid.x_of(c)));
@@ -92,29 +105,40 @@ int main(int argc, char** argv) {
     upd.set("type", "position_update")
         .set("peer_id", my_id)
         .set("position", point_json(my_pos));
+    // busy/idle status rides the heartbeat so the manager can detect a
+    // Task whose delivery was lost in an outage (idle-but-marked-busy)
+    if (my_task) upd.set("busy_task", (*my_task)["task_id"]);
     bus.publish("mapd", upd);
   };
 
-  auto task_metric = [&](const char* type) {
-    if (!my_task || (*my_task)["task_id"].is_null()) return;
+  // Builds, publishes, and RETURNS the metric payload (the completed
+  // metric is also held for retransmit-until-ack, original timestamp).
+  auto task_metric = [&](const char* type) -> Json {
     Json m;
+    if (!my_task || (*my_task)["task_id"].is_null()) return m;
     m.set("type", type)
         .set("task_id", (*my_task)["task_id"])
         .set("peer_id", my_id)
         .set("timestamp_ms", unix_ms());
     bus.publish("mapd", m);
+    return m;
   };
 
   auto completion_check = [&]() {  // positional done detection (ref :379-410)
     if (!my_task) return;  // my_task.reset() below prevents duplicate done
     auto dl = parse_point((*my_task)["delivery"]);
     if (dl && my_pos == *dl) {
-      task_metric("task_metric_completed");
+      Json metric = task_metric("task_metric_completed");
       Json done;
       done.set("status", "done").set("task_id", (*my_task)["task_id"]);
       bus.publish("mapd", done);
       log_info("✅ Task %lld DONE\n",
                static_cast<long long>((*my_task)["task_id"].as_int()));
+      // hold both payloads for retransmit until the manager acks
+      unacked_done = done;
+      unacked_done_metric = metric;
+      unacked_done_id = (*my_task)["task_id"].as_int();
+      done_last_sent_ms = mono_ms();
       my_task.reset();
     }
   };
@@ -147,8 +171,25 @@ int main(int argc, char** argv) {
           last_broadcast = mono_ms();
           completion_check();
         }
+      } else if (type == "done_ack") {
+        if (d["peer_id"].as_str() == my_id
+            && d["task_id"].as_int() == unacked_done_id) {
+          unacked_done.reset();
+          unacked_done_id = -1;
+        }
       } else if (type.empty() && d.has("pickup") && d.has("delivery")) {
         if (d["peer_id"].as_str() != my_id) return;
+        const long long tid = d["task_id"].as_int();
+        if (unacked_done && tid == unacked_done_id) {
+          // the manager re-sent a task we already completed (its done was
+          // lost): refuse the duplicate and heal by retransmitting now
+          bus.publish("mapd", unacked_done_metric);
+          bus.publish("mapd", *unacked_done);
+          done_last_sent_ms = mono_ms();
+          return;
+        }
+        if (my_task && (*my_task)["task_id"].as_int() == tid)
+          return;  // duplicate delivery of the task we are working on
         my_task = d;
         task_metric("task_metric_received");
         task_metric("task_metric_started");
@@ -161,9 +202,19 @@ int main(int argc, char** argv) {
         });
     if (!alive) break;
 
-    if (mono_ms() - last_broadcast >= heartbeat_ms) {  // ref :285-291
+    int64_t now = mono_ms();
+    if (now - last_broadcast >= heartbeat_ms) {  // ref :285-291
       broadcast_position();
-      last_broadcast = mono_ms();
+      last_broadcast = now;
+    }
+    // done retransmit: no ack yet (lost in an outage, or the ack itself
+    // was lost) — re-publish on the retry cadence until acked
+    if (unacked_done && now - done_last_sent_ms >= done_retry_ms) {
+      log_info("🔁 retransmitting done for task %lld (no ack yet)\n",
+               unacked_done_id);
+      bus.publish("mapd", unacked_done_metric);
+      bus.publish("mapd", *unacked_done);
+      done_last_sent_ms = now;
     }
   }
 

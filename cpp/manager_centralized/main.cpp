@@ -58,6 +58,7 @@ struct AgentInfo {
   std::optional<Json> task;
   Phase phase = Phase::None;
   int64_t last_seen_ms = 0;
+  int64_t dispatched_ms = 0;  // when .task was (re-)sent, for resend grace
 };
 
 }  // namespace
@@ -94,6 +95,10 @@ int main(int argc, char** argv) {
   // been silent this long (the fleet must not stall if solverd dies).
   const int64_t solver_failover_ms =
       knobs.get_int("--solver-failover-ms", "MAPD_SOLVER_FAILOVER_MS", 5000);
+  // an agent that keeps reporting idle this long past dispatch never got
+  // its task (delivery lost in a bus outage) — re-send the same task
+  const int64_t task_resend_ms =
+      knobs.get_int("--task-resend-ms", "MAPD_TASK_RESEND_MS", 5000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -182,6 +187,7 @@ int main(int argc, char** argv) {
     AgentInfo& a = agents[peer];
     a.task = task;
     a.phase = Phase::ToPickup;
+    a.dispatched_ms = mono_ms();
     if (auto p = parse_point(task["pickup"])) a.goal = *p;
     bus.publish("mapd", task);
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
@@ -434,9 +440,25 @@ int main(int argc, char** argv) {
                        agents.size());
               try_assign_pending();
             } else {
-              it->second.pos = *p;
-              it->second.last_seen_ms = mono_ms();
-              if (!it->second.task) it->second.goal = *p;
+              AgentInfo& a = it->second;
+              a.pos = *p;
+              a.last_seen_ms = mono_ms();
+              if (!a.task) a.goal = *p;
+              // idle-but-marked-busy reconciliation: the heartbeat carries
+              // a busy_task field while the agent holds a task; still-idle
+              // well past dispatch means the Task publish was dropped in a
+              // bus outage — re-send the SAME task.  A lost DONE instead
+              // heals via the agent's retransmit (which also refuses this
+              // duplicate by task id).
+              if (a.task && !d.has("busy_task")
+                  && mono_ms() - a.dispatched_ms > task_resend_ms) {
+                log_info("↻ %s reports idle but task %lld is in flight; "
+                         "re-sending\n", peer.c_str(),
+                         static_cast<long long>(
+                             (*a.task)["task_id"].as_int()));
+                bus.publish("mapd", *a.task);
+                a.dispatched_ms = mono_ms();
+              }
             }
           } else if (type == "plan_response") {
             handle_plan_response(d);
@@ -455,6 +477,12 @@ int main(int argc, char** argv) {
           } else if (d["status"].as_str() == "done") {
             const std::string& peer = m.from;
             const long long tid = d["task_id"].as_int();
+            // ack unconditionally: agents retransmit done until acked, and
+            // a duplicate (its ack was lost) must still be acked
+            Json ack;
+            ack.set("type", "done_ack").set("peer_id", peer)
+                .set("task_id", Json(static_cast<int64_t>(tid)));
+            bus.publish("mapd", ack);
             auto it = agents.find(peer);
             if (it != agents.end() && it->second.task
                 && (*it->second.task)["task_id"].as_int() == tid) {

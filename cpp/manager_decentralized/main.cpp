@@ -73,6 +73,10 @@ int main(int argc, char** argv) {
   // dropped (a mute-but-connected peer never emits peer_left)
   const int64_t agent_stale_ms =
       knobs.get_int("--agent-stale-ms", "MAPD_AGENT_STALE_MS", 60000);
+  // a peer that keeps reporting idle this long past dispatch never got its
+  // task (delivery lost in a bus outage) — re-send the same task
+  const int64_t task_resend_ms =
+      knobs.get_int("--task-resend-ms", "MAPD_TASK_RESEND_MS", 5000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -108,7 +112,13 @@ int main(int argc, char** argv) {
   std::map<std::string, Cell> peer_positions;
   std::map<std::string, int64_t> peer_last_seen;  // position_update times
   std::map<std::string, Json> peer_busy;   // peer -> active task (full JSON)
+  std::map<std::string, int64_t> busy_since;  // peer -> dispatch mono_ms
   std::deque<Json> requeue;                // tasks orphaned by dead peers
+  // Done dedup (bounded): agents retransmit done until acked, and a task
+  // re-queued from a presumed-dead peer can complete twice — only the
+  // first done per task id may trigger the free-the-peer + refill path.
+  std::set<long long> completed_ids;
+  std::deque<long long> completed_order;
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
@@ -125,6 +135,7 @@ int main(int argc, char** argv) {
     m.sent_time = unix_ms();
     task_metrics.add_metric(m);
     peer_busy[peer] = t;
+    busy_since[peer] = mono_ms();
     peer_last_seen.emplace(peer, mono_ms());  // monitor from dispatch
     bus.publish("mapd", t);
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
@@ -232,7 +243,10 @@ int main(int argc, char** argv) {
       task_metrics.clear();
       path_metrics.clear();
       peer_busy.clear();
+      busy_since.clear();
       requeue.clear();
+      completed_ids.clear();
+      completed_order.clear();
       log_info("🔄 state reset\n");
     } else if (!cmd.empty()) {
       Json raw;  // unknown lines broadcast raw (ref :389-395)
@@ -277,15 +291,36 @@ int main(int argc, char** argv) {
           const Json& d = m.data;
           const std::string& type = d["type"].as_str();
           if (type == "position_update") {
+            const std::string& peer = d["peer_id"].as_str();
             const auto& p = d["position"].as_array();
             if (p.size() == 2) {
               int x = static_cast<int>(p[0].as_int());
               int y = static_cast<int>(p[1].as_int());
               if (grid.in_bounds(x, y))
-                peer_positions[d["peer_id"].as_str()] = grid.cell(x, y);
+                peer_positions[peer] = grid.cell(x, y);
             }
-            subscribed_peers.insert(d["peer_id"].as_str());
-            peer_last_seen[d["peer_id"].as_str()] = mono_ms();
+            subscribed_peers.insert(peer);
+            peer_last_seen[peer] = mono_ms();
+            // idle-but-marked-busy reconciliation: the heartbeat carries a
+            // busy_task field while the agent holds a task.  A peer still
+            // reporting idle well past dispatch never received its Task
+            // (publish into a bus outage is dropped) — re-send the SAME
+            // task.  An agent whose done was lost instead is healed by its
+            // own retransmit (and refuses this duplicate by task id).
+            auto busy = peer_busy.find(peer);
+            if (busy != peer_busy.end() && !d.has("busy_task")) {
+              int64_t now = mono_ms();
+              auto since = busy_since.find(peer);
+              if (since != busy_since.end()
+                  && now - since->second > task_resend_ms) {
+                log_info("↻ %s reports idle but task %lld is in flight; "
+                         "re-sending\n", peer.c_str(),
+                         static_cast<long long>(
+                             busy->second["task_id"].as_int()));
+                bus.publish("mapd", busy->second);
+                since->second = now;
+              }
+            }
           } else if (type == "occupied_request") {
             // manager answers with ALL known positions (ref :441-468)
             Json occ;
@@ -318,11 +353,56 @@ int main(int argc, char** argv) {
             path_metrics.record_micros(d["duration_micros"].as_int(),
                                        d["timestamp_ms"].as_int());
           } else if (d["status"].as_str() == "done") {
-            // closed loop: fresh task for that peer immediately (ref :527-560)
             const std::string& peer = m.from;
+            const long long tid = d["task_id"].as_int();
+            // ack unconditionally: agents retransmit done until acked, and
+            // a duplicate (its ack was lost) must still be acked
+            Json ack;
+            ack.set("type", "done_ack").set("peer_id", peer)
+                .set("task_id", Json(static_cast<int64_t>(tid)));
+            bus.publish("mapd", ack);
+            if (completed_ids.count(tid)) {
+              // retransmit of an already-processed done, or the second
+              // completion of a re-queued task: counted once already.  If
+              // the reporter's CURRENT assignment is this very task (it
+              // completed the re-dispatched copy), free it and keep it in
+              // the work loop — but never clobber a DIFFERENT in-flight
+              // assignment (late retransmit after a fresh dispatch).
+              log_warn("⚠️  duplicate done for task %lld (%s) ignored\n",
+                       tid, peer.c_str());
+              auto busy = peer_busy.find(peer);
+              if (busy != peer_busy.end()
+                  && busy->second["task_id"].as_int() == tid) {
+                peer_busy.erase(busy);
+                busy_since.erase(peer);
+                if (!requeue.empty()) drain_requeue();
+                if (!peer_busy.count(peer) && subscribed_peers.count(peer))
+                  send_task_to(peer);
+              }
+              return;
+            }
+            completed_ids.insert(tid);
+            completed_order.push_back(tid);
+            if (completed_order.size() > 4096) {
+              completed_ids.erase(completed_order.front());
+              completed_order.pop_front();
+            }
+            // the presumed-dead original agent finished after all: cancel
+            // the queued duplicate before drain_requeue re-dispatches a
+            // task that is already complete (re-dispatch would also reset
+            // its metric from Completed back to Sent)
+            for (auto q = requeue.begin(); q != requeue.end(); ++q)
+              if ((*q)["task_id"].as_int() == tid) {
+                log_info("♻️  task %lld done by its original agent; queued "
+                         "duplicate cancelled\n", tid);
+                requeue.erase(q);
+                break;
+              }
+            // closed loop: fresh task for that peer immediately (ref :527-560)
             peer_busy.erase(peer);
+            busy_since.erase(peer);
             log_info("🎉 %s finished task %lld\n", peer.c_str(),
-                     static_cast<long long>(d["task_id"].as_int()));
+                     static_cast<long long>(tid));
             if (!requeue.empty())
               drain_requeue();  // orphans take priority over fresh tasks
             if (!peer_busy.count(peer) && subscribed_peers.count(peer))
@@ -356,6 +436,7 @@ int main(int argc, char** argv) {
                        busy->second["task_id"].as_int()));
               requeue.push_back(std::move(busy->second));
               peer_busy.erase(busy);
+              busy_since.erase(peer);
               drain_requeue();
             }
             log_info("👋 peer left: %s\n", peer.c_str());
@@ -389,6 +470,7 @@ int main(int argc, char** argv) {
                        busy->second["task_id"].as_int()));
           requeue.push_back(std::move(busy->second));
           peer_busy.erase(busy);
+          busy_since.erase(peer);
         } else {
           log_info("🧹 dropping silent peer %s (%lld ms)\n", peer.c_str(),
                    static_cast<long long>(now - it->second));

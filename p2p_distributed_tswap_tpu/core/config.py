@@ -169,6 +169,14 @@ class RuntimeConfig:
     # Centralized --solver=tpu: plan natively while the solver daemon has
     # produced no fresh response for this long (fleet must not stall).
     solver_failover_ms: int = 5_000
+    # Agents retransmit `done` on this cadence until the manager's done_ack
+    # arrives: a done published into a bus outage is dropped, which would
+    # otherwise strand the manager's busy bookkeeping forever (the
+    # reference simply loses such tasks, decentralized/manager.rs:185-189).
+    done_retry_ms: int = 2_000
+    # Managers re-send an in-flight task when its agent keeps reporting
+    # idle past this grace (the Task publish was dropped in a bus outage).
+    task_resend_ms: int = 5_000
     # Bus endpoint.
     bus_host: str = "127.0.0.1"
     bus_port: int = 7400
@@ -204,6 +212,8 @@ class RuntimeConfig:
             "MAPD_HEARTBEAT_MS": self.heartbeat_ms,
             "MAPD_AGENT_STALE_MS": self.agent_stale_ms,
             "MAPD_SOLVER_FAILOVER_MS": self.solver_failover_ms,
+            "MAPD_DONE_RETRY_MS": self.done_retry_ms,
+            "MAPD_TASK_RESEND_MS": self.task_resend_ms,
             "MAPD_LOG_LEVEL": self.log_level,
         }
         if self.task_csv_path:
