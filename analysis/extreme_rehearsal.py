@@ -13,10 +13,23 @@ ms/step here measures TOTAL WORK, not parallel wall-clock (same caveat as
 analysis/sharded_steptime.py).  The point is capability + residency, not
 speed.
 
+Checkpoint/resume (VERDICT r4 item 5: the round-4 1024²-band attempt was
+abandoned after 85 min with the rescue tool sitting unused in the repo):
+``--checkpoint PATH`` saves the full sharded MapdState (solver/
+checkpoint.py) plus a sidecar of loop latches every ``--checkpoint-every``
+steps and at ``--max-seconds`` session end; ``--resume`` restores it —
+skipping the multi-thousand-second prime burst, because the direction
+fields ride the checkpoint — and continues bit-identically (the solver is
+deterministic; tests/test_checkpoint.py).  A multi-hour band solve thus
+runs as bounded sessions that survive kills, with wall-clock accumulated
+across sessions in the sidecar.
+
 Usage:
   python analysis/extreme_rehearsal.py --probe 8        # feasibility: time 8 steps
   python analysis/extreme_rehearsal.py                  # full certified run
   python analysis/extreme_rehearsal.py --out MULTICHIP_REHEARSAL_r04.json
+  python analysis/extreme_rehearsal.py --checkpoint ck.npz --max-seconds 3600
+  python analysis/extreme_rehearsal.py --checkpoint ck.npz --resume  # next session
 """
 
 from __future__ import annotations
@@ -81,6 +94,14 @@ def main():
     ap.add_argument("--probe", type=int, default=0,
                     help="time N steps and exit (feasibility probe)")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--checkpoint", default=None,
+                    help="save resumable state here periodically")
+    ap.add_argument("--checkpoint-every", type=int, default=256,
+                    help="steps between checkpoint saves")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from --checkpoint if it exists")
+    ap.add_argument("--max-seconds", type=float, default=0,
+                    help="end this session (with a checkpoint) after N s")
     args = ap.parse_args()
 
     grid = Grid.warehouse(args.side, args.side)
@@ -124,12 +145,13 @@ def main():
                            s.t, done_t)
         return s, ok, done_t, mapd._finished(cfg, s)
 
+    from p2p_distributed_tswap_tpu.solver.checkpoint import (
+        load_extra, load_state, save_state)
+
     tasks_j = jnp.asarray(tasks, jnp.int32)
-    s = mapd.init_state(cfg, jnp.asarray(starts, jnp.int32), len(tasks))
-    s = mapd._transitions(cfg, s, tasks_j)
-    s = mapd._assign(cfg, s, tasks_j)
-    s = jax.device_put(s, jax.tree.map(
-        lambda sp: NamedSharding(mesh, sp), specs))
+    to_mesh = functools.partial(
+        jax.device_put,
+        device=jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs))
     free_j = jax.device_put(jnp.asarray(grid.free),
                             NamedSharding(mesh, P(TILES_AXIS, None)))
 
@@ -140,14 +162,48 @@ def main():
           f"band = {dirs_dev_mb:.0f} MB packed dirs, "
           f"{sweep_dev_mb:.0f} MB sweep transient", flush=True)
 
-    t0 = time.perf_counter()
-    s = prime(s, free_j)
-    int(s.t)
-    print(f"# prime burst: {time.perf_counter() - t0:.1f}s", flush=True)
-
+    steps = 0
+    prime_s = 0.0     # one-time field prime (paid once, rides checkpoints)
+    prior_s = 0.0     # loop wall-clock banked by previous sessions
+    sessions = 1
     ok = jnp.bool_(True)
     done_t = jnp.int32(-1)
-    steps = 0
+    if args.resume and args.checkpoint and os.path.exists(args.checkpoint):
+        # state AND loop latches live in the same npz (save_state extra=),
+        # atomically replaced as one file — a sidecar could tear from the
+        # state on a mid-save kill
+        s = to_mesh(load_state(args.checkpoint, cfg,
+                               expected_num_tasks=len(tasks)))
+        meta = load_extra(args.checkpoint)
+        steps = int(meta["steps"])
+        ok = jnp.bool_(bool(meta["invariants_ok"]))
+        done_t = jnp.int32(int(meta["done_t"]))
+        prime_s = float(meta["prime_s"])
+        prior_s = float(meta["loop_s"])
+        sessions = int(meta["sessions"]) + 1
+        print(f"# resumed session {sessions} at t={steps} "
+              f"({prior_s:.0f}s loop banked; prime burst skipped — the "
+              f"fields ride the checkpoint)", flush=True)
+    else:
+        s = mapd.init_state(cfg, jnp.asarray(starts, jnp.int32), len(tasks))
+        s = mapd._transitions(cfg, s, tasks_j)
+        s = mapd._assign(cfg, s, tasks_j)
+        s = to_mesh(s)
+        t0 = time.perf_counter()
+        s = prime(s, free_j)
+        int(s.t)
+        prime_s = time.perf_counter() - t0
+        print(f"# prime burst: {prime_s:.1f}s", flush=True)
+
+    def save_ckpt(elapsed_now):
+        # .npz suffix so np.savez doesn't append one behind our back
+        tmp = args.checkpoint + ".tmp.npz"
+        save_state(tmp, s, extra={
+            "steps": steps, "done_t": int(done_t),
+            "invariants_ok": bool(ok), "sessions": sessions,
+            "prime_s": prime_s, "loop_s": prior_s + elapsed_now})
+        os.replace(tmp, args.checkpoint)
+
     t0 = time.perf_counter()
     if args.probe:
         for _ in range(args.probe):
@@ -160,16 +216,37 @@ def main():
         return
 
     FETCH_EVERY = 32
+    session_steps = 0
+    last_saved = steps
     finished = False
     while not finished and steps < cfg.max_timesteps + FETCH_EVERY:
         for _ in range(FETCH_EVERY):
             s, ok, done_t, fin = fused_iter(s, tasks_j, free_j, ok, done_t)
             steps += 1
+            session_steps += 1
         finished = bool(fin)
+        elapsed = time.perf_counter() - t0
         if steps % 512 == 0:
-            print(f"# t={steps} elapsed={time.perf_counter()-t0:.0f}s",
-                  flush=True)
+            print(f"# t={steps} elapsed={elapsed:.0f}s (session "
+                  f"{sessions})", flush=True)
+        # steps only lands on multiples of FETCH_EVERY, so compare against
+        # the last save instead of a modulo that could never fire
+        if args.checkpoint and steps - last_saved >= args.checkpoint_every:
+            save_ckpt(elapsed)
+            last_saved = steps
+        if args.max_seconds and elapsed > args.max_seconds and not finished:
+            save_ckpt(elapsed)
+            print(json.dumps({
+                "session": sessions, "paused_at_step": steps,
+                "session_steps": session_steps,
+                "session_s": round(elapsed, 1),
+                "total_s": round(prime_s + prior_s + elapsed, 1),
+                "resume": f"--checkpoint {args.checkpoint} --resume",
+            }), flush=True)
+            return
     elapsed = time.perf_counter() - t0
+    if args.checkpoint:
+        save_ckpt(elapsed)
     makespan = int(done_t)
     completed = bool(np.asarray(s.task_used).all()) and 0 < makespan
     result = {
@@ -180,12 +257,15 @@ def main():
         "replan_chunk": args.replan_chunk,
         "per_device_dirs_mb": round(dirs_dev_mb, 1),
         "per_device_sweep_mb": round(sweep_dev_mb, 1),
-        "ms_per_step_serialized": round(1000.0 * elapsed / steps, 1),
+        "ms_per_step_serialized": round(
+            1000.0 * (prior_s + elapsed) / max(steps, 1), 1),
         "makespan": makespan if completed else None,
         "completed": completed,
         "invariants_ok": bool(ok),
         "steps_run": steps,
-        "wallclock_s": round(elapsed, 1),
+        "prime_s": round(prime_s, 1),
+        "wallclock_s": round(prime_s + prior_s + elapsed, 1),
+        "sessions": sessions,
     }
     print(json.dumps(result), flush=True)
     if args.out:

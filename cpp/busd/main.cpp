@@ -46,17 +46,22 @@ int main(int argc, char** argv) {
   uint16_t port = (argc > 1 && argv[1][0] != '-')
                       ? static_cast<uint16_t>(atoi(argv[1]))
                       : 7400;
+  // cross-host fleets: bind a routable interface ("0.0.0.0" for all) so
+  // agents on other hosts can reach the hub (RUN_INSTRUCTIONS cross-host)
+  const std::string bind_addr =
+      knobs.get_str("--bind", "MAPD_BUS_BIND", "127.0.0.1");
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
 
-  int listen_fd = tcp_listen(port);
+  int listen_fd = tcp_listen(port, bind_addr);
   if (listen_fd < 0) {
-    fprintf(stderr, "mapd_bus: cannot listen on 127.0.0.1:%u\n", port);
+    fprintf(stderr, "mapd_bus: cannot listen on %s:%u\n", bind_addr.c_str(),
+            port);
     return 1;
   }
   set_nonblocking(listen_fd);
-  log_info("mapd_bus listening on 127.0.0.1:%u\n", port);
+  log_info("mapd_bus listening on %s:%u\n", bind_addr.c_str(), port);
 
   std::map<int, std::unique_ptr<Client>> clients;
 

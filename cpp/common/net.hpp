@@ -26,15 +26,20 @@ inline int set_nonblocking(int fd) {
   return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
-// Listening socket on 127.0.0.1:port; returns fd or -1.
-inline int tcp_listen(uint16_t port) {
+// Listening socket on bind_addr:port (default loopback; pass "0.0.0.0"
+// or an interface address for cross-host fleets); returns fd or -1.
+inline int tcp_listen(uint16_t port,
+                      const std::string& bind_addr = "127.0.0.1") {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (inet_pton(AF_INET, bind_addr.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
   addr.sin_port = htons(port);
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       listen(fd, 128) < 0) {

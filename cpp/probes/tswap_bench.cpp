@@ -1,0 +1,82 @@
+// mapd_tswap_bench — native planning-time probe for the TPU crossover sweep.
+//
+// Times the centralized manager's native planning step (cpp/common/
+// tswap.hpp tswap_step — the semantic transcription of the reference's
+// tswap_step, src/algorithm/tswap.rs:174-286) at a given agent count in
+// STEADY STATE: distance fields pre-warmed and never trimmed (the most
+// flattering setup for the native path — the fleet's manager trims its
+// cache at 512 fields and would also pay BFS recomputes), agents that
+// arrive get a fresh goal from a bounded pool so the scan keeps running
+// against live traffic.  The occupant scan makes the step O(N^2)
+// (occupant_of is a linear scan per hop, tswap.hpp:33-38) — this probe
+// measures where that crosses the 500 ms planning tick, the wall the
+// reference hit at ~180 ms / 50 agents (manager.rs:564-567) and the
+// regime the TPU solver daemon exists for (analysis/crossover_sweep.py
+// pairs these numbers with solverd latencies).
+//
+// Usage: mapd_tswap_bench --agents N [--side S] [--iters K] [--seed X]
+// Prints one JSON line.
+
+#include <chrono>
+#include <cstdio>
+#include <random>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../common/grid.hpp"
+#include "../common/knobs.hpp"
+#include "../common/tswap.hpp"
+
+using namespace mapd;
+
+int main(int argc, char** argv) {
+  Knobs knobs(argc, argv);
+  const int n = static_cast<int>(knobs.get_int("--agents", "MAPD_AGENTS", 50));
+  const int side = static_cast<int>(knobs.get_int("--side", "MAPD_SIDE", 256));
+  const int iters = static_cast<int>(knobs.get_int("--iters", "MAPD_ITERS", 20));
+  const uint64_t seed = static_cast<uint64_t>(
+      knobs.get_int("--seed", "MAPD_SEED", 0));
+
+  Grid grid;
+  grid.width = grid.height = side;
+  grid.free.assign(static_cast<size_t>(side) * side, 1);
+  DistanceCache dc(grid);
+  std::mt19937_64 rng(seed);
+
+  // Distinct random starts; goals from a bounded pool (2N cells) so the
+  // field cache is finite and fully warm after the first pass.
+  auto cells = grid.free_cells();
+  for (size_t i = cells.size() - 1; i > 0; --i)
+    std::swap(cells[i], cells[rng() % (i + 1)]);
+  if (static_cast<size_t>(n) >= cells.size()) {
+    fprintf(stderr, "need at least one non-start free cell for goals\n");
+    return 1;
+  }
+  std::vector<Cell> goal_pool(cells.begin() + n,
+                              cells.begin() + n + std::min<size_t>(
+                                  2 * n, cells.size() - n));
+  std::vector<TswapAgent> agents(n);
+  for (int i = 0; i < n; ++i)
+    agents[i] = TswapAgent{i, cells[i], goal_pool[rng() % goal_pool.size()]};
+
+  // Warm every field the pool can produce (steady-state cache).
+  for (Cell g : goal_pool) dc.next_hop(0, g);
+  tswap_step(agents, dc);  // untimed warm step
+
+  double total_ms = 0, max_ms = 0;
+  for (int k = 0; k < iters; ++k) {
+    auto t0 = std::chrono::steady_clock::now();
+    tswap_step(agents, dc);
+    double ms = std::chrono::duration_cast<std::chrono::microseconds>(
+                    std::chrono::steady_clock::now() - t0).count() / 1000.0;
+    total_ms += ms;
+    max_ms = ms > max_ms ? ms : max_ms;
+    for (auto& a : agents)  // arrivals pick new work (steady-state churn)
+      if (a.v == a.g) a.g = goal_pool[rng() % goal_pool.size()];
+  }
+  printf("{\"agents\": %d, \"side\": %d, \"iters\": %d, "
+         "\"ms_per_step_avg\": %.3f, \"ms_per_step_max\": %.3f}\n",
+         n, side, iters, total_ms / iters, max_ms);
+  return 0;
+}

@@ -166,6 +166,53 @@ def distance_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
     return d
 
 
+def multi_source_field(free: jnp.ndarray, sources_idx: jnp.ndarray,
+                       max_rounds: int = 128) -> jnp.ndarray:
+    """Exact BFS distance from every cell to its NEAREST source — ONE field
+    regardless of how many sources (the min-plus sweeps take a multi-source
+    seed as naturally as a single goal).
+
+    Used by the bench's sound makespan lower bound: whoever physically
+    visits a task cell walked there from its own start, so the first-visit
+    time of any cell is >= its distance to the nearest agent start.
+
+    Args:
+      free: (H, W) bool, True where traversable.
+      sources_idx: (S,) int32 flat cell indices (e.g. all agent starts).
+      max_rounds: safety cap on sweep rounds.
+
+    Returns:
+      (H, W) int32; INF at obstacles and cells unreachable from every
+      source.
+    """
+    h, w = free.shape
+    d0 = jnp.full(h * w, INF, jnp.int32).at[sources_idx].set(0)
+    d0 = jnp.where(free.reshape(-1), d0, INF).reshape(1, h, w)
+
+    xcoord = jnp.arange(w, dtype=jnp.int32).reshape(1, 1, w)
+    ycoord = jnp.arange(h, dtype=jnp.int32).reshape(1, h, 1)
+
+    def one_round(d):
+        d = _sweep(d, free, axis=2, reverse=False, coord=xcoord)
+        d = _sweep(d, free, axis=2, reverse=True, coord=-xcoord)
+        d = _sweep(d, free, axis=1, reverse=False, coord=ycoord)
+        d = _sweep(d, free, axis=1, reverse=True, coord=-ycoord)
+        return d
+
+    def cond(state):
+        _, prev_changed, i = state
+        return prev_changed & (i < max_rounds)
+
+    def body(state):
+        d, _, i = state
+        nd = one_round(d)
+        return nd, jnp.any(nd != d), i + 1
+
+    d, _, _ = jax.lax.while_loop(cond, body,
+                                 (d0, jnp.bool_(True), jnp.int32(0)))
+    return d.reshape(h, w)
+
+
 def directions_from_distance(dist: jnp.ndarray, free: jnp.ndarray) -> jnp.ndarray:
     """Next-hop direction field from a distance field.
 

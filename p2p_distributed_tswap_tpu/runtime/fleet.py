@@ -78,12 +78,27 @@ class Fleet:
         time.sleep(0.3)
         if mode == "centralized" and solver == "tpu":
             # --solver=tpu planning happens in the JAX solver daemon
-            spawn("solverd",
-                  [sys.executable, "-m",
-                   "p2p_distributed_tswap_tpu.runtime.solverd",
-                   "--port", str(port), *map_args,
-                   *(solverd_args or [])])
-            time.sleep(8)  # accelerator init headroom
+            sd_proc = spawn("solverd",
+                            [sys.executable, "-m",
+                             "p2p_distributed_tswap_tpu.runtime.solverd",
+                             "--port", str(port), *map_args,
+                             *(solverd_args or [])])
+            # wait for the readiness banner (printed AFTER any --warm
+            # pre-compile) so the manager never opens with a failover
+            # window; without logs fall back to a fixed headroom sleep
+            if self.log_dir:
+                sd_log = self.log_dir / "solverd.log"
+                deadline = time.monotonic() + 240
+                while time.monotonic() < deadline:
+                    if sd_proc.poll() is not None:
+                        break  # died at startup: manager will plan natively
+                    if (sd_log.exists()
+                            and "solverd up" in sd_log.read_text(
+                                errors="ignore")):
+                        break
+                    time.sleep(0.5)
+            else:
+                time.sleep(8)  # accelerator init headroom
         mgr_cmd = [str(build / f"mapd_manager_{mode}"), "--port", str(port),
                    *map_args]
         if mode == "centralized":

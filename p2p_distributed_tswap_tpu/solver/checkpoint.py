@@ -50,11 +50,33 @@ def _v1_defaults(n: int, pos: np.ndarray, goal: np.ndarray,
     }
 
 
-def save_state(path: str, state: MapdState) -> None:
+def save_state(path: str, state: MapdState, extra: dict | None = None
+               ) -> None:
     """Write ``state`` to ``path`` as a compressed npz archive (host-side:
-    device arrays are fetched)."""
+    device arrays are fetched).
+
+    ``extra`` — optional caller metadata (scalars/arrays) stored in the
+    SAME archive under reserved ``__x_<key>__`` names, so state and its
+    loop latches (step counters, invariant folds, wall-clock ledgers)
+    live in one atomically-replaceable file: a sidecar written separately
+    can tear from the state on a mid-save kill, which is exactly the
+    crash window checkpoints exist for.  Read back with
+    :func:`load_extra`."""
     arrays = {name: np.asarray(getattr(state, name)) for name in _FIELDS}
+    for k, v in (extra or {}).items():
+        arrays[f"__x_{k}__"] = np.asarray(v)
     np.savez_compressed(path, __format_version__=FORMAT_VERSION, **arrays)
+
+
+def load_extra(path: str) -> dict:
+    """Return the ``extra`` dict stored by :func:`save_state` (empty if
+    none was stored)."""
+    out = {}
+    with np.load(path) as z:
+        for name in z.files:
+            if name.startswith("__x_") and name.endswith("__"):
+                out[name[4:-2]] = z[name]
+    return out
 
 
 def load_state(path: str, cfg: SolverConfig | None = None,

@@ -15,6 +15,7 @@ from p2p_distributed_tswap_tpu.ops.distance import (
     direction_fields,
     directions_from_distance,
     distance_fields,
+    multi_source_field,
 )
 
 
@@ -102,6 +103,18 @@ def test_direction_tiebreak_is_first_min():
     assert dirs[1, 2] == 3  # (-1,0): step -x
     # corner (0,0): both (0,1) and (1,0) descend; first in order wins -> 0
     assert dirs[0, 0] == 0
+
+
+def test_multi_source_field_is_min_over_single_sources():
+    g = Grid.random_obstacles(24, 24, 0.2, seed=5)
+    free = jnp.asarray(g.free)
+    rng = np.random.default_rng(0)
+    free_idx = np.flatnonzero(np.asarray(g.free).reshape(-1))
+    sources = rng.choice(free_idx, size=7, replace=False).astype(np.int32)
+    singles = np.asarray(distance_fields(free, jnp.asarray(sources)))
+    expect = singles.reshape(7, -1).min(axis=0)
+    got = np.asarray(multi_source_field(free, jnp.asarray(sources)))
+    np.testing.assert_array_equal(got.reshape(-1), expect)
 
 
 def test_apply_direction_roundtrip():
