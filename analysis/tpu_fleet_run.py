@@ -117,7 +117,15 @@ def main():
                      if "solverd up" in ln), "")
     warm_line = next((ln for ln in solverd_log.splitlines()
                       if "pre-warmed" in ln), "")
-    recompile_stalls = solverd_log.count("recompiled step program")
+    # count stalls AFTER the readiness banner only: the --warm compile
+    # itself prints a recompile line before "solverd up" by design.  No
+    # banner = the daemon never became ready; report None, not a count
+    # that would misattribute the warm compile as a runtime stall.
+    if "solverd up" in solverd_log:
+        recompile_stalls = solverd_log.split("solverd up", 1)[1].count(
+            "recompiled step program")
+    else:
+        recompile_stalls = None
     mgr_log = (log_dir / "manager.log").read_text(errors="ignore") \
         if (log_dir / "manager.log").exists() else ""
     failed_over = "planning natively" in mgr_log

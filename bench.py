@@ -405,47 +405,66 @@ def run_rung(name: str, seed: int = 0) -> dict:
     }
 
 
-def run_rung_subprocess(name: str, tries: int, seed: int = 0) -> dict:
+def run_rung_subprocess(name: str, tries: int, seeds=(0,)) -> list:
     """Run one rung isolated in a fresh process, retrying on the tunnel's
-    nondeterministic kernel faults.  The LAST retry of a full-solve rung
-    falls back to the stepwise window, which dodges the fused-program
-    fault class at the cost of dispatch overhead."""
+    nondeterministic kernel faults.  ALL requested seeds run in the SAME
+    subprocess (one line per seed): the jitted solve is shape-identical
+    across seeds, so 5 seeds pay ONE compile instead of five.  Returns the
+    per-seed records that made it out (a mid-batch fault keeps the seeds
+    already printed).  The LAST retry of a full-solve rung falls back to
+    the stepwise window, which dodges the fused-program fault class at the
+    cost of dispatch overhead."""
     err = ""
+    seeds = list(seeds)
     for attempt in range(tries):
         env = dict(os.environ)
         # fall back to stepwise only on a LAST retry that follows a real
         # fused failure (tries=1 must still run the fused path)
         if attempt == tries - 1 and attempt > 0 and name in FULL_SOLVE:
             env["BENCH_STEPWISE"] = "1"
+        # budget scales with the batch: one hour for the first seed plus
+        # half an hour per additional seed
+        budget = 3600 + 1800 * (len(seeds) - 1)
+        stdout = ""
+        timed_out = False
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--rung", name,
-                 "--seed", str(seed)],
-                capture_output=True, text=True, timeout=3600, env=env,
+                 "--seeds", ",".join(str(s) for s in seeds)],
+                capture_output=True, text=True, timeout=budget, env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
-        except subprocess.TimeoutExpired:
-            # a rung overrunning its hour (degraded tunnel at the 4096^2 /
-            # long-horizon rungs) is a per-rung failure, not a bench abort
+            stdout = proc.stdout or ""
+        except subprocess.TimeoutExpired as e:
+            # a rung overrunning its budget (degraded tunnel at the 4096^2
+            # / long-horizon rungs) is a per-rung failure, not a bench
+            # abort — but seeds that already printed are kept
             print(json.dumps({"rung": name, "attempt": attempt + 1,
-                              "transient_failure": "timeout 3600s"}),
+                              "transient_failure": f"timeout {budget}s"}),
                   file=sys.stderr, flush=True)
-            err = "timeout 3600s"
-            continue
-        for line in reversed(proc.stdout.strip().splitlines()):
+            err = f"timeout {budget}s"
+            stdout = (e.stdout.decode() if isinstance(e.stdout, bytes)
+                      else e.stdout) or ""
+            timed_out = True
+        outs = []
+        for line in stdout.strip().splitlines():
             try:
                 out = json.loads(line)
             except json.JSONDecodeError:
                 continue
             if "metric" in out:
-                return out
+                outs.append(out)
+        if outs:
+            return outs
+        if timed_out:
+            continue
         err = (proc.stderr or proc.stdout or "")[-400:]
         print(json.dumps({"rung": name, "attempt": attempt + 1,
                           "transient_failure": err.splitlines()[-1] if err
                           else "no output"}), file=sys.stderr, flush=True)
         if attempt < tries - 1:
             time.sleep(15)  # give the tunnel a moment to recover
-    return {"metric": f"mapd_step_wallclock_{name}", "value": None,
-            "unit": "ms/step", "vs_baseline": None, "error": err}
+    return [{"metric": f"mapd_step_wallclock_{name}", "value": None,
+             "unit": "ms/step", "vs_baseline": None, "error": err}]
 
 
 def _aggregate_seeds(name: str, per_seed: list) -> dict:
@@ -493,8 +512,13 @@ MULTISEED_RUNGS = {"ref", "medium", "flagship",
 
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
-        seed = int(sys.argv[4]) if len(sys.argv) >= 5 else 0
-        print(json.dumps(run_rung(sys.argv[2], seed)), flush=True)
+        # --seeds a,b,c runs every seed in THIS process (one compile);
+        # --seed N is the single-seed spelling
+        seeds = [0]
+        if len(sys.argv) >= 5 and sys.argv[3] in ("--seed", "--seeds"):
+            seeds = [int(x) for x in sys.argv[4].split(",")]
+        for sd in seeds:
+            print(json.dumps(run_rung(sys.argv[2], sd)), flush=True)
         return
     tries = int(os.environ.get("BENCH_TRIES", "3"))
     rungs = os.environ.get("BENCH_RUNGS", DEFAULT_RUNGS)
@@ -502,15 +526,18 @@ def main():
              os.environ.get("BENCH_SEEDS", "0,1,2,3,4").split(",")]
     results = {}
     for name in [r.strip() for r in rungs.split(",") if r.strip()]:
-        if name in MULTISEED_RUNGS and len(seeds) > 1:
-            per_seed = []
-            for seed in seeds:
-                r = run_rung_subprocess(name, tries, seed)
-                per_seed.append(r)
+        use = seeds if (name in MULTISEED_RUNGS and len(seeds) > 1) \
+            else seeds[:1]
+        per_seed = run_rung_subprocess(name, tries, use)
+        if len(use) > 1:
+            # aggregate whenever MULTIPLE seeds were REQUESTED — even a
+            # fault-truncated batch must keep the multiseed schema (and
+            # its seeds list shows exactly how many made it)
+            for r in per_seed:
                 print(json.dumps(r), flush=True)
             res = _aggregate_seeds(name, per_seed)
         else:
-            res = run_rung_subprocess(name, tries, seeds[0])
+            res = per_seed[0]
         results[name] = res
         print(json.dumps(res), flush=True)
     # Headline LAST (the driver parses one JSON line): the reference rung,

@@ -84,7 +84,10 @@ echo "quit" >&3
 wait $MANAGER_PID 2>/dev/null
 MANAGER_PID=
 
-COMPLETED=$(grep -c ",completed$" "$OUT/task_metrics.csv" 2>/dev/null || echo 0)
+# grep -c prints "0" AND exits 1 on zero matches; reassign instead of
+# appending a second line via `|| echo 0`
+COMPLETED=$(grep -c ",completed$" "$OUT/task_metrics.csv" 2>/dev/null) \
+  || COMPLETED=0
 DISPATCHED=$(($(wc -l < "$OUT/task_metrics.csv" 2>/dev/null || echo 1) - 1))
 {
   echo "test: cross-host (network namespace) decentralized fleet"
