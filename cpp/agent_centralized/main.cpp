@@ -177,6 +177,15 @@ int main(int argc, char** argv) {
           unacked_done.reset();
           unacked_done_id = -1;
         }
+      } else if (type == "task_withdrawn") {
+        // a TSWAP goal exchange moved this task to another agent; drop
+        // the stale copy so positional completion can't double-fire
+        if (d["peer_id"].as_str() == my_id && my_task
+            && (*my_task)["task_id"].as_int() == d["task_id"].as_int()) {
+          log_info("🔁 task %lld withdrawn (exchanged away)\n",
+                   d["task_id"].as_int());
+          my_task.reset();
+        }
       } else if (type.empty() && d.has("pickup") && d.has("delivery")) {
         if (d["peer_id"].as_str() != my_id) return;
         const long long tid = d["task_id"].as_int();

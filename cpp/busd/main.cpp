@@ -107,8 +107,15 @@ int main(int argc, char** argv) {
       if (it == clients.end()) continue;
       Client& c = *it->second;
       bool ok = true;
-      if (pfds[k].revents & (POLLERR | POLLHUP)) ok = false;
-      if (ok && (pfds[k].revents & POLLIN)) ok = c.conn.on_readable();
+      const char* why = "";
+      if (pfds[k].revents & (POLLERR | POLLHUP)) {
+        ok = false;
+        why = "pollerr/hup";
+      }
+      if (ok && (pfds[k].revents & POLLIN)) {
+        ok = c.conn.on_readable();
+        if (!ok) why = "read-eof/err";
+      }
       while (ok) {
         auto line = c.conn.next_line();
         if (!line) break;
@@ -152,8 +159,15 @@ int main(int argc, char** argv) {
           c.conn.send_line(reply.dump());
         }
       }
-      if (ok && (c.conn.wants_write())) ok = c.conn.on_writable();
-      if (!ok) dead.push_back(fd);
+      if (ok && (c.conn.wants_write())) {
+        ok = c.conn.on_writable();
+        if (!ok) why = "write-err";
+      }
+      if (!ok) {
+        log_debug("dropping client fd=%d peer=%s (%s, errno=%d)\n", fd,
+                  c.peer_id.c_str(), why, errno);
+        dead.push_back(fd);
+      }
     }
 
     for (int fd : dead) {

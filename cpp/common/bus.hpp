@@ -169,11 +169,13 @@ class BusClient {
   // with it, drop the socket and arm the backoff timer.
   bool drop_or_retry() {
     if (!reconnect_) return false;
+    const int err = errno;  // capture BEFORE close() can overwrite it
     conn_.close_fd();
     backoff_ms_ = 250;
     next_attempt_ms_ = mono_ms() + backoff_ms_;
-    fprintf(stderr, "bus: connection lost, reconnecting (backoff %lld ms)\n",
-            static_cast<long long>(backoff_ms_));
+    fprintf(stderr,
+            "bus: connection lost (errno=%d), reconnecting (backoff "
+            "%lld ms)\n", err, static_cast<long long>(backoff_ms_));
     return true;
   }
 
@@ -194,6 +196,8 @@ class BusClient {
       backoff_ms_ = backoff_ms_ ? std::min<int64_t>(backoff_ms_ * 2, 4000)
                                 : 250;
       next_attempt_ms_ = now + backoff_ms_;
+      fprintf(stderr, "bus: reconnect attempt failed (errno=%d), next in "
+              "%lld ms\n", errno, static_cast<long long>(backoff_ms_));
       return true;
     }
     set_nonblocking(fd);

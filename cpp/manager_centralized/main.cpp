@@ -240,6 +240,106 @@ int main(int argc, char** argv) {
     }
   };
 
+  // TSWAP goal exchanges ARE task re-assignments: when a step hands agent
+  // k the goal agent j held, the task (and phase) follows the goal, and
+  // the manager — the system of record for assignments — re-broadcasts
+  // the exchanged Tasks so agent-side positional completion tracks the
+  // NEW task.  (The decentralized agents do exactly this on the wire with
+  // swap_request/response; this is the centralized equivalent.)
+  //
+  // History: round 4 instead RESET goals from tasks every tick ("never
+  // persist swapped goals") — that fixed the wrong-delivery freeze but
+  // created a rarer head-on LIVELOCK: two agents meeting at even
+  // separation trigger a Rule-4 rotation, retreat one cell, have their
+  // goals snapped back, and repeat forever (observed as fleets frozen
+  // right after a pickup flip in the round-4/5 flaky e2e runs).  With
+  // tasks following goals, the rotation is real progress exactly like
+  // the offline kernel (solver/step.py slot permutation).
+  //
+  // Push-extension goals (a parked blocker retargeted at the mover's
+  // CELL, not at any agent's goal) match no donor: the movement pass
+  // already resolved the pair this tick, and the blocker keeps its task
+  // state — its goal resets next tick.  An agent whose task was donated
+  // away and who received none becomes idle (task_withdrawn tells it to
+  // drop its stale copy); try_assign_pending refills it.  Exchanged-task
+  // re-broadcasts make the receiving agent re-emit received/started
+  // metrics, which update the original record's timestamps — accepted
+  // (the task is genuinely being re-assigned).
+  auto adopt_goal_exchanges = [&](const std::vector<std::string>& ids,
+                                  const std::vector<Cell>& old_goals,
+                                  const std::vector<Cell>& new_goals) {
+    struct Incoming {
+      std::optional<Json> task;
+      Phase phase = Phase::None;
+      bool set = false;
+    };
+    std::multimap<Cell, size_t> donors;  // old goal cell -> index
+    for (size_t k = 0; k < ids.size(); ++k)
+      if (new_goals[k] != old_goals[k]) donors.insert({old_goals[k], k});
+    if (donors.empty()) return;
+    std::vector<Incoming> incoming(ids.size());
+    std::vector<char> donated(ids.size(), 0);
+    for (size_t k = 0; k < ids.size(); ++k) {
+      if (new_goals[k] == old_goals[k]) continue;
+      auto range = donors.equal_range(new_goals[k]);
+      for (auto it = range.first; it != range.second; ++it) {
+        size_t j = it->second;
+        if (donated[j] || j == k) continue;  // each donor gives once
+        AgentInfo& aj = agents[ids[j]];
+        incoming[k] = Incoming{aj.task, aj.phase, true};
+        donated[j] = 1;
+        break;
+      }
+    }
+    auto withdraw = [&](const std::string& peer, const Json& task) {
+      Json w;
+      w.set("type", "task_withdrawn")
+          .set("task_id", task["task_id"])
+          .set("peer_id", peer);
+      bus.publish("mapd", w);
+      log_info("🔁 task %lld exchanged away from %s\n",
+               task["task_id"].as_int(), peer.c_str());
+    };
+    for (size_t k = 0; k < ids.size(); ++k) {
+      if (!donated[k] && !incoming[k].set) continue;
+      AgentInfo& a = agents[ids[k]];
+      if (donated[k] && !incoming[k].set) {
+        // task handed away, nothing received: now idle
+        if (a.task) withdraw(ids[k], *a.task);
+        a.task.reset();
+        a.phase = Phase::None;
+        a.goal = a.pos;
+      } else if (incoming[k].set) {
+        // received an IDLE donor's positional goal while donating a task
+        // away: the agent must drop its stale copy too
+        if (donated[k] && a.task && !incoming[k].task)
+          withdraw(ids[k], *a.task);
+        if (!donated[k] && a.task) {
+          // the receiver's own task was claimed by NOBODY (its new goal
+          // came from a push-extension coincidence): never drop a live
+          // task — back onto the pending queue it goes
+          requeue_task(ids[k], a, "exchange displaced");
+        }
+        a.task = incoming[k].task;
+        a.phase = incoming[k].phase;
+        if (a.task) {
+          a.task->set("peer_id", ids[k]);
+          auto cell = parse_point((*a.task)[
+              a.phase == Phase::ToDelivery ? "delivery" : "pickup"]);
+          if (cell) a.goal = *cell;
+          a.dispatched_ms = mono_ms();
+          bus.publish("mapd", *a.task);  // the re-assignment, on the wire
+          log_info("🔁 task %lld exchanged to %s\n",
+                   (*a.task)["task_id"].as_int(), ids[k].c_str());
+        } else {
+          a.phase = Phase::None;
+          a.goal = a.pos;
+        }
+      }
+    }
+    try_assign_pending();  // displaced tasks go straight back out
+  };
+
   // pickup-arrival phase transitions (ref :695-709): the MANAGER flips the
   // goal to delivery in centralized mode
   auto pickup_transitions = [&]() {
@@ -259,9 +359,11 @@ int main(int argc, char** argv) {
 
   auto plan_native = [&]() {
     std::vector<std::string> ids;
+    std::vector<Cell> old_goals;
     std::vector<TswapAgent> ta;
     for (auto& [peer, a] : agents) {
       ids.push_back(peer);
+      old_goals.push_back(a.goal);
       ta.push_back(TswapAgent{static_cast<int>(ta.size()), a.pos, a.goal});
     }
     if (ta.empty()) return;
@@ -271,31 +373,39 @@ int main(int argc, char** argv) {
                      std::chrono::steady_clock::now() - t0)
                      .count();
     path_metrics.record_micros(us, unix_ms());
-    // TSWAP may swap/rotate goals WITHIN the step, but manager state keeps
-    // the task-derived goal, exactly like the reference's plan_all_paths
-    // (manager.rs:131-141 writes back only current_pos).  Persisting
-    // swapped goals permanently freezes the fleet: after a swap between a
-    // task-carrying agent and a parked one, the carrier is steered to the
-    // wrong delivery cell, its positional done (agent-side, per ITS task)
-    // never fires, and every later plan says "stay" — observed live as a
-    // full-fleet deadlock in the solverd e2e.
-    std::vector<Cell> next(ids.size());
-    for (size_t k = 0; k < ids.size(); ++k) next[k] = ta[k].v;
+    std::vector<Cell> next(ids.size()), new_goals(ids.size());
+    for (size_t k = 0; k < ids.size(); ++k) {
+      next[k] = ta[k].v;
+      new_goals[k] = ta[k].g;
+    }
     emit_moves(ids, next);
+    // swapped/rotated goals carry their tasks with them (see
+    // adopt_goal_exchanges: the round-4 reset-every-tick alternative
+    // livelocks head-on pairs)
+    adopt_goal_exchanges(ids, old_goals, new_goals);
   };
+
+  // goals as they were SENT for the in-flight plan_seq: the daemon's
+  // returned goals are relative to these, and any goal mutation between
+  // request and response (completion, fresh assignment, idle reset) must
+  // not be misread as an exchange
+  std::map<std::string, Cell> sent_goals;
 
   auto plan_request_tpu = [&]() {
     Json req;
     Json arr;
+    std::map<std::string, Cell> snap;
     for (auto& [peer, a] : agents) {
       Json e;
       e.set("peer_id", peer)
           .set("pos", point_json(a.pos))
           .set("goal", point_json(a.goal));
       arr.push_back(e);
+      snap[peer] = a.goal;
     }
     if (arr.is_null()) return;
     req.set("type", "plan_request").set("seq", ++plan_seq).set("agents", arr);
+    sent_goals = std::move(snap);
     bus.publish("solver", req);
   };
 
@@ -320,19 +430,32 @@ int main(int argc, char** argv) {
     int64_t us = d["duration_micros"].as_int();
     path_metrics.record_micros(us, unix_ms());
     std::vector<std::string> ids;
-    std::vector<Cell> next;
+    std::vector<Cell> next, old_goals, new_goals;
     for (const auto& mv : d["moves"].as_array()) {
       auto np = parse_point(mv["next_pos"]);
       if (!np) continue;
       const std::string& peer = mv["peer_id"].as_str();
       auto it = agents.find(peer);
       if (it == agents.end()) continue;
-      // the daemon's returned goals (post-swap) are deliberately NOT
-      // adopted — same reference-parity/freeze reasoning as plan_native
       ids.push_back(peer);
       next.push_back(*np);
+      // exchanges are judged against the goal THE REQUEST carried, and
+      // only for agents whose goal is unchanged since — a completion or
+      // fresh assignment in flight must not fabricate a phantom exchange
+      auto sg = sent_goals.find(peer);
+      Cell base = (sg != sent_goals.end()
+                   && sg->second == it->second.goal)
+                      ? sg->second
+                      : it->second.goal;
+      old_goals.push_back(base);
+      auto ng = parse_point(mv["goal"]);
+      new_goals.push_back(
+          ng && base == it->second.goal ? *ng : it->second.goal);
     }
     emit_moves(ids, next);
+    // the daemon's returned post-swap goals re-assign tasks exactly like
+    // the native path (adopt_goal_exchanges)
+    adopt_goal_exchanges(ids, old_goals, new_goals);
   };
 
   auto save_csv = [&](const std::string& path, const std::string& content) {
@@ -446,11 +569,17 @@ int main(int argc, char** argv) {
               if (!a.task) a.goal = *p;
               // idle-but-marked-busy reconciliation: the heartbeat carries
               // a busy_task field while the agent holds a task; still-idle
-              // well past dispatch means the Task publish was dropped in a
-              // bus outage — re-send the SAME task.  A lost DONE instead
-              // heals via the agent's retransmit (which also refuses this
-              // duplicate by task id).
-              if (a.task && !d.has("busy_task")
+              // (or still on a DIFFERENT task — an exchanged-task
+              // re-broadcast can be lost too) well past dispatch means the
+              // Task publish was dropped — re-send the SAME task.  A lost
+              // DONE instead heals via the agent's retransmit (which also
+              // refuses this duplicate by task id).
+              bool stale_assignment =
+                  a.task
+                  && (!d.has("busy_task")
+                      || d["busy_task"].as_int()
+                             != (*a.task)["task_id"].as_int());
+              if (stale_assignment
                   && mono_ms() - a.dispatched_ms > task_resend_ms) {
                 log_info("↻ %s reports idle but task %lld is in flight; "
                          "re-sending\n", peer.c_str(),

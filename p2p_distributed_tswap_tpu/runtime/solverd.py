@@ -16,12 +16,12 @@ permutes goals far more often than the task lifecycle creates new ones.
 Wire: plan_request  {type, seq, agents:[{peer_id, pos:[x,y], goal:[x,y]}]}
       plan_response {type, seq, duration_micros,
                      moves:[{peer_id, next_pos:[x,y], goal:[x,y]}]}
-      (``goal`` in a move is DIAGNOSTIC ONLY — it shows the step's
-      swap/rotation decisions.  The manager deliberately does not adopt
-      it: manager goals stay task-derived, matching the reference's
-      plan_all_paths, which persists only positions — persisting swapped
-      goals froze fleets whose carrier agents were steered to wrong
-      delivery cells.)
+      (``goal`` in a move carries the step's swap/rotation decisions; the
+      manager adopts them as TASK re-assignments — the task follows the
+      exchanged goal and both Tasks are re-broadcast
+      (manager_centralized adopt_goal_exchanges).  Round 4 ignored the
+      returned goals, which livelocked head-on pairs: rotation, retreat,
+      goal reset, repeat.)
 
 Usage: python -m p2p_distributed_tswap_tpu.runtime.solverd
            [--port 7400] [--map FILE] [--capacity-min 16] [--warm N]
