@@ -180,8 +180,21 @@ int main(int argc, char** argv) {
 
   std::map<std::string, NearbyEntry> nearby;  // peer -> last known pos/goal
   std::map<std::string, int64_t> pending_requests;  // request_id -> issued ms
-  std::optional<std::pair<std::string, int64_t>> pending_goal_swap;
-  std::optional<std::pair<std::string, int64_t>> pending_rotation;
+  // One outstanding TASK exchange.  A TSWAP goal exchange here is a task
+  // re-assignment — the same principle as the centralized manager's
+  // exchange handling: goals and tasks move TOGETHER, because phase
+  // transitions are positional against the task's own cells and a goal
+  // pointing away from the held task parks the agent forever (observed
+  // live: two post-outage agents frozen mid-delivery at each other's
+  // goals while heartbeating).  `target` disambiguates a CROSSED pair
+  // (head-on agents requesting each other simultaneously) from a
+  // three-way collision: the former must complete, the latter decline.
+  struct PendingSwap {
+    std::string req_id;
+    std::string target;
+    int64_t issued_ms = 0;
+  };
+  std::optional<PendingSwap> pending_swap;
   PathComputationMetrics path_metrics;
 
   // Done retransmit-until-ack (lost-done desync fix, VERDICT r4 weak #1):
@@ -266,6 +279,44 @@ int main(int argc, char** argv) {
     }
   };
 
+  // Adopt a task AT THE PHASE it was handed over in: the new holder
+  // continues to the exact cell the old holder was heading to (what a
+  // goal swap means under TSWAP), and positional arrive_check keeps
+  // working because the task rides along with the goal.
+  auto adopt_task = [&](const Json& task, const std::string& phase) {
+    my_task = task;
+    task_state = phase == "delivery" ? TaskState::MovingToDelivery
+                                     : TaskState::MovingToPickup;
+    auto c = task_cell(task_state == TaskState::MovingToDelivery
+                           ? "delivery" : "pickup");
+    if (c) my_goal = *c;
+    log_info("🔄 adopted task %lld at %s phase\n",
+             static_cast<long long>(task["task_id"].as_int()),
+             phase.c_str());
+    publish_position();
+    arrive_check();  // the handed-over cell can be this very cell
+  };
+  auto current_phase = [&]() {
+    return task_state == TaskState::MovingToDelivery ? "delivery" : "pickup";
+  };
+  // One in-flight exchange at a time; a lost response ages out via
+  // swap_timeout_ms and the next decision tick retries (possibly with a
+  // different blocker).  A task stranded by a lost response is healed by
+  // the manager's unclaimed-task sweep.
+  auto request_task_swap = [&](const std::string& peer, int64_t now) {
+    if (pending_swap || !my_task) return;
+    std::string req_id = my_id + "_" + std::to_string(unix_ms());
+    Json req;
+    req.set("type", "swap_request")
+        .set("request_id", req_id)
+        .set("from_peer", my_id)
+        .set("to_peer", peer)
+        .set("task", *my_task)
+        .set("phase", current_phase());
+    bus.publish("mapd", req);
+    pending_swap = PendingSwap{req_id, peer, now};
+  };
+
   int64_t last_tick = 0;
   int64_t last_metrics_print = mono_ms();
 
@@ -300,6 +351,14 @@ int main(int argc, char** argv) {
                                                    : d["peer_id"]);
         bus.publish("mapd", resp);
       } else if (type == "goal_swap_request") {
+        // LEGACY-WIRE COMPAT (this handler and the two below): our agents
+        // coordinate exchanges exclusively through swap_request — a goal
+        // exchange IS a task re-assignment — but the reference's wire
+        // catalog (C10) includes goal_swap_request/goal_swap_response/
+        // target_rotation_request, so foreign peers speaking them still
+        // get protocol-correct answers.  A goal they move away from our
+        // task cannot strand us: the pos==goal resume guard in the
+        // decision loop re-targets our own task.
         if (d["to_peer"].as_str() != my_id) return;
         // always accept: reply with my old goal, take theirs (ref :1041-1072)
         Json inner;
@@ -327,7 +386,6 @@ int main(int argc, char** argv) {
                     (*inner)["from_peer"].as_str().c_str());
           my_goal = *g;
         }
-        pending_goal_swap.reset();
       } else if (type == "target_rotation_request") {
         const auto& parts = d["participants"].as_array();
         const auto& goals = d["goals"].as_array();
@@ -344,26 +402,89 @@ int main(int argc, char** argv) {
           }
         }
       } else if (type == "swap_request") {
-        if (d["to_peer"].as_str() != my_id || !my_task) return;
-        Json resp;  // task swap: hand over my task, adopt theirs (ref :1110-1136)
+        // Task exchange (ref :1110-1136, extended): goals and tasks move
+        // together.  An idle responder simply adopts the incoming task
+        // (it was parked in the requester's way; now it has somewhere to
+        // go) and replies taskless so the requester parks instead.
+        if (d["to_peer"].as_str() != my_id) return;
+        Json resp;
         resp.set("type", "swap_response")
+            .set("request_id", d["request_id"])
             .set("from_peer", my_id)
-            .set("to_peer", d["from_peer"])
-            .set("task", *my_task);
+            .set("to_peer", d["from_peer"]);
+        if (pending_swap && pending_swap->target == d["from_peer"].as_str()) {
+          // CROSSED pair: we are requesting this very peer right now.
+          // Complete the exchange through THEIR request and drop ours —
+          // their response to our request (carrying the same task we
+          // adopt here) is then ignored by the request_id check.
+          pending_swap.reset();
+        } else if (pending_swap) {
+          // a THIRD party's request while our own exchange is
+          // outstanding: accepting here and then the pending response
+          // would adopt twice and strand a task with no holder.
+          // Decline; the requester retries next tick.
+          resp.set("declined", true);
+          bus.publish("mapd", resp);
+          return;
+        }
+        if (d.has("task") && unacked_done
+            && d["task"]["task_id"].as_int() == unacked_done_id) {
+          // the offered task is one WE already completed (stale holder
+          // from a lost response): tell the requester to stand down and
+          // heal it by retransmitting the done — mirrors the bare-Task
+          // handler's duplicate refusal
+          bus.publish("mapd", resp);  // taskless: requester parks idle
+          bus.publish("mapd", unacked_done_metric);
+          bus.publish("mapd", *unacked_done);
+          done_last_sent_ms = mono_ms();
+          return;
+        }
+        const bool retransmit =
+            my_task && d.has("task")
+            && (*my_task)["task_id"].as_int()
+                   == d["task"]["task_id"].as_int();
+        if (my_task && !retransmit)
+          resp.set("task", *my_task).set("phase", current_phase());
         bus.publish("mapd", resp);
-        my_task = d["task"];
-        if (auto p = task_cell("pickup")) {  // adopt the incoming task fully
-          my_goal = *p;
-          task_state = TaskState::MovingToPickup;
-          arrive_check();  // adopted-in-place: pickup may be this very cell
+        if (retransmit) return;  // we already hold their copy: stand down
+        if (d.has("task")) {
+          adopt_task(d["task"], d["phase"].as_str());
+        } else if (my_task) {
+          // gave mine away and got nothing back: park idle
+          my_task.reset();
+          task_state = TaskState::Idle;
+          my_goal = my_pos;
         }
       } else if (type == "swap_response") {
         if (d["to_peer"].as_str() != my_id) return;
-        my_task = d["task"];
-        if (auto p = task_cell("pickup")) {
-          my_goal = *p;
-          task_state = TaskState::MovingToPickup;
-          arrive_check();
+        // only the exchange we actually have outstanding: a late or
+        // duplicate response must not clobber a newer assignment
+        if (!pending_swap || d["request_id"].as_str() != pending_swap->req_id)
+          return;
+        pending_swap.reset();
+        if (d["declined"].as_bool()) return;  // busy peer: retry next tick
+        if (d.has("task") && unacked_done
+            && d["task"]["task_id"].as_int() == unacked_done_id) {
+          // offered back a task we already completed: refuse it, heal by
+          // retransmitting the done.  The responder DID adopt the task we
+          // sent (a response carrying a task means the exchange
+          // committed on its side), so we park idle rather than keep a
+          // double-held copy.
+          bus.publish("mapd", unacked_done_metric);
+          bus.publish("mapd", *unacked_done);
+          done_last_sent_ms = mono_ms();
+          my_task.reset();
+          task_state = TaskState::Idle;
+          my_goal = my_pos;
+          return;
+        }
+        if (d.has("task")) {
+          adopt_task(d["task"], d["phase"].as_str());
+        } else {
+          // idle (or already-holding) responder absorbed the task
+          my_task.reset();
+          task_state = TaskState::Idle;
+          my_goal = my_pos;
         }
       } else if (type == "done_ack") {
         if (d["peer_id"].as_str() == my_id
@@ -418,12 +539,20 @@ int main(int argc, char** argv) {
                                                : std::next(it);
     while (pending_requests.size() > args.max_requests)
       pending_requests.erase(pending_requests.begin());
-    if (pending_goal_swap && now - pending_goal_swap->second > args.swap_timeout_ms)
-      pending_goal_swap.reset();
-    if (pending_rotation && now - pending_rotation->second > args.swap_timeout_ms)
-      pending_rotation.reset();
+    if (pending_swap && now - pending_swap->issued_ms > args.swap_timeout_ms)
+      pending_swap.reset();
 
     publish_position();
+
+    // A goal-only exchange from the wire (legacy goal_swap / rotation
+    // peers) can park us at a FOREIGN goal: pos == goal but our task's
+    // phase cell is elsewhere, and the `my_pos != my_goal` decision gate
+    // below would then skip forever (the exact freeze the task-exchange
+    // protocol removes).  Resume our own task instead of parking.
+    if (my_task && my_pos == my_goal) {
+      auto c = task_cell(current_phase());
+      if (c && *c != my_pos) my_goal = *c;
+    }
 
     // done retransmit: no ack yet (lost in an outage, or the ack itself
     // was lost) — re-publish on the retry cadence until acked
@@ -459,43 +588,20 @@ int main(int argc, char** argv) {
           my_pos = d.next;
           arrive_check();
           break;
-        case LocalDecision::Kind::WaitForGoalSwap: {
-          if (!pending_goal_swap) {
-            std::string req_id = my_id + "_" + std::to_string(unix_ms());
-            Json req;
-            req.set("type", "goal_swap_request")
-                .set("request_id", req_id)
-                .set("from_peer", my_id)
-                .set("to_peer", d.swap_peer)
-                .set("my_goal", point_json(grid, my_goal));
-            bus.publish("mapd", req);
-            pending_goal_swap = {req_id, now};
-          }
+        case LocalDecision::Kind::WaitForGoalSwap:
+          // Rule 3: the blocker is parked on its goal — exchange with it.
+          request_task_swap(d.swap_peer, now);
           break;
-        }
-        case LocalDecision::Kind::WaitForRotation: {
-          if (!pending_rotation) {
-            std::string req_id = my_id + "_" + std::to_string(unix_ms());
-            Json req;
-            Json parts, goals;
-            for (size_t i = 0; i < d.participants.size(); ++i) {
-              parts.push_back(Json(d.participants[i]));
-              goals.push_back(point_json(grid, d.goals[i]));
-            }
-            req.set("type", "target_rotation_request")
-                .set("request_id", req_id)
-                .set("initiator", my_id)
-                .set("participants", parts)
-                .set("goals", goals);
-            bus.publish("mapd", req);
-            pending_rotation = {req_id, now};
-            // The bus never echoes a publish back to its sender, so apply
-            // our own rotation locally: as participants[0] we take the next
-            // participant's goal, exactly as receivers do.
-            if (d.goals.size() > 1) my_goal = d.goals[1];
-          }
+        case LocalDecision::Kind::WaitForRotation:
+          // Deadlock chain: exchange with the IMMEDIATE blocker
+          // (participants[0] is us).  Pairwise exchanges repeated over
+          // ticks unwind the chain the way sequential Rule 4's backward
+          // goal rotation does — composed of adjacent transpositions —
+          // while keeping every task attached to a live holder (a bare
+          // goal rotation strands k tasks pointing at foreign goals).
+          if (d.participants.size() > 1)
+            request_task_swap(d.participants[1], now);
           break;
-        }
         case LocalDecision::Kind::Wait:
           break;
       }

@@ -111,6 +111,12 @@ int main(int argc, char** argv) {
       if (pfds[k].revents & (POLLERR | POLLHUP)) {
         ok = false;
         why = "pollerr/hup";
+        // poll() sets no errno for revents; fetch the socket's own error
+        // so the drop diagnostic doesn't print a stale one
+        int soerr = 0;
+        socklen_t slen = sizeof(soerr);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+        errno = soerr;
       }
       if (ok && (pfds[k].revents & POLLIN)) {
         ok = c.conn.on_readable();

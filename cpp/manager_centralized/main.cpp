@@ -317,7 +317,10 @@ int main(int argc, char** argv) {
         if (!donated[k] && a.task) {
           // the receiver's own task was claimed by NOBODY (its new goal
           // came from a push-extension coincidence): never drop a live
-          // task — back onto the pending queue it goes
+          // task — back onto the pending queue it goes.  The agent must
+          // also hear task_withdrawn, or its live stale copy could
+          // positionally double-done the re-dispatched task.
+          withdraw(ids[k], *a.task);
           requeue_task(ids[k], a, "exchange displaced");
         }
         a.task = incoming[k].task;
@@ -443,14 +446,11 @@ int main(int argc, char** argv) {
       // only for agents whose goal is unchanged since — a completion or
       // fresh assignment in flight must not fabricate a phantom exchange
       auto sg = sent_goals.find(peer);
-      Cell base = (sg != sent_goals.end()
-                   && sg->second == it->second.goal)
-                      ? sg->second
-                      : it->second.goal;
-      old_goals.push_back(base);
+      const bool unchanged = sg != sent_goals.end()
+                             && sg->second == it->second.goal;
+      old_goals.push_back(it->second.goal);
       auto ng = parse_point(mv["goal"]);
-      new_goals.push_back(
-          ng && base == it->second.goal ? *ng : it->second.goal);
+      new_goals.push_back(ng && unchanged ? *ng : it->second.goal);
     }
     emit_moves(ids, next);
     // the daemon's returned post-swap goals re-assign tasks exactly like

@@ -119,6 +119,15 @@ int main(int argc, char** argv) {
   // first done per task id may trigger the free-the-peer + refill path.
   std::set<long long> completed_ids;
   std::deque<long long> completed_order;
+  // In-flight task ledger: agents exchange tasks PEER TO PEER (a TSWAP
+  // goal exchange is a task re-assignment), so per-peer bookkeeping alone
+  // cannot tell a healthy exchange from a stranded task.  Heartbeats
+  // carry busy_task; the ledger records the last time ANY peer claimed
+  // each dispatched task, and the cleanup sweep re-queues tasks no one
+  // has claimed for agent_stale_ms (e.g. a swap_response lost in a bus
+  // outage stranding the handed-over task).
+  std::map<long long, Json> inflight;        // task_id -> bare Task JSON
+  std::map<long long, int64_t> last_claimed; // task_id -> last claim mono_ms
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
@@ -137,6 +146,8 @@ int main(int argc, char** argv) {
     peer_busy[peer] = t;
     busy_since[peer] = mono_ms();
     peer_last_seen.emplace(peer, mono_ms());  // monitor from dispatch
+    inflight[static_cast<long long>(id)] = t;
+    last_claimed[static_cast<long long>(id)] = mono_ms();
     bus.publish("mapd", t);
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
              peer.c_str());
@@ -247,6 +258,8 @@ int main(int argc, char** argv) {
       requeue.clear();
       completed_ids.clear();
       completed_order.clear();
+      inflight.clear();
+      last_claimed.clear();
       log_info("🔄 state reset\n");
     } else if (!cmd.empty()) {
       Json raw;  // unknown lines broadcast raw (ref :389-395)
@@ -309,16 +322,61 @@ int main(int argc, char** argv) {
             // own retransmit (and refuses this duplicate by task id).
             auto busy = peer_busy.find(peer);
             if (busy != peer_busy.end() && !d.has("busy_task")) {
-              int64_t now = mono_ms();
-              auto since = busy_since.find(peer);
-              if (since != busy_since.end()
-                  && now - since->second > task_resend_ms) {
-                log_info("↻ %s reports idle but task %lld is in flight; "
-                         "re-sending\n", peer.c_str(),
-                         static_cast<long long>(
-                             busy->second["task_id"].as_int()));
-                bus.publish("mapd", busy->second);
-                since->second = now;
+              const long long btid = busy->second["task_id"].as_int();
+              if (completed_ids.count(btid)) {
+                // someone ELSE completed this peer's task (peer-side
+                // exchange): never re-send a finished task — free the
+                // peer for fresh work instead
+                peer_busy.erase(busy);
+                busy_since.erase(peer);
+                if (subscribed_peers.count(peer)) send_task_to(peer);
+              } else {
+                int64_t now = mono_ms();
+                auto since = busy_since.find(peer);
+                if (since != busy_since.end()
+                    && now - since->second > task_resend_ms) {
+                  log_info("↻ %s reports idle but task %lld is in flight; "
+                           "re-sending\n", peer.c_str(), btid);
+                  bus.publish("mapd", busy->second);
+                  since->second = now;
+                }
+              }
+            } else if (d.has("busy_task")) {
+              // the heartbeat claims a task: refresh the ledger, and on
+              // an id MISMATCH believe the agent — tasks move between
+              // peers in exchanges the manager never arbitrates
+              const long long ctid = d["busy_task"].as_int();
+              auto inf = inflight.find(ctid);
+              if (inf != inflight.end()) {
+                last_claimed[ctid] = mono_ms();
+                // a queued requeue copy is now moot: its holder is alive
+                // (same race the done handler cancels for completions)
+                for (auto q = requeue.begin(); q != requeue.end(); ++q)
+                  if ((*q)["task_id"].as_int() == ctid) {
+                    log_info("♻️  task %lld re-claimed by %s; queued "
+                             "duplicate cancelled\n", ctid, peer.c_str());
+                    requeue.erase(q);
+                    break;
+                  }
+                if (busy == peer_busy.end()
+                    || busy->second["task_id"].as_int() != ctid) {
+                  log_info("🔁 %s now carries task %lld (peer-side "
+                           "exchange); bookkeeping follows\n",
+                           peer.c_str(), ctid);
+                  // the previous holder's entry is stale: drop it so the
+                  // idle-resend cannot hand the task back out twice
+                  for (auto b = peer_busy.begin(); b != peer_busy.end();)
+                    if (b->first != peer
+                        && b->second["task_id"].as_int() == ctid) {
+                      busy_since.erase(b->first);
+                      b = peer_busy.erase(b);
+                    } else {
+                      ++b;
+                    }
+                  peer_busy[peer] = inf->second;
+                  peer_busy[peer].set("peer_id", peer);
+                  busy_since[peer] = mono_ms();
+                }
               }
             }
           } else if (type == "occupied_request") {
@@ -383,6 +441,8 @@ int main(int argc, char** argv) {
             }
             completed_ids.insert(tid);
             completed_order.push_back(tid);
+            inflight.erase(tid);
+            last_claimed.erase(tid);
             if (completed_order.size() > 4096) {
               completed_ids.erase(completed_order.front());
               completed_order.pop_front();
@@ -478,6 +538,38 @@ int main(int argc, char** argv) {
         subscribed_peers.erase(peer);
         peer_positions.erase(peer);
         it = peer_last_seen.erase(it);
+      }
+      // Unclaimed-task sweep (runs AFTER the silence sweep so a mute
+      // peer's task is re-queued through the silence path first): a
+      // dispatched task that no heartbeat has claimed for agent_stale_ms
+      // has no live holder — e.g. its holder handed it over in an
+      // exchange whose swap_response died with the bus.  Re-queue it.
+      for (auto inf = inflight.begin(); inf != inflight.end();) {
+        const long long tid = inf->first;
+        if (completed_ids.count(tid)) {
+          last_claimed.erase(tid);
+          inf = inflight.erase(inf);
+          continue;
+        }
+        auto lc = last_claimed.find(tid);
+        const int64_t claimed_ms = lc == last_claimed.end() ? 0 : lc->second;
+        bool queued = false;
+        for (const auto& q : requeue)
+          queued = queued || q["task_id"].as_int() == tid;
+        if (!queued && now - claimed_ms > agent_stale_ms) {
+          log_info("♻️  task %lld unclaimed by any peer for %lld ms, "
+                   "re-queueing\n", tid,
+                   static_cast<long long>(now - claimed_ms));
+          requeue.push_back(inf->second);
+          for (auto b = peer_busy.begin(); b != peer_busy.end(); ++b)
+            if (b->second["task_id"].as_int() == tid) {
+              busy_since.erase(b->first);
+              peer_busy.erase(b);
+              break;
+            }
+          last_claimed[tid] = now;  // one shot per stale window
+        }
+        ++inf;
       }
       drain_requeue();
       // Cap enforcement evicts the chosen peer from ALL tracking maps at

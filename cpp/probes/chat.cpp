@@ -124,6 +124,15 @@ int main(int argc, char** argv) {
                 });
     if (!alive) break;
   }
+  // Drain buffered publishes before closing: a "/quit" arriving in the
+  // same stdin burst as the lines before it would otherwise race the
+  // nonblocking socket and drop those messages on the floor.
+  int64_t drain_deadline = mono_ms() + 1000;
+  while (bus.wants_write() && mono_ms() < drain_deadline) {
+    pollfd p{bus.fd(), POLLOUT, 0};
+    poll(&p, 1, 100);
+    if (!bus.flush()) break;
+  }
   log_info("chat: bye\n");
   bus.close();
   return 0;

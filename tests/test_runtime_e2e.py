@@ -267,9 +267,19 @@ def test_task_requeued_on_mute_agent(built, tiny_map, tmp_path):
         completed = _wait_for(initial_tasks_done, timeout=60, interval=2)
         log = manager_log.read_text(errors="ignore")
         victim.send_signal(sig.SIGCONT)  # let close() terminate it cleanly
+        if not completed:
+            # A sampled task endpoint can land on the cell the frozen body
+            # occupies (time-seeded RNG, 12x12 map) — physically
+            # unreachable until the victim resumes.  The property under
+            # test is that the task is re-queued and never LOST, so give
+            # the resumed fleet a grace period; exactly-once counting is
+            # still asserted via the CSV.
+            completed = _wait_for(initial_tasks_done, timeout=45, interval=2)
         fleet.quit()
-        assert "silent for" in log and "re-queueing" in log, log[-1500:]
-        assert completed, log[-1500:]
+        assert "silent for" in log and "re-queueing" in log, log[-4000:]
+        assert completed, log[-4000:] + "".join(
+            "\n== " + f.name + " ==\n" + f.read_text(errors="ignore")[-1500:]
+            for f in sorted(log_dir.glob("agent_*.log")))
 
 
 def test_tpu_solver_failover_to_native(built, tiny_map, tmp_path):
@@ -371,7 +381,7 @@ def test_chat_probe_broadcasts(built):
             timeout=15), a_lines
         a.stdin.write("hello from alice\n/post status update\n/quit\n")
         a.stdin.flush()
-        time.sleep(1.0)
+        time.sleep(2.0)  # bob must drain the relay before his own /quit
         b.stdin.write("/quit\n")
         b.stdin.flush()
         out_b = b.communicate(timeout=10)[0]
@@ -422,15 +432,9 @@ def test_corridor_head_on_exchanges_complete(built, tmp_path):
             fleet.command("tasks 2")
             time.sleep(3)
 
-        def completions():
-            fleet.command(f"save {csv}")
-            time.sleep(0.5)
-            if not csv.exists():
-                return 0
-            return sum(1 for r in csv.read_text().splitlines()[1:]
-                       if r.endswith(",completed"))
-
-        done = completions()
+        fleet.command(f"save {csv}")
+        time.sleep(0.5)
+        done = _count_completed(csv)
         mgr = (log_dir / "manager.log").read_text(errors="ignore")
         fleet.quit()
         # a single head-on livelock caps completions near zero; healthy
@@ -438,6 +442,43 @@ def test_corridor_head_on_exchanges_complete(built, tmp_path):
         assert done >= 6, (
             f"only {done} completions in 60s on the corridor — head-on "
             "encounters are stalling:\n" + mgr[-1500:])
+
+
+def test_corridor_head_on_decentralized_task_exchange(built, tmp_path):
+    """Deadlock regression (round 5, decentralized twin of the corridor
+    test): two decentralized agents meeting head-on used to exchange
+    GOALS (goal_swap / target_rotation) while their tasks stayed put —
+    each then walked to the other's goal and froze there forever,
+    because phase transitions are positional against the task's own
+    cells and the decision tick skips when pos == goal (observed live in
+    the bus-restart flake: both agents heartbeating, zero arrivals).
+    Exchanges now ride swap_request/swap_response carrying task+phase,
+    so the task follows the heading and the corridor fleet keeps
+    completing tasks through every encounter."""
+    corridor = tmp_path / "corridor.map.txt"
+    corridor.write_text("." * 10 + "\n")
+    log_dir = tmp_path / "logs"
+    csv = tmp_path / "task_metrics.csv"
+    with Fleet("decentralized", num_agents=2, port=_free_port(),
+               map_file=str(corridor), log_dir=str(log_dir)) as fleet:
+        time.sleep(3)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            fleet.command("tasks 2")
+            time.sleep(3)
+
+        fleet.command(f"save {csv}")
+        time.sleep(0.5)
+        done = _count_completed(csv)
+        mgr = (log_dir / "manager.log").read_text(errors="ignore")
+        fleet.quit()
+        assert done >= 6, (
+            f"only {done} completions in 60s on the decentralized "
+            "corridor — head-on exchanges are stranding tasks:\n"
+            + mgr[-2500:] + "".join(
+                "\n== " + f.name + " ==\n"
+                + f.read_text(errors="ignore")[-1200:]
+                for f in sorted(log_dir.glob("agent_*.log"))))
 
 
 @pytest.mark.parametrize("mode", ["decentralized", "centralized"])
@@ -491,7 +532,8 @@ def test_fleet_survives_bus_restart(built, tiny_map, tmp_path, mode):
             fleet.quit()
             assert completed, (
                 "no task completions after bus restart: " + "".join(
-                    f.read_text(errors="ignore")[-400:]
+                    "\n== " + f.name + " ==\n"
+                    + f.read_text(errors="ignore")[-2500:]
                     for f in sorted(log_dir.glob("*.log"))))
         finally:
             if new_bus is not None:
