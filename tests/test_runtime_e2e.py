@@ -6,6 +6,7 @@ nothing and pass/fail is human-judged).  A tiny 12x12 map keeps journeys a
 few cells long so tasks complete within CI time at the faithful 500 ms tick.
 """
 
+import json
 import shutil
 import socket
 import subprocess
@@ -479,6 +480,84 @@ def test_corridor_head_on_decentralized_task_exchange(built, tmp_path):
                 "\n== " + f.name + " ==\n"
                 + f.read_text(errors="ignore")[-1200:]
                 for f in sorted(log_dir.glob("agent_*.log"))))
+
+
+def test_legacy_goal_swap_cannot_strand_agent(built, tiny_map, tmp_path):
+    """Legacy-wire compat (round 5): our agents coordinate exchanges via
+    swap_request (task+phase), but a FOREIGN peer speaking the
+    reference's goal_swap wire can still move our agent's goal without
+    its task.  The agent must answer protocol-correctly (response nested
+    under a "data" STRING, the reference's wire quirk) and must NOT
+    freeze parked at the foreign goal: the decision loop's resume guard
+    re-targets the agent's own task, so the task still completes."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    with Fleet("decentralized", num_agents=1, port=port, map_file=tiny_map,
+               log_dir=str(log_dir)) as fleet:
+        time.sleep(3.5)
+        legacy = BusClient(port=port, peer_id="legacy-peer")
+        legacy.subscribe("mapd")
+        fleet.command("tasks 1")
+
+        # learn the agent's id and task from the bare Task broadcast
+        agent_id = task_id = None
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and agent_id is None:
+            f = legacy.recv(timeout=2.0)
+            if not f or f.get("op") != "msg":
+                continue
+            d = f.get("data") or {}
+            if "pickup" in d and "delivery" in d:
+                agent_id, task_id = d["peer_id"], d["task_id"]
+        assert agent_id, "no Task broadcast observed"
+        time.sleep(1.5)  # let the agent start walking
+
+        legacy.publish("mapd", {
+            "type": "goal_swap_request",
+            "request_id": "legacy-1",
+            "from_peer": "legacy-peer",
+            "to_peer": agent_id,
+            "my_goal": [11, 11],  # far corner: a goal with no task behind it
+        })
+
+        # three observable stages, in order: the swap is answered, the
+        # agent's broadcast goal actually becomes the foreign cell (the
+        # displacement happened — otherwise the resume guard under test
+        # is never exercised), and a task completes AFTER that (the
+        # manager's closed loop keeps tasks flowing, so a frozen agent
+        # would produce no further completions).
+        swap_answered = goal_moved = completed_after = False
+        deadline = time.monotonic() + 75
+        while (time.monotonic() < deadline
+               and not (swap_answered and goal_moved and completed_after)):
+            f = legacy.recv(timeout=2.0)
+            if not f or f.get("op") != "msg":
+                continue
+            d = f.get("data") or {}
+            if d.get("type") == "goal_swap_response":
+                inner = json.loads(d["data"])  # nested-string wire quirk
+                if inner.get("to_peer") == "legacy-peer":
+                    assert inner.get("accepted") is True
+                    swap_answered = True
+            elif (d.get("type") == "position"
+                    and d.get("peer_id") == agent_id
+                    and d.get("goal") == [11, 11]):
+                goal_moved = True
+            elif d.get("type") == "task_metric_completed" and goal_moved:
+                completed_after = True
+        legacy.close()
+        fleet.quit()
+        agent_log = "".join(f.read_text(errors="ignore")
+                            for f in sorted(log_dir.glob("agent_*.log")))
+        assert swap_answered, "goal_swap_request was not answered"
+        assert goal_moved, (
+            "agent never adopted the foreign goal — the legacy swap was "
+            "silently ignored:\n" + agent_log[-2000:])
+        assert completed_after, (
+            "no task completed after the legacy goal displacement — the "
+            "agent froze at the foreign goal:\n" + agent_log[-2000:])
 
 
 @pytest.mark.parametrize("mode", ["decentralized", "centralized"])
