@@ -50,6 +50,16 @@ int main(int argc, char** argv) {
   // agents on other hosts can reach the hub (RUN_INSTRUCTIONS cross-host)
   const std::string bind_addr =
       knobs.get_str("--bind", "MAPD_BUS_BIND", "127.0.0.1");
+  // Fault injection for protocol tests: silently drop the first
+  // `drop_count` published frames whose data `type` equals `drop_type`
+  // (e.g. sever the swap_response of a task exchange to prove the
+  // manager's unclaimed-task sweep rescues the stranded task).  The bus
+  // is a deliberately lossy medium — this makes a SPECIFIC loss
+  // reproducible instead of waiting for an outage race.
+  const std::string drop_type =
+      knobs.get_str("--drop-type", "MAPD_BUS_DROP_TYPE", "");
+  int64_t drop_left = knobs.get_int("--drop-count", "MAPD_BUS_DROP_COUNT",
+                                    drop_type.empty() ? 0 : 1);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -146,6 +156,14 @@ int main(int argc, char** argv) {
           c.topics.erase(j["topic"].as_str());
         } else if (op == "pub") {
           const std::string& topic = j["topic"].as_str();
+          if (drop_left > 0 && !drop_type.empty()
+              && j["data"]["type"].as_str() == drop_type) {
+            --drop_left;
+            log_warn("💉 fault injection: dropped %s frame from %s "
+                     "(%lld more)\n", drop_type.c_str(), c.peer_id.c_str(),
+                     static_cast<long long>(drop_left));
+            continue;
+          }
           Json msg;
           msg.set("op", "msg")
               .set("topic", topic)

@@ -7,6 +7,7 @@ few cells long so tasks complete within CI time at the faithful 500 ms tick.
 """
 
 import json
+import os
 import shutil
 import socket
 import subprocess
@@ -480,6 +481,159 @@ def test_corridor_head_on_decentralized_task_exchange(built, tmp_path):
                 "\n== " + f.name + " ==\n"
                 + f.read_text(errors="ignore")[-1200:]
                 for f in sorted(log_dir.glob("agent_*.log"))))
+
+
+def test_unclaimed_task_sweep_rescues_stranded_task(built, tiny_map,
+                                                    tmp_path):
+    """The in-flight ledger's sweep, triggered deterministically: two
+    scripted bus peers under the real manager.  Peer 1 heartbeats a
+    claim for peer 2's task — the aftermath of a peer-side exchange
+    whose other half was lost — so peer 1's OWN task is claimed by
+    nobody.  The manager must move bookkeeping to follow the claims,
+    re-queue the unclaimed task after agent_stale_ms, re-dispatch it,
+    and count every task exactly once."""
+    from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+    log_dir = tmp_path / "logs"
+    csv = tmp_path / "task_metrics.csv"
+    port = _free_port()
+    cfg = RuntimeConfig(agent_stale_ms=4000, cleanup_interval_ms=1000)
+    with Fleet("decentralized", num_agents=0, port=port, map_file=tiny_map,
+               log_dir=str(log_dir), config=cfg) as fleet:
+        time.sleep(1.5)
+        p1 = BusClient(port=port, peer_id="py-agent-1")
+        p2 = BusClient(port=port, peer_id="py-agent-2")
+        for c in (p1, p2):
+            c.subscribe("mapd")
+        time.sleep(1.0)  # peer_joined reaches the manager
+        fleet.command("tasks 2")
+
+        tasks = {}       # peer_id -> first task id assigned by the manager
+        deliveries = {}  # task id -> times a bare Task for it was received
+        rescued = None   # id of the re-dispatched (swept) task
+        t_end = time.monotonic() + 25
+        last_beat = 0.0
+        while time.monotonic() < t_end:
+            now = time.monotonic()
+            if now - last_beat >= 0.5:
+                last_beat = now
+                for cli, pos in ((p1, [1, 1]), (p2, [2, 2])):
+                    beat = {"type": "position_update",
+                            "peer_id": cli.peer_id, "position": pos}
+                    # p1 falsely claims p2's task (severed-exchange
+                    # aftermath); p2 claims its own honestly
+                    if tasks.get("py-agent-2") is not None:
+                        beat["busy_task"] = tasks["py-agent-2"]
+                    cli.publish("mapd", beat)
+            for cli in (p1, p2):
+                f = cli.recv(timeout=0.1)
+                if not f or f.get("op") != "msg":
+                    continue
+                d = f.get("data") or {}
+                if "pickup" in d and d.get("peer_id") == cli.peer_id:
+                    tid = d["task_id"]
+                    deliveries[tid] = deliveries.get(tid, 0) + 1
+                    if cli.peer_id not in tasks:
+                        tasks[cli.peer_id] = tid
+                    elif (tid == tasks.get("py-agent-1")
+                            and deliveries[tid] >= 2):
+                        # SECOND delivery of the stranded task: the sweep
+                        # re-dispatched it — complete it now
+                        rescued = tid
+                        cli.publish("mapd", {
+                            "type": "task_metric_completed",
+                            "task_id": tid, "peer_id": cli.peer_id,
+                            "timestamp_ms": int(time.time() * 1000)})
+                        cli.publish("mapd",
+                                    {"status": "done", "task_id": tid})
+            if rescued is not None:
+                break
+        # peer 2 finishes its own task so both count exactly once
+        if tasks.get("py-agent-2") is not None:
+            p2.publish("mapd", {
+                "type": "task_metric_completed",
+                "task_id": tasks["py-agent-2"], "peer_id": "py-agent-2",
+                "timestamp_ms": int(time.time() * 1000)})
+            p2.publish("mapd",
+                       {"status": "done", "task_id": tasks["py-agent-2"]})
+        time.sleep(1.0)
+        fleet.command(f"save {csv}")
+        time.sleep(0.5)
+        log = (log_dir / "manager.log").read_text(errors="ignore")
+        p1.close()
+        p2.close()
+        fleet.quit()
+        assert len(tasks) == 2, f"dispatch incomplete: {tasks}, log:\n" \
+            + log[-2000:]
+        assert "unclaimed by any peer" in log, log[-3000:]
+        assert rescued == tasks["py-agent-1"], (
+            f"stranded task {tasks['py-agent-1']} was never re-dispatched:\n"
+            + log[-3000:])
+        done_rows = [int(r.split(",")[0])
+                     for r in csv.read_text().splitlines()[1:]
+                     if r.endswith(",completed")]
+        assert set(tasks.values()) <= set(done_rows), (csv.read_text(),
+                                                       log[-2000:])
+        # exactly once: one completed row per task, no double count of
+        # the re-dispatched copy
+        assert len(done_rows) == len(set(done_rows)), csv.read_text()
+
+
+def test_bus_fault_injection_drops_one_frame(built, tmp_path):
+    """The busd --drop-type knob severs exactly the first matching frame:
+    with MAPD_BUS_DROP_TYPE=chat, alice's first chat line never reaches
+    bob but her second does — reproducible loss for protocol tests."""
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    port = _free_port()
+    env = dict(os.environ, MAPD_BUS_DROP_TYPE="chat",
+               MAPD_BUS_DROP_COUNT="1")
+    bus_log = open(tmp_path / "bus.log", "w")
+    bus = subprocess.Popen([str(BUILD_DIR / "mapd_bus"), str(port)],
+                           stdout=bus_log, stderr=subprocess.STDOUT,
+                           env=env)
+    a = b = None
+    try:
+        time.sleep(0.3)
+        import threading
+        b = subprocess.Popen(
+            [str(BUILD_DIR / "mapd_chat"), "--port", str(port),
+             "--name", "bob"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        b_lines = []
+        threading.Thread(target=lambda: [b_lines.append(l)
+                                         for l in b.stdout],
+                         daemon=True).start()
+        assert _wait_for(
+            lambda: any("chat probe bob" in l for l in b_lines),
+            timeout=15), b_lines
+        time.sleep(0.3)
+        a = subprocess.Popen(
+            [str(BUILD_DIR / "mapd_chat"), "--port", str(port),
+             "--name", "alice"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        assert _wait_for(
+            lambda: any("peer joined:" in l for l in b_lines),
+            timeout=15), b_lines
+        a.stdin.write("dropped line\nsurviving line\n/quit\n")
+        a.stdin.flush()
+        assert _wait_for(
+            lambda: any("surviving line" in l for l in b_lines),
+            timeout=15), b_lines
+        assert not any("dropped line" in l for l in b_lines), b_lines
+        b.stdin.write("/quit\n")
+        b.stdin.flush()
+        b.wait(timeout=10)
+        a.wait(timeout=10)
+    finally:
+        for p in (a, b):
+            if p is not None and p.poll() is None:
+                p.kill()
+        bus.terminate()
+        bus_log.close()
+    log = (tmp_path / "bus.log").read_text(errors="ignore")
+    assert "fault injection: dropped chat frame" in log, log[-1000:]
 
 
 def test_legacy_goal_swap_cannot_strand_agent(built, tiny_map, tmp_path):
