@@ -1,0 +1,258 @@
+"""Merged host+solver trace report (obs/ tentpole).
+
+Consumes Chrome trace-event JSONL written by the Python tracer
+(p2p_distributed_tswap_tpu/obs/trace.py) and the C++ tracer
+(cpp/common/trace.hpp) — any mix of files, typically one per process of a
+fleet — and prints:
+
+1. per-span latency table: count, p50/p95/p99/max milliseconds, total;
+2. the tick-budget breakdown: mean per-phase cost inside each tick span
+   (``solverd.tick``, ``manager.plan_tick``) against the 500 ms planning
+   tick, including the untraced remainder, plus over-budget tick counts;
+3. final counter values per process (Chrome "C" events);
+4. optionally (--perfetto OUT.json) one merged ``{"traceEvents": [...]}``
+   file that https://ui.perfetto.dev opens directly — the per-process
+   wall-clock anchors make the timelines interleave at ~ms alignment.
+
+Usage:
+    python analysis/trace_report.py [FILE_OR_DIR ...]
+        [--budget-ms 500] [--perfetto merged.json]
+
+With no paths, reads every *.trace.jsonl under $JG_TRACE_DIR
+(default results/trace).  Heartbeat sidecars (*.heartbeat.jsonl) found
+next to trace files contribute the over-budget tick summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# the centralized manager's planning tick (ref manager.rs:567)
+DEFAULT_BUDGET_MS = 500.0
+# top-level per-tick spans whose children form the budget breakdown
+TICK_SPANS = ("solverd.tick", "manager.plan_tick")
+
+
+def _discover(paths: List[str]) -> Tuple[List[str], List[str]]:
+    """Expand args (files or dirs) into (trace_files, heartbeat_files)."""
+    if not paths:
+        paths = [os.environ.get("JG_TRACE_DIR", "results/trace")]
+    traces, beats = [], []
+    for p in paths:
+        if os.path.isdir(p):
+            traces += sorted(glob.glob(os.path.join(p, "*.trace.jsonl")))
+            beats += sorted(glob.glob(os.path.join(p, "*.heartbeat.jsonl")))
+        elif p.endswith(".heartbeat.jsonl"):
+            beats.append(p)
+        else:
+            traces.append(p)
+    return traces, beats
+
+
+def load_events(trace_files: List[str]) -> List[dict]:
+    """All parseable event objects from the given JSONL files (bad lines —
+    e.g. a truncated final line from a killed process — are skipped)."""
+    events = []
+    for path in trace_files:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(ev, dict) and "ph" in ev:
+                        events.append(ev)
+        except OSError as e:
+            print(f"⚠️ cannot read {path}: {e}", file=sys.stderr)
+    return events
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[k]
+
+
+def build_report(events: List[dict],
+                 budget_ms: float = DEFAULT_BUDGET_MS) -> dict:
+    """Fold events into the report structure (testable, print-free)."""
+    proc_names: Dict[int, str] = {}
+    spans: Dict[str, List[float]] = defaultdict(list)  # name -> durs (ms)
+    counters: Dict[Tuple[str, str], int] = {}
+    ticks: Dict[str, List[dict]] = defaultdict(list)
+    children: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: defaultdict(float))  # tick span -> child name -> total ms
+
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M" and ev.get("name") == "process_name":
+            proc_names[ev.get("pid", 0)] = ev.get("args", {}).get("name", "?")
+        elif ph == "X":
+            name = ev.get("name", "?")
+            dur_ms = ev.get("dur", 0) / 1000.0
+            spans[name].append(dur_ms)
+            parent = ev.get("args", {}).get("parent")
+            if name in TICK_SPANS:
+                ticks[name].append(ev)
+            elif parent in TICK_SPANS:
+                children[parent][name] += dur_ms
+        elif ph == "C":
+            proc = proc_names.get(ev.get("pid", 0), str(ev.get("pid", "?")))
+            # last value wins: flushes append cumulative snapshots
+            counters[(proc, ev.get("name", "?"))] = \
+                ev.get("args", {}).get("value", 0)
+
+    span_stats = {}
+    for name, durs in spans.items():
+        s = sorted(durs)
+        span_stats[name] = {
+            "count": len(s), "p50_ms": round(_pct(s, 0.50), 3),
+            "p95_ms": round(_pct(s, 0.95), 3),
+            "p99_ms": round(_pct(s, 0.99), 3),
+            "max_ms": round(s[-1], 3), "total_ms": round(sum(s), 3),
+        }
+
+    budget = {}
+    for tick_name, tick_evs in ticks.items():
+        durs = sorted(ev.get("dur", 0) / 1000.0 for ev in tick_evs)
+        n = len(durs)
+        phases = {}
+        for child, total in sorted(children[tick_name].items(),
+                                   key=lambda kv: -kv[1]):
+            mean = total / n if n else 0.0
+            phases[child] = {"mean_ms": round(mean, 3),
+                             "pct_of_budget": round(100 * mean / budget_ms, 1)}
+        mean_tick = sum(durs) / n if n else 0.0
+        traced = sum(v["mean_ms"] for v in phases.values())
+        budget[tick_name] = {
+            "ticks": n,
+            "mean_ms": round(mean_tick, 3),
+            "p50_ms": round(_pct(durs, 0.50), 3),
+            "p95_ms": round(_pct(durs, 0.95), 3),
+            "p99_ms": round(_pct(durs, 0.99), 3),
+            "budget_ms": budget_ms,
+            "over_budget_ticks": sum(1 for d in durs if d > budget_ms),
+            "phases": phases,
+            "untraced_ms": round(max(0.0, mean_tick - traced), 3),
+        }
+
+    by_proc: Dict[str, Dict[str, int]] = defaultdict(dict)
+    for (proc, name), v in sorted(counters.items()):
+        by_proc[proc][name] = v
+    return {"processes": sorted(proc_names.values()),
+            "spans": span_stats, "budget": budget,
+            "counters": dict(by_proc)}
+
+
+def load_heartbeats(beat_files: List[str]) -> Optional[dict]:
+    total = over = 0
+    worst = 0.0
+    for path in beat_files:
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        hb = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    total += 1
+                    if hb.get("over_budget"):
+                        over += 1
+                    worst = max(worst, hb.get("ms", {}).get("total", 0.0))
+        except OSError:
+            continue
+    if not total:
+        return None
+    return {"ticks": total, "over_budget": over, "worst_ms": round(worst, 3)}
+
+
+def print_report(report: dict, heartbeats: Optional[dict] = None) -> None:
+    if report["processes"]:
+        print(f"processes: {', '.join(report['processes'])}")
+    print()
+    print("| span | count | p50 ms | p95 ms | p99 ms | max ms | total ms |")
+    print("|---|---|---|---|---|---|---|")
+    for name, s in sorted(report["spans"].items(),
+                          key=lambda kv: -kv[1]["total_ms"]):
+        print(f"| {name} | {s['count']} | {s['p50_ms']} | {s['p95_ms']} "
+              f"| {s['p99_ms']} | {s['max_ms']} | {s['total_ms']} |")
+
+    for tick_name, b in report["budget"].items():
+        print()
+        print(f"## tick budget — {tick_name} "
+              f"({b['ticks']} ticks vs {b['budget_ms']:.0f} ms budget)")
+        print(f"mean {b['mean_ms']} ms, p50 {b['p50_ms']} / "
+              f"p95 {b['p95_ms']} / p99 {b['p99_ms']} ms; "
+              f"{b['over_budget_ticks']} tick(s) over budget")
+        print()
+        print("| phase | mean ms/tick | % of budget |")
+        print("|---|---|---|")
+        for child, v in b["phases"].items():
+            print(f"| {child} | {v['mean_ms']} | {v['pct_of_budget']}% |")
+        print(f"| (untraced remainder) | {b['untraced_ms']} | "
+              f"{round(100 * b['untraced_ms'] / b['budget_ms'], 1)}% |")
+
+    if heartbeats:
+        print()
+        print(f"heartbeats: {heartbeats['ticks']} ticks, "
+              f"{heartbeats['over_budget']} over budget, "
+              f"worst {heartbeats['worst_ms']} ms")
+
+    if report["counters"]:
+        print()
+        print("## counters")
+        for proc, cs in report["counters"].items():
+            for name, v in cs.items():
+                print(f"{proc}: {name} = {v}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="*",
+                    help="trace JSONL files or directories "
+                         "(default: $JG_TRACE_DIR or results/trace)")
+    ap.add_argument("--budget-ms", type=float, default=DEFAULT_BUDGET_MS)
+    ap.add_argument("--perfetto", default=None, metavar="OUT.json",
+                    help="also write one merged traceEvents JSON for "
+                         "ui.perfetto.dev")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as one JSON object instead of "
+                         "markdown tables")
+    args = ap.parse_args(argv)
+
+    traces, beats = _discover(args.paths)
+    if not traces:
+        print("no *.trace.jsonl found (is JG_TRACE=1 set on the fleet?)",
+              file=sys.stderr)
+        return 1
+    events = load_events(traces)
+    if not events:
+        print("trace files contained no events", file=sys.stderr)
+        return 1
+    report = build_report(events, budget_ms=args.budget_ms)
+    if args.perfetto:
+        Path(args.perfetto).write_text(json.dumps({"traceEvents": events}))
+        print(f"merged perfetto trace: {args.perfetto} "
+              f"({len(events)} events from {len(traces)} file(s))",
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print_report(report, load_heartbeats(beats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,8 @@ import subprocess
 import sys
 import time
 
+from p2p_distributed_tswap_tpu.obs import trace
+
 REFERENCE_STEP_MS = 180.0   # ~50 agents, 100x100 (BASELINE.md)
 TARGET_STEP_MS = 1000.0     # north-star budget at scale (BASELINE.md)
 
@@ -232,12 +234,16 @@ def makespan_bounds(grid, starts, tasks, cfg):
     return lb, est
 
 
-def bench_full_solve(scn, seed: int = 0, built=None):
+def bench_full_solve(scn, seed: int = 0, built=None, measure_only=False):
     """Full MAPD solve; ms/step averaged over the whole run.  The recorded
     paths are then certified host-side (_verify_paths).  Completion and
     per-transition legality are reported SEPARATELY: a horizon-exhausted
     but perfectly legal run must be attributable as "did not finish", not
-    disguised as a collision (ADVICE r3)."""
+    disguised as a collision (ADVICE r3).
+
+    ``measure_only`` (the trace-off overhead re-measure) skips the warm run
+    (the program is already compiled and warm from the primary measurement)
+    and the host-side path verification — only ms/step is consumed."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -247,14 +253,19 @@ def bench_full_solve(scn, seed: int = 0, built=None):
     grid, starts, tasks, cfg = built or scn.build(seed=seed)
     args = (cfg, jnp.asarray(starts, jnp.int32), jnp.asarray(tasks, jnp.int32),
             jnp.asarray(grid.free))
-    final = mapd._run_mapd_jit(*args)     # compile + warm run
-    jax.block_until_ready(final)
-    t0 = time.perf_counter()
-    final = mapd._run_mapd_jit(*args)
-    jax.block_until_ready(final)
-    elapsed = time.perf_counter() - t0
+    if not measure_only:
+        with trace.span("bench.compile_and_warm"):
+            final = mapd._run_mapd_jit(*args)     # compile + warm run
+            jax.block_until_ready(final)
+    with trace.span("bench.measure_full_solve"):
+        t0 = time.perf_counter()
+        final = mapd._run_mapd_jit(*args)
+        jax.block_until_ready(final)
+        elapsed = time.perf_counter() - t0
     steps = int(final.t)
     assert steps > 0
+    if measure_only:
+        return 1000.0 * elapsed / steps, steps, None, None
     completed = bool(np.asarray(final.task_used).all()) and \
         steps <= cfg.max_timesteps
     inv_ok = _verify_paths(cfg, grid, np.asarray(final.paths_pos[:steps]))
@@ -308,20 +319,23 @@ def bench_step_window(scn, seed: int = 0, no_full: bool = False, built=None):
         return jax.jit(functools.partial(mapd.prepare_state, cfg))(
             starts_j, tasks_in, free_j)
 
-    s, tasks_j = prepare(tasks_j)
+    with trace.span("bench.prepare"):
+        s, tasks_j = prepare(tasks_j)
     # invariant fold rides the warmup steps (and the completion run below),
     # NEVER the timed window — certification without distorting ms/step
     ok = jnp.bool_(True)
-    for _ in range(WARMUP_STEPS):
-        prev = s.pos
-        s = step(s, tasks_j, free_j)
-        ok = ok & check(prev, s.pos, free_j)
-    int(s.t)  # force: block_until_ready does not reliably block on axon
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        s = step(s, tasks_j, free_j)
-    int(s.t)
-    elapsed = time.perf_counter() - t0
+    with trace.span("bench.warmup", steps=WARMUP_STEPS):
+        for _ in range(WARMUP_STEPS):
+            prev = s.pos
+            s = step(s, tasks_j, free_j)
+            ok = ok & check(prev, s.pos, free_j)
+        int(s.t)  # force: block_until_ready does not reliably block on axon
+    with trace.span("bench.measure_step_window", steps=MEASURE_STEPS):
+        t0 = time.perf_counter()
+        for _ in range(MEASURE_STEPS):
+            s = step(s, tasks_j, free_j)
+        int(s.t)
+        elapsed = time.perf_counter() - t0
     makespan = None
     full = os.environ.get("BENCH_FULL", "1") != "0" and not no_full
     if full:
@@ -365,16 +379,42 @@ def run_rung(name: str, seed: int = 0) -> dict:
     built = scn.build(seed=seed)  # one build serves measurement, LB and label
     grid = built[0]
     stepwise = os.environ.get("BENCH_STEPWISE") == "1"
-    if name in FULL_SOLVE and not stepwise:
-        ms, steps, completed, inv_ok = bench_full_solve(scn, built=built)
-        makespan = steps if completed else None
-        measure = "full-solve"
-    else:
-        ms, makespan, completed, inv_ok = bench_step_window(
-            scn, no_full=name in NO_FULL, built=built)
-        if not completed:
-            makespan = None
-        measure = "step-window"
+    with trace.span("bench.rung", rung=name, seed=seed):
+        if name in FULL_SOLVE and not stepwise:
+            ms, steps, completed, inv_ok = bench_full_solve(scn, built=built)
+            makespan = steps if completed else None
+            measure = "full-solve"
+        else:
+            ms, makespan, completed, inv_ok = bench_step_window(
+                scn, no_full=name in NO_FULL, built=built)
+            if not completed:
+                makespan = None
+            measure = "step-window"
+    # Tracing opt-in (JG_TRACE=1): re-measure with the tracer forced off so
+    # the rung record carries the trace-on vs trace-off step-time delta —
+    # instrumentation overhead regressions show up in the BENCH trajectory
+    # instead of masquerading as solver slowdowns.  The trace itself lands
+    # next to the BENCH artifacts ($BENCH_TRACE_DIR, default the JG_TRACE
+    # dir).  Only the measured window matters for the delta: warm runs,
+    # path verification, and completion passes are all skipped
+    # (measure_only / no_full).
+    trace_extra = {}
+    if trace.enabled():
+        with trace.disabled():
+            if measure == "full-solve":
+                ms_off = bench_full_solve(scn, built=built,
+                                          measure_only=True)[0]
+            else:
+                ms_off = bench_step_window(scn, no_full=True, built=built)[0]
+        tdir = os.environ.get("BENCH_TRACE_DIR", trace.trace_dir())
+        tpath = trace.flush(os.path.join(
+            tdir, f"bench-{name}-s{seed}.trace.jsonl"))
+        trace_extra = {
+            "trace_off_ms_per_step": round(ms_off, 4),
+            "trace_overhead_pct": round(100.0 * (ms - ms_off) / ms_off, 2)
+            if ms_off else None,
+            "trace_file": tpath,
+        }
     # LB only when there is a makespan to ratio against: the BFS chunks are
     # real device work at the big grids (and a tunnel-fault risk at 4096^2)
     # — never spend them after a measurement that cannot use the bound.
@@ -402,6 +442,7 @@ def run_rung(name: str, seed: int = 0) -> dict:
         "mode": scn.mode,
         "measure": measure,
         "seed": seed,
+        **trace_extra,
     }
 
 
@@ -512,6 +553,7 @@ MULTISEED_RUNGS = {"ref", "medium", "flagship",
 
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
+        trace.configure(proc=f"bench-{sys.argv[2]}")
         # --seeds a,b,c runs every seed in THIS process (one compile);
         # --seed N is the single-seed spelling
         seeds = [0]

@@ -40,17 +40,21 @@ struct TaskMetric {
   std::optional<int64_t> completion_time;
   TaskStatus status = TaskStatus::Sent;
 
+  // Clamped to >= 0 like the Python twin: the subtracted stamps come from
+  // DIFFERENT peers' wall clocks (manager sent vs agent started/completed),
+  // and skew must not produce negative latencies.  The collector counts
+  // occurrences (clock_skew_events).
   std::optional<int64_t> total_time() const {
     if (!completion_time) return std::nullopt;
-    return *completion_time - sent_time;
+    return std::max<int64_t>(0, *completion_time - sent_time);
   }
   std::optional<int64_t> processing_time() const {
     if (!start_time || !completion_time) return std::nullopt;
-    return *completion_time - *start_time;
+    return std::max<int64_t>(0, *completion_time - *start_time);
   }
   std::optional<int64_t> startup_latency() const {
     if (!start_time) return std::nullopt;
-    return *start_time - sent_time;
+    return std::max<int64_t>(0, *start_time - sent_time);
   }
 };
 
@@ -91,6 +95,9 @@ struct TaskStatistics {
 class TaskMetricsCollector {
  public:
   std::map<uint64_t, TaskMetric> metrics;
+  // peer-clock-skew evidence (see TaskMetric derivation clamps)
+  uint64_t clock_skew_events = 0;
+  int64_t clock_skew_worst_ms = 0;
 
   void add_metric(TaskMetric m) { metrics[m.task_id] = std::move(m); }
 
@@ -98,6 +105,7 @@ class TaskMetricsCollector {
     auto it = metrics.find(id);
     if (it != metrics.end()) {
       it->second.received_time = at_ms;
+      note_skew(it->second.sent_time, at_ms);
       it->second.status = TaskStatus::Received;
     }
   }
@@ -105,6 +113,7 @@ class TaskMetricsCollector {
     auto it = metrics.find(id);
     if (it != metrics.end()) {
       it->second.start_time = at_ms;
+      note_skew(it->second.sent_time, at_ms);
       it->second.status = TaskStatus::Running;
     }
   }
@@ -112,6 +121,9 @@ class TaskMetricsCollector {
     auto it = metrics.find(id);
     if (it != metrics.end()) {
       it->second.completion_time = at_ms;
+      note_skew(it->second.start_time ? *it->second.start_time
+                                      : it->second.sent_time,
+                at_ms);
       it->second.status = TaskStatus::Completed;
     }
   }
@@ -119,7 +131,11 @@ class TaskMetricsCollector {
     auto it = metrics.find(id);
     if (it != metrics.end()) it->second.status = TaskStatus::Failed;
   }
-  void clear() { metrics.clear(); }
+  void clear() {
+    metrics.clear();
+    clock_skew_events = 0;
+    clock_skew_worst_ms = 0;
+  }
 
   TaskStatistics statistics() const {
     TaskStatistics s;
@@ -173,6 +189,14 @@ class TaskMetricsCollector {
           << '\n';
     }
     return out.str();
+  }
+
+ private:
+  void note_skew(int64_t earlier, int64_t later) {
+    if (later < earlier) {
+      ++clock_skew_events;
+      clock_skew_worst_ms = std::max(clock_skew_worst_ms, earlier - later);
+    }
   }
 };
 

@@ -1,0 +1,264 @@
+// Span tracer for the C++ host runtime — the native mirror of
+// p2p_distributed_tswap_tpu/obs/trace.py (one schema, one report tool:
+// analysis/trace_report.py merges both sides into one Perfetto timeline).
+//
+// Same contract as the Python side:
+//   - gated by JG_TRACE=1 (or the binary's --trace flag via trace_init);
+//     disabled, a Span is one bool check — no clock read, no lock;
+//   - monotonic durations on a wall-clock anchor, so events from this
+//     process interleave with solverd's at ~ms alignment;
+//   - bounded ring buffer (newest TRACE_CAPACITY events kept);
+//   - counters exported as Chrome "C" events on flush;
+//   - flush appends Chrome trace-event JSONL to
+//     $JG_TRACE_DIR/<proc>-<pid>.trace.jsonl (default results/trace/),
+//     and runs automatically at process exit.
+//
+// Spans nest lexically (RAII); each event carries its parent span's name in
+// args.parent via a thread-local stack, matching the Python tracer.
+
+#pragma once
+
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace mapd {
+
+constexpr size_t TRACE_CAPACITY = 65536;
+
+struct TraceEvent {
+  std::string name;
+  char ph = 'X';       // 'X' complete span, 'i' instant, 'C' counter
+  int64_t ts_us = 0;   // wall-anchored microseconds
+  int64_t dur_us = 0;  // 'X' only
+  std::string parent;  // enclosing span name, "" at top level
+  std::string args_json;  // extra args as a JSON fragment ("\"k\":1"), or ""
+};
+
+class Tracer {
+ public:
+  static Tracer& instance() {
+    static Tracer t;
+    return t;
+  }
+
+  bool enabled() const { return enabled_; }
+
+  void init(const char* proc, bool force_on = false) {
+    proc_ = proc;
+    if (force_on) enabled_ = true;
+  }
+
+  int64_t now_us() const {
+    auto mono = std::chrono::steady_clock::now();
+    return anchor_us_ + std::chrono::duration_cast<std::chrono::microseconds>(
+                            mono - mono0_)
+                            .count();
+  }
+
+  void emit(TraceEvent ev) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (events_.size() >= TRACE_CAPACITY) events_.pop_front();
+    events_.push_back(std::move(ev));
+  }
+
+  void instant(const std::string& name, const std::string& args_json = "") {
+    if (!enabled_) return;
+    TraceEvent ev;
+    ev.name = name;
+    ev.ph = 'i';
+    ev.ts_us = now_us();
+    ev.args_json = args_json;
+    emit(std::move(ev));
+  }
+
+  void count(const std::string& name, int64_t n = 1) {
+    if (!enabled_) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    counters_[name] += n;
+  }
+
+  void gauge(const std::string& name, double v) {
+    if (!enabled_) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    gauges_[name] = v;
+  }
+
+  // thread-local span-nesting stack (parent attribution, like obs/trace.py)
+  static std::vector<std::string>& stack() {
+    thread_local std::vector<std::string> s;
+    return s;
+  }
+
+  std::string default_path() const {
+    const char* dir = getenv("JG_TRACE_DIR");
+    std::string d = dir && *dir ? dir : "results/trace";
+    return d + "/" + proc_ + "-" + std::to_string(getpid()) + ".trace.jsonl";
+  }
+
+  // Append buffered events (+ metadata line on first flush) as JSONL.
+  void flush() {
+    if (!enabled_) return;
+    std::deque<TraceEvent> evs;
+    std::map<std::string, int64_t> counters;
+    std::map<std::string, double> gauges;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      evs.swap(events_);
+      counters = counters_;
+      gauges = gauges_;
+    }
+    std::string path = default_path();
+    size_t slash = path.rfind('/');
+    if (slash != std::string::npos)
+      mkdirs(path.substr(0, slash));
+    FILE* f = fopen(path.c_str(), "a");
+    if (!f) return;
+    if (!wrote_meta_) {
+      fprintf(f,
+              "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%d,"
+              "\"args\":{\"name\":\"%s\"}}\n",
+              getpid(), proc_.c_str());
+      wrote_meta_ = true;
+    }
+    for (const auto& ev : evs) {
+      fprintf(f, "{\"name\":\"%s\",\"ph\":\"%c\",\"ts\":%lld,",
+              json_escape(ev.name).c_str(), ev.ph,
+              static_cast<long long>(ev.ts_us));
+      if (ev.ph == 'X')
+        fprintf(f, "\"dur\":%lld,", static_cast<long long>(ev.dur_us));
+      if (ev.ph == 'i') fprintf(f, "\"s\":\"p\",");
+      fprintf(f, "\"pid\":%d,\"tid\":1,\"args\":{", getpid());
+      bool first = true;
+      if (!ev.parent.empty()) {
+        fprintf(f, "\"parent\":\"%s\"", json_escape(ev.parent).c_str());
+        first = false;
+      }
+      if (!ev.args_json.empty())
+        fprintf(f, "%s%s", first ? "" : ",", ev.args_json.c_str());
+      fprintf(f, "}}\n");
+    }
+    int64_t ts = now_us();
+    for (const auto& [name, v] : counters)
+      fprintf(f,
+              "{\"name\":\"%s\",\"ph\":\"C\",\"ts\":%lld,\"pid\":%d,"
+              "\"args\":{\"value\":%lld}}\n",
+              json_escape(name).c_str(), static_cast<long long>(ts), getpid(),
+              static_cast<long long>(v));
+    for (const auto& [name, v] : gauges)
+      fprintf(f,
+              "{\"name\":\"%s\",\"ph\":\"C\",\"ts\":%lld,\"pid\":%d,"
+              "\"args\":{\"value\":%g}}\n",
+              json_escape(name).c_str(), static_cast<long long>(ts), getpid(),
+              v);
+    fclose(f);
+  }
+
+  ~Tracer() { flush(); }
+
+ private:
+  Tracer() {
+    const char* v = getenv("JG_TRACE");
+    enabled_ = v && *v && strcmp(v, "0") != 0;
+    mono0_ = std::chrono::steady_clock::now();
+    anchor_us_ = std::chrono::duration_cast<std::chrono::microseconds>(
+                     std::chrono::system_clock::now().time_since_epoch())
+                     .count();
+  }
+
+  static void mkdirs(const std::string& dir) {
+    std::string cur;
+    for (size_t i = 0; i < dir.size(); ++i) {
+      cur += dir[i];
+      if (dir[i] == '/' || i + 1 == dir.size())
+        mkdir(cur.c_str(), 0755);  // EEXIST is fine
+    }
+  }
+
+  static std::string json_escape(const std::string& s) {
+    std::string out;
+    for (char c : s) {
+      if (c == '"' || c == '\\') out += '\\';
+      if (static_cast<unsigned char>(c) < 0x20) continue;
+      out += c;
+    }
+    return out;
+  }
+
+  bool enabled_ = false;
+  bool wrote_meta_ = false;
+  std::string proc_ = "cpp";
+  std::deque<TraceEvent> events_;
+  std::map<std::string, int64_t> counters_;
+  std::map<std::string, double> gauges_;
+  std::chrono::steady_clock::time_point mono0_;
+  int64_t anchor_us_ = 0;
+  std::mutex mu_;
+};
+
+// RAII span: construct to open, destruct to record.  Near-zero when off.
+class Span {
+ public:
+  explicit Span(const char* name, std::string args_json = "")
+      : live_(Tracer::instance().enabled()) {
+    if (!live_) return;
+    name_ = name;
+    args_json_ = std::move(args_json);
+    auto& st = Tracer::stack();
+    parent_ = st.empty() ? "" : st.back();
+    st.push_back(name_);
+    t0_us_ = Tracer::instance().now_us();
+  }
+
+  Span(const Span&) = delete;
+  Span& operator=(const Span&) = delete;
+
+  ~Span() {
+    if (!live_) return;
+    auto& st = Tracer::stack();
+    if (!st.empty() && st.back() == name_) st.pop_back();
+    TraceEvent ev;
+    ev.name = name_;
+    ev.ph = 'X';
+    ev.ts_us = t0_us_;
+    int64_t dur = Tracer::instance().now_us() - t0_us_;
+    ev.dur_us = dur < 0 ? 0 : dur;
+    ev.parent = parent_;
+    ev.args_json = std::move(args_json_);
+    Tracer::instance().emit(std::move(ev));
+  }
+
+ private:
+  bool live_;
+  std::string name_, parent_, args_json_;
+  int64_t t0_us_ = 0;
+};
+
+inline void trace_init(const char* proc, bool force_on = false) {
+  Tracer::instance().init(proc, force_on);
+}
+
+inline void trace_count(const char* name, int64_t n = 1) {
+  Tracer::instance().count(name, n);
+}
+
+inline void trace_instant(const char* name, const std::string& args = "") {
+  Tracer::instance().instant(name, args);
+}
+
+inline void trace_flush() { Tracer::instance().flush(); }
+
+inline bool trace_enabled() { return Tracer::instance().enabled(); }
+
+}  // namespace mapd

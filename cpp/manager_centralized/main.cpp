@@ -41,6 +41,7 @@
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
+#include "../common/trace.hpp"
 #include "../common/tswap.hpp"
 
 using namespace mapd;
@@ -102,6 +103,9 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
+  // span tracing (JG_TRACE=1 or --trace): same schema as the Python
+  // tracer; analysis/trace_report.py merges this file with solverd's
+  trace_init("manager_centralized", knobs.get_bool("--trace", nullptr));
 
   Grid grid = Grid::default_grid();
   if (!map_file.empty()) {
@@ -227,6 +231,7 @@ int main(int argc, char** argv) {
 
   auto emit_moves = [&](const std::vector<std::string>& ids,
                         const std::vector<Cell>& next) {
+    Span sp("manager.emit_moves");
     for (size_t k = 0; k < ids.size(); ++k) {
       auto it = agents.find(ids[k]);
       if (it == agents.end()) continue;
@@ -237,6 +242,7 @@ int main(int argc, char** argv) {
           .set("next_pos", point_json(next[k]))
           .set("timestamp", unix_ms());
       bus.publish("mapd", mi);
+      trace_count("manager.moves_emitted");
     }
   };
 
@@ -268,6 +274,7 @@ int main(int argc, char** argv) {
   auto adopt_goal_exchanges = [&](const std::vector<std::string>& ids,
                                   const std::vector<Cell>& old_goals,
                                   const std::vector<Cell>& new_goals) {
+    Span sp("manager.adopt_goal_exchanges");
     struct Incoming {
       std::optional<Json> task;
       Phase phase = Phase::None;
@@ -297,6 +304,7 @@ int main(int argc, char** argv) {
           .set("task_id", task["task_id"])
           .set("peer_id", peer);
       bus.publish("mapd", w);
+      trace_count("manager.goal_exchanges");
       log_info("🔁 task %lld exchanged away from %s\n",
                task["task_id"].as_int(), peer.c_str());
     };
@@ -361,6 +369,7 @@ int main(int argc, char** argv) {
   };
 
   auto plan_native = [&]() {
+    Span sp("manager.plan_native");
     std::vector<std::string> ids;
     std::vector<Cell> old_goals;
     std::vector<TswapAgent> ta;
@@ -371,7 +380,11 @@ int main(int argc, char** argv) {
     }
     if (ta.empty()) return;
     auto t0 = std::chrono::steady_clock::now();
-    tswap_step(ta, dc);
+    {
+      Span sp("manager.tswap_step",
+              "\"agents\":" + std::to_string(ta.size()));
+      tswap_step(ta, dc);
+    }
     int64_t us = std::chrono::duration_cast<std::chrono::microseconds>(
                      std::chrono::steady_clock::now() - t0)
                      .count();
@@ -395,6 +408,7 @@ int main(int argc, char** argv) {
   std::map<std::string, Cell> sent_goals;
 
   auto plan_request_tpu = [&]() {
+    Span sp("manager.plan_request_encode");
     Json req;
     Json arr;
     std::map<std::string, Cell> snap;
@@ -416,7 +430,11 @@ int main(int argc, char** argv) {
   bool failed_over = false;
 
   auto handle_plan_response = [&](const Json& d) {
-    if (d["seq"].as_int() != plan_seq) return;  // stale tick
+    if (d["seq"].as_int() != plan_seq) {
+      trace_count("manager.stale_plan_responses");
+      return;  // stale tick
+    }
+    Span sp("manager.plan_response_apply");
     // Only FRESH (applied) responses prove the daemon useful: a daemon
     // whose latency always exceeds the planning tick produces nothing but
     // stale responses, and counting those as liveness would suppress the
@@ -680,6 +698,9 @@ int main(int argc, char** argv) {
 
     int64_t now = mono_ms();
     if (now - last_plan >= planning_ms) {  // planning tick (ref :675-724)
+      Span sp("manager.plan_tick",
+              "\"agents\":" + std::to_string(agents.size()));
+      trace_count("manager.plan_ticks");
       last_plan = now;
       pickup_transitions();
       if (!agents.empty()) {
@@ -691,6 +712,8 @@ int main(int argc, char** argv) {
           if (now - last_plan_response > solver_failover_ms) {
             if (!failed_over) {
               failed_over = true;
+              trace_count("manager.solver_failovers");
+              trace_instant("manager.solver_failover");
               log_warn("⚠️  solver daemon silent for %lld ms; planning "
                        "natively until it responds\n",
                        static_cast<long long>(now - last_plan_response));
@@ -744,6 +767,7 @@ int main(int argc, char** argv) {
         known_left.erase(known_left.begin());
       try_assign_pending();
       dc.trim(512);
+      trace_flush();  // bounded ring: the 30 s cleanup cadence drains it
       log_info("🧹 [CLEANUP] agents=%zu pending=%zu\n", agents.size(),
                pending_tasks.size());
         }
@@ -754,6 +778,7 @@ int main(int argc, char** argv) {
   if (const char* p = getenv("PATH_CSV_PATH"))
     save_csv(p, path_metrics.to_csv_string());
   log_info("%s\n", task_metrics.statistics().to_string().c_str());
+  trace_flush();
   log_info("manager: bye\n");
   bus.close();
   return 0;

@@ -53,20 +53,27 @@ class TaskMetric:
     completion_time: Optional[int] = None
     status: TaskStatus = TaskStatus.SENT
 
+    # Derivations subtract timestamps STAMPED BY DIFFERENT PEERS (sent by
+    # the manager, started/completed by the agent, each on its own wall
+    # clock) and are clamped to >= 0: peer clock skew beyond the message
+    # latency otherwise yields negative latencies that poison averages and
+    # flip CSV consumers' sorts.  Skew occurrences are counted by the
+    # collector (clock_skew_events) at update time so the clamp is never
+    # silent.
     def get_total_time(self) -> Optional[int]:
         if self.completion_time is None:
             return None
-        return self.completion_time - self.sent_time
+        return max(0, self.completion_time - self.sent_time)
 
     def get_agent_processing_time(self) -> Optional[int]:
         if self.start_time is None or self.completion_time is None:
             return None
-        return self.completion_time - self.start_time
+        return max(0, self.completion_time - self.start_time)
 
     def get_startup_latency(self) -> Optional[int]:
         if self.start_time is None:
             return None
-        return self.start_time - self.sent_time
+        return max(0, self.start_time - self.sent_time)
 
 
 @dataclasses.dataclass
@@ -109,6 +116,17 @@ class TaskMetricsCollector:
 
     def __init__(self):
         self.metrics: Dict[int, TaskMetric] = {}
+        # NetworkMetrics-style counters: how often a peer-stamped timestamp
+        # landed BEFORE its predecessor (wall clocks disagree); the
+        # TaskMetric derivations clamp, these keep the evidence
+        self.clock_skew_events = 0
+        self.clock_skew_worst_ms = 0
+
+    def _note_skew(self, earlier: Optional[int], later: int) -> None:
+        if earlier is not None and later < earlier:
+            self.clock_skew_events += 1
+            self.clock_skew_worst_ms = max(self.clock_skew_worst_ms,
+                                           earlier - later)
 
     def add_metric(self, metric: TaskMetric) -> None:
         self.metrics[metric.task_id] = metric
@@ -117,18 +135,22 @@ class TaskMetricsCollector:
         m = self.metrics.get(task_id)
         if m is not None:
             m.received_time = now_ms() if at_ms is None else at_ms
+            self._note_skew(m.sent_time, m.received_time)
             m.status = TaskStatus.RECEIVED
 
     def update_started(self, task_id: int, at_ms: Optional[int] = None) -> None:
         m = self.metrics.get(task_id)
         if m is not None:
             m.start_time = now_ms() if at_ms is None else at_ms
+            self._note_skew(m.sent_time, m.start_time)
             m.status = TaskStatus.RUNNING
 
     def update_completed(self, task_id: int, at_ms: Optional[int] = None) -> None:
         m = self.metrics.get(task_id)
         if m is not None:
             m.completion_time = now_ms() if at_ms is None else at_ms
+            self._note_skew(m.start_time if m.start_time is not None
+                            else m.sent_time, m.completion_time)
             m.status = TaskStatus.COMPLETED
 
     def update_failed(self, task_id: int) -> None:

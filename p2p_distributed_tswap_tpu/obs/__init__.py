@@ -1,0 +1,10 @@
+"""Runtime observability: span tracer (obs.trace), per-tick heartbeat
+(obs.heartbeat).  Enabled with JG_TRACE=1; near-zero-cost when off.  The
+C++ host runtime mirrors the span schema in cpp/common/trace.hpp; merged
+reports come from analysis/trace_report.py."""
+
+from p2p_distributed_tswap_tpu.obs import trace  # noqa: F401
+from p2p_distributed_tswap_tpu.obs.heartbeat import (  # noqa: F401
+    TICK_BUDGET_MS,
+    HeartbeatWriter,
+)

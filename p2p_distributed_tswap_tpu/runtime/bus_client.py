@@ -22,6 +22,7 @@ import time
 from typing import Callable, Iterator, Optional
 
 from p2p_distributed_tswap_tpu.metrics.task_metrics import NetworkMetrics
+from p2p_distributed_tswap_tpu.obs import trace
 
 
 class BusClient:
@@ -81,6 +82,8 @@ class BusClient:
                 else 0.25
             self._next_attempt = time.monotonic() + self._backoff
             return False
+        trace.count("bus.reconnects")
+        trace.instant("bus.reconnect", peer_id=self.peer_id)
         if self._on_reconnect:
             self._on_reconnect()
         return True
@@ -117,7 +120,10 @@ class BusClient:
         try:
             self.sock.sendall((line + "\n").encode())
             self.net.record_sent(len(line))
+            trace.count("bus.msgs_sent")
+            trace.count("bus.bytes_sent", len(line))
         except OSError:
+            trace.count("bus.send_drops")
             self._drop()
 
     def query_peers(self, topic: str) -> None:
@@ -151,6 +157,8 @@ class BusClient:
                     continue
                 if frame.get("op") == "msg":
                     self.net.record_received(len(line))
+                    trace.count("bus.msgs_received")
+                    trace.count("bus.bytes_received", len(line))
                 return frame
             try:
                 self.sock.settimeout(

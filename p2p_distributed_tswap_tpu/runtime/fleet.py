@@ -18,10 +18,12 @@ import signal
 import subprocess
 import sys
 import time
+from datetime import datetime
 from pathlib import Path
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
+from p2p_distributed_tswap_tpu.obs import trace
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 BUILD_DIR = REPO_ROOT / "cpp" / "build"
@@ -50,9 +52,18 @@ class Fleet:
         assert mode in ("centralized", "decentralized")
         build = ensure_built()
         self.procs: List[subprocess.Popen] = []
-        self.log_dir = Path(log_dir) if log_dir else None
-        if self.log_dir:
-            self.log_dir.mkdir(parents=True, exist_ok=True)
+        self._names: List[str] = []
+        # Child stderr is never dropped: with no explicit log_dir each run
+        # gets a fresh timestamped directory, so a crashing child's last
+        # words (and its exit code, see exit_summary) survive the fleet
+        # teardown instead of vanishing into DEVNULL.
+        if log_dir is None:
+            stamp = (datetime.now().strftime("%Y%m%d-%H%M%S")
+                     + f"-{os.getpid()}")
+            log_dir = REPO_ROOT / "results" / "fleet_logs" / stamp
+        self.log_dir = Path(log_dir)
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        self.exit_summary: List[Dict] = []
         penv = dict(os.environ)
         if config is not None:
             # one RuntimeConfig configures every binary in the fleet
@@ -63,14 +74,13 @@ class Fleet:
         self._logs: List = []
 
         def spawn(name, cmd, stdin=None):
-            if self.log_dir:
-                out = open(self.log_dir / f"{name}.log", "w")
-                self._logs.append(out)
-            else:
-                out = subprocess.DEVNULL
+            out = open(self.log_dir / f"{name}.log", "w")
+            self._logs.append(out)
             p = subprocess.Popen(cmd, stdin=stdin, stdout=out,
                                  stderr=subprocess.STDOUT, env=penv)
             self.procs.append(p)
+            self._names.append(name)
+            trace.instant("fleet.spawn", proc=name, pid=p.pid)
             return p
 
         map_args = ["--map", map_file] if map_file else []
@@ -127,6 +137,13 @@ class Fleet:
         self.close()
 
     def close(self) -> None:
+        if not self.procs:
+            return  # already closed; keep the recorded exit_summary
+        # Children already dead BEFORE the teardown SIGTERM died on their
+        # own — their exit codes are the fleet's failure record, not an
+        # artifact of shutdown.
+        died_early = {id(p): p.poll() for p in self.procs
+                      if p.poll() is not None}
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
@@ -136,7 +153,25 @@ class Fleet:
                 p.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
+                try:  # reap, or the summary below reads returncode None
+                    p.wait(timeout=1)
+                except subprocess.TimeoutExpired:
+                    pass
+        self.exit_summary = []
+        for name, p in zip(self._names, self.procs):
+            rec = {"proc": name, "pid": p.pid, "returncode": p.poll(),
+                   "died_early": id(p) in died_early,
+                   "log": str(self.log_dir / f"{name}.log")}
+            self.exit_summary.append(rec)
+            trace.instant("fleet.exit", proc=name, pid=p.pid,
+                          returncode=rec["returncode"],
+                          died_early=rec["died_early"])
+            if rec["died_early"] and rec["returncode"] != 0:
+                print(f"⚠️  fleet: {name} (pid {p.pid}) exited "
+                      f"{rec['returncode']} before shutdown — see "
+                      f"{rec['log']}", file=sys.stderr, flush=True)
         self.procs.clear()
+        self._names.clear()
         for f in self._logs:
             try:
                 f.close()
@@ -176,7 +211,14 @@ def main(argv=None) -> int:
         fleet.command("metrics")
         time.sleep(1)
         fleet.quit()
-    print("fleet shut down")
+        bad = [r for r in fleet.exit_summary
+               if r["died_early"] and r["returncode"] != 0]
+        for r in bad:
+            print(f"fleet: {r['proc']} exited {r['returncode']} "
+                  f"(log: {r['log']})")
+    trace.flush()
+    print("fleet shut down" + (f" ({len(bad)} child failure(s))"
+                               if bad else ""))
     return 0
 
 

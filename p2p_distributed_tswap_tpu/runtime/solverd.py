@@ -25,6 +25,14 @@ Wire: plan_request  {type, seq, agents:[{peer_id, pos:[x,y], goal:[x,y]}]}
 
 Usage: python -m p2p_distributed_tswap_tpu.runtime.solverd
            [--port 7400] [--map FILE] [--capacity-min 16] [--warm N]
+           [--trace]
+
+Observability (obs/): with ``JG_TRACE=1`` (or ``--trace``) every tick is
+traced phase-by-phase (decode -> cache lookup -> field sweep -> step
+dispatch -> device sync -> encode) into Chrome trace-event JSONL plus a
+per-tick heartbeat line judged against the manager's 500 ms planning
+budget; ``kill -USR1`` or a bus ``stats_request`` message dumps a
+machine-readable stats snapshot at any time (tracing not required).
 
 ``--warm N`` pre-compiles the whole planning path for an N-agent fleet
 BEFORE the readiness banner: the step program at capacity(N), the
@@ -38,10 +46,12 @@ from __future__ import annotations
 
 import argparse
 import functools
+import json
+import signal
 import sys
 import time
 from collections import OrderedDict
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +59,7 @@ import numpy as np
 
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.obs import HeartbeatWriter, trace
 from p2p_distributed_tswap_tpu.ops.distance import (
     PACKED_STAY,
     direction_fields,
@@ -90,6 +101,13 @@ class PlanService:
             direction_fields(self.free, goals).reshape(goals.shape[0], -1)))
         self._last_cap = 0
         self._seen_programs = 0
+        # observability: cumulative counters + the last plan's per-phase
+        # wall times (obs/ heartbeat pulls these; a handful of
+        # perf_counter reads per tick, negligible against the tick budget)
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.recompiles = 0
+        self.last_phase_ms: Dict[str, float] = {}
 
     def _capacity(self, n: int) -> int:
         c = self.capacity_min
@@ -144,38 +162,139 @@ class PlanService:
         # quiet on cache hits (e.g. shrinking back to a known capacity).
         t_plan0 = time.perf_counter()
         goals = [g for _, _, g in agents]
-        # LRU-touch cached request goals FIRST so eviction inside
-        # _ensure_fields can only hit goals absent from this request
-        for g in goals:
-            if g in self.goal_rows:
-                self.goal_rows.move_to_end(g)
-        self._ensure_fields(goals)
-        cfg = SolverConfig(height=self.grid.height, width=self.grid.width,
-                           num_agents=cap)
-        pos = np.zeros(cap, np.int32)
-        goal = np.zeros(cap, np.int32)
-        slot = np.zeros(cap, np.int32)
-        active = np.zeros(cap, bool)
-        # agents map onto cached field rows via the slot indirection; padded
-        # lanes reuse row 0 but are masked inactive
-        for k, (_, p, g) in enumerate(agents):
-            pos[k], goal[k], slot[k] = p, g, self.goal_rows[g]
-            active[k] = True
-        new_pos, new_goal, _ = self._step(
-            cfg, jnp.asarray(pos), jnp.asarray(goal), jnp.asarray(slot),
-            self.dirs, jnp.asarray(active))
-        new_pos = np.asarray(new_pos)
-        new_goal = np.asarray(new_goal)
+        with trace.span("solverd.cache_lookup", agents=n):
+            uniq = dict.fromkeys(goals)
+            misses = sum(1 for g in uniq if g not in self.goal_rows)
+            hits = len(uniq) - misses
+            self.cache_hits += hits
+            self.cache_misses += misses
+            trace.count("solverd.field_cache_hits", hits)
+            trace.count("solverd.field_cache_misses", misses)
+            # LRU-touch cached request goals FIRST so eviction inside
+            # _ensure_fields can only hit goals absent from this request
+            for g in goals:
+                if g in self.goal_rows:
+                    self.goal_rows.move_to_end(g)
+        t_sweep0 = time.perf_counter()
+        with trace.span("solverd.field_sweep", fresh_goals=misses):
+            self._ensure_fields(goals)
+        t_disp0 = time.perf_counter()
+        with trace.span("solverd.step_dispatch", capacity=cap):
+            cfg = SolverConfig(height=self.grid.height, width=self.grid.width,
+                               num_agents=cap)
+            pos = np.zeros(cap, np.int32)
+            goal = np.zeros(cap, np.int32)
+            slot = np.zeros(cap, np.int32)
+            active = np.zeros(cap, bool)
+            # agents map onto cached field rows via the slot indirection;
+            # padded lanes reuse row 0 but are masked inactive
+            for k, (_, p, g) in enumerate(agents):
+                pos[k], goal[k], slot[k] = p, g, self.goal_rows[g]
+                active[k] = True
+            new_pos, new_goal, _ = self._step(
+                cfg, jnp.asarray(pos), jnp.asarray(goal), jnp.asarray(slot),
+                self.dirs, jnp.asarray(active))
+        t_sync0 = time.perf_counter()
+        with trace.span("solverd.device_sync"):
+            new_pos = np.asarray(new_pos)
+            new_goal = np.asarray(new_goal)
+        t_end = time.perf_counter()
         new_cache = getattr(self._step, "_cache_size", lambda: None)()
         if new_cache is not None and new_cache > self._seen_programs:
+            self.recompiles += 1
+            trace.count("solverd.recompiles")
+            trace.instant("solverd.recompile", capacity=cap,
+                          field_rows=int(self.dirs.shape[0]))
             print(f"⏳ recompiled step program "
                   f"(capacity {self._last_cap} -> {cap}, "
                   f"{self.dirs.shape[0]} field rows): plan stalled "
                   f"{time.perf_counter() - t_plan0:.1f}s", flush=True)
             self._seen_programs = new_cache
         self._last_cap = cap
+        self.last_phase_ms = {
+            "cache_lookup": 1000.0 * (t_sweep0 - t_plan0),
+            "field_sweep": 1000.0 * (t_disp0 - t_sweep0),
+            "step_dispatch": 1000.0 * (t_sync0 - t_disp0),
+            "device_sync": 1000.0 * (t_end - t_sync0),
+        }
         return [(agents[k][0], int(new_pos[k]), int(new_goal[k]))
                 for k in range(n)]
+
+
+class TickRunner:
+    """One solverd planning tick, decode -> plan -> encode, as a plain
+    callable — the daemon loop drives it with bus frames; tests drive it
+    in-process with dicts.  Owns the tick span, the per-tick heartbeat
+    line, and the on-demand stats snapshot (SIGUSR1 / bus stats_request)."""
+
+    def __init__(self, service: PlanService, grid: Grid,
+                 heartbeat: Optional[HeartbeatWriter] = None):
+        self.service = service
+        self.grid = grid
+        self.heartbeat = heartbeat
+        self.ticks = 0
+        self.dropped_total = 0
+
+    def handle(self, data: dict) -> Optional[dict]:
+        """plan_request dict -> plan_response dict (None for empty fleets)."""
+        seq = data.get("seq")
+        t0 = time.perf_counter()
+        with trace.span("solverd.tick", seq=seq):
+            with trace.span("solverd.request_decode"):
+                agents = []
+                w = self.grid.width
+                for e in data.get("agents", []):
+                    px, py = e["pos"]
+                    gx, gy = e["goal"]
+                    agents.append((e["peer_id"], py * w + px, gy * w + gx))
+                t_dec = time.perf_counter()
+            if not agents:
+                return None
+            moves = self.service.plan(agents)
+            t_plan = time.perf_counter()
+            us = int((t_plan - t0) * 1e6)
+            with trace.span("solverd.reply_encode"):
+                resp = {
+                    "type": "plan_response",
+                    "seq": seq,
+                    "duration_micros": us,
+                    "moves": [{"peer_id": pid,
+                               "next_pos": [c % w, c // w],
+                               "goal": [g % w, g // w]}
+                              for pid, c, g in moves],
+                }
+            t_end = time.perf_counter()
+        self.ticks += 1
+        if self.heartbeat is not None:
+            phase_ms = dict(self.service.last_phase_ms)
+            phase_ms["decode"] = 1000.0 * (t_dec - t0)
+            phase_ms["encode"] = 1000.0 * (t_end - t_plan)
+            phase_ms["total"] = 1000.0 * (t_end - t0)
+            self.heartbeat.beat(seq, len(agents), phase_ms,
+                                counters=trace.snapshot()["counters"])
+            trace.flush()
+        return resp
+
+    def stats(self) -> dict:
+        """Machine-readable daemon state: tracer snapshot + service view."""
+        svc = self.service
+        snap = trace.snapshot()
+        snap["service"] = {
+            "ticks": self.ticks,
+            "dropped_stale": self.dropped_total,
+            "cache_hits": svc.cache_hits,
+            "cache_misses": svc.cache_misses,
+            "cached_fields": len(svc.goal_rows),
+            "max_fields": svc.max_fields,
+            "recompiles": svc.recompiles,
+            "capacity": svc._last_cap,
+            "last_phase_ms": {k: round(v, 3)
+                              for k, v in svc.last_phase_ms.items()},
+        }
+        if self.heartbeat is not None:
+            snap["service"]["over_budget_ticks"] = \
+                self.heartbeat.over_budget_ticks
+        return snap
 
 
 def main(argv=None) -> int:
@@ -186,10 +305,15 @@ def main(argv=None) -> int:
     ap.add_argument("--warm", type=int, default=0,
                     help="pre-compile for an N-agent fleet before the "
                          "readiness banner (zero recompile stalls)")
+    ap.add_argument("--trace", action="store_true",
+                    help="force span tracing on (equivalent to JG_TRACE=1)")
     # Force the CPU backend (tests; also the env-var route is unreliable in
     # environments whose sitecustomize pre-imports jax with a plugin set).
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
+
+    tracer = trace.configure(enabled=True if args.trace else None,
+                             proc="solverd")
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -232,16 +356,44 @@ def main(argv=None) -> int:
         print(f"🔥 pre-warmed: capacity {service._capacity(n)} step "
               f"program, field chunk program, {n} field rows in "
               f"{time.perf_counter() - t0:.1f}s", flush=True)
+    heartbeat = None
+    if tracer.enabled:
+        heartbeat = HeartbeatWriter(tracer.default_path("heartbeat"))
+        print(f"🔎 tracing on: {tracer.default_path('trace')} "
+              f"(+ heartbeat sidecar)", flush=True)
+    runner = TickRunner(service, grid, heartbeat=heartbeat)
+
+    # SIGUSR1 = operator stats dump: signal handlers only flip a flag (the
+    # handler can interrupt the plan path mid-tick, where a full dump
+    # would not be re-entrant); the loop below dumps between frames.
+    stats_requested = {"flag": False}
+    signal.signal(signal.SIGUSR1,
+                  lambda *_: stats_requested.__setitem__("flag", True))
+
+    def dump_stats() -> None:
+        print("📈 stats " + json.dumps(runner.stats()), flush=True)
+        trace.flush()
+
+    trace.instant("solverd.up", port=args.port)
     print(f"🧮 solverd up on port {args.port} "
           f"(grid {grid.height}x{grid.width}, devices={jax.devices()})")
     sys.stdout.flush()
 
-    dropped_total = 0
     while True:
         frame = bus.recv(timeout=1.0)
+        if stats_requested["flag"]:
+            stats_requested["flag"] = False
+            dump_stats()
         if frame is None or frame.get("op") != "msg":
             continue
         data = frame.get("data") or {}
+        if data.get("type") == "stats_request":
+            # on-demand machine-readable snapshot over the bus (the
+            # operator-CLI / harness analog of SIGUSR1)
+            bus.publish("solver", {"type": "stats_response",
+                                   **runner.stats()})
+            trace.flush()
+            continue
         if data.get("type") != "plan_request":
             continue
         # Staleness drop: if planning fell behind the manager's tick (slow
@@ -262,31 +414,20 @@ def main(argv=None) -> int:
             if ndata.get("type") == "plan_request":
                 data = ndata
                 dropped += 1
+            elif ndata.get("type") == "stats_request":
+                # a stats_request queued behind plan_requests must not be
+                # swallowed by the stale drain — answer it right here
+                bus.publish("solver", {"type": "stats_response",
+                                       **runner.stats()})
         if dropped:
-            dropped_total += dropped
+            runner.dropped_total += dropped
+            trace.count("solverd.dropped_stale", dropped)
             print(f"⏭️  dropped {dropped} stale plan_request(s) "
-                  f"({dropped_total} total); planning seq {data.get('seq')}",
-                  flush=True)
-        t0 = time.perf_counter()
-        agents = []
-        w = grid.width
-        for e in data.get("agents", []):
-            px, py = e["pos"]
-            gx, gy = e["goal"]
-            agents.append((e["peer_id"], py * w + px, gy * w + gx))
-        if not agents:
-            continue
-        moves = service.plan(agents)
-        us = int((time.perf_counter() - t0) * 1e6)
-        bus.publish("solver", {
-            "type": "plan_response",
-            "seq": data.get("seq"),
-            "duration_micros": us,
-            "moves": [{"peer_id": pid,
-                       "next_pos": [c % w, c // w],
-                       "goal": [g % w, g // w]}
-                      for pid, c, g in moves],
-        })
+                  f"({runner.dropped_total} total); planning seq "
+                  f"{data.get('seq')}", flush=True)
+        resp = runner.handle(data)
+        if resp is not None:
+            bus.publish("solver", resp)
 
 
 if __name__ == "__main__":

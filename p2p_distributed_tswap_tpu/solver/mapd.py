@@ -26,6 +26,7 @@ from flax import struct
 from p2p_distributed_tswap_tpu.core.agent import AgentPhase, AgentState
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.obs import trace
 from p2p_distributed_tswap_tpu.ops.distance import (
     PACKED_STAY,
     direction_fields,
@@ -432,12 +433,16 @@ def host_prime_fields(cfg: SolverConfig, s: MapdState,
     """
     n, r = cfg.num_agents, min(cfg.replan_chunk, cfg.num_agents)
     nchunks = -(-n // r)
-    for ci in range(nchunks):
-        sel = np.clip(np.arange(ci * r, (ci + 1) * r), 0, n - 1)
-        sel_j = jnp.asarray(sel, jnp.int32)
-        fields = _prime_chunk(cfg, r, free, s.goal[sel_j])
-        # rebind through s so the superseded dirs reference drops each chunk
-        s = s.replace(dirs=_prime_update(s.dirs, s.slot[sel_j], fields))
+    with trace.span("mapd.host_prime_fields", agents=n, chunks=nchunks):
+        for ci in range(nchunks):
+            sel = np.clip(np.arange(ci * r, (ci + 1) * r), 0, n - 1)
+            sel_j = jnp.asarray(sel, jnp.int32)
+            with trace.span("mapd.prime_chunk", chunk=ci):
+                fields = _prime_chunk(cfg, r, free, s.goal[sel_j])
+                # rebind through s so the superseded dirs reference drops
+                # each chunk
+                s = s.replace(dirs=_prime_update(s.dirs, s.slot[sel_j],
+                                                 fields))
     return s.replace(need_replan=jnp.zeros(cfg.num_agents, bool))
 
 
@@ -497,10 +502,12 @@ def solve_offline(grid: Grid, starts_idx: np.ndarray, tasks: np.ndarray,
     if len(tasks) == 0:
         n = len(starts_idx)
         return (np.zeros((0, n), np.int32), np.zeros((0, n), np.int8), 0)
-    final = _run_mapd_jit(cfg, jnp.asarray(starts_idx, jnp.int32),
-                          jnp.asarray(tasks, jnp.int32),
-                          jnp.asarray(grid.free))
-    makespan = int(final.t)
+    with trace.span("mapd.solve_offline", agents=len(starts_idx),
+                    tasks=int(len(tasks))):
+        final = _run_mapd_jit(cfg, jnp.asarray(starts_idx, jnp.int32),
+                              jnp.asarray(tasks, jnp.int32),
+                              jnp.asarray(grid.free))
+        makespan = int(final.t)  # the fetch that syncs the device
     if not cfg.record_paths:
         n = len(starts_idx)
         return (np.zeros((0, n), np.int32), np.zeros((0, n), np.int8),

@@ -1,0 +1,87 @@
+"""Observability CI gates (tentpole satellite).
+
+1. Smoke: a few in-process solverd ticks with tracing enabled, then
+   ``analysis/trace_report.py`` (the real CLI entry) must parse the trace
+   + heartbeat files and print the per-span table and tick-budget
+   breakdown.
+2. ``python -m compileall`` over the package and analysis/ as a cheap
+   syntax gate — analysis scripts have no other tier-1 coverage and a
+   SyntaxError there should fail fast, not at the first hardware run.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.obs import HeartbeatWriter, trace
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def test_solverd_ticks_then_trace_report_cli(tmp_path, monkeypatch):
+    from p2p_distributed_tswap_tpu.runtime.solverd import (
+        PlanService, TickRunner)
+
+    monkeypatch.setenv("JG_TRACE_DIR", str(tmp_path))
+    tracer = trace.configure(enabled=True, proc="solverd")
+    try:
+        grid = Grid.default()
+        runner = TickRunner(
+            PlanService(grid, capacity_min=4), grid,
+            heartbeat=HeartbeatWriter(tracer.default_path("heartbeat")))
+        for seq in range(3):
+            resp = runner.handle({"type": "plan_request", "seq": seq,
+                                  "agents": [
+                                      {"peer_id": "a", "pos": [1, 1],
+                                       "goal": [6, 2]},
+                                      {"peer_id": "b", "pos": [4, 4],
+                                       "goal": [2, 4]}]})
+            assert resp is not None and len(resp["moves"]) == 2
+        trace.flush()
+    finally:
+        trace.configure(enabled=False)
+
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "analysis" / "trace_report.py"),
+         str(tmp_path), "--perfetto", str(tmp_path / "merged.json")],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "solverd.tick" in out
+    assert "tick budget — solverd.tick" in out
+    assert "heartbeats: 3 ticks" in out
+    # Perfetto merge artifact is one well-formed traceEvents JSON
+    merged = json.loads((tmp_path / "merged.json").read_text())
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert {"solverd.tick", "solverd.field_sweep"} <= names
+
+    # --json mode is the machine-readable face of the same report
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "analysis" / "trace_report.py"),
+         str(tmp_path), "--json"], capture_output=True, text=True,
+        cwd=str(ROOT))
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["budget"]["solverd.tick"]["ticks"] == 3
+    assert report["spans"]["solverd.step_dispatch"]["count"] == 3
+
+
+def test_trace_report_empty_dir_fails_cleanly(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "analysis" / "trace_report.py"),
+         str(tmp_path)], capture_output=True, text=True, cwd=str(ROOT))
+    assert proc.returncode == 1
+    assert "no *.trace.jsonl" in proc.stderr
+
+
+@pytest.mark.parametrize("target", ["p2p_distributed_tswap_tpu", "analysis"])
+def test_compileall_syntax_gate(target):
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", target],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert proc.returncode == 0, \
+        f"syntax errors under {target}:\n{proc.stdout}{proc.stderr}"
