@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""fleet_top — live fleet-wide metrics view over ``mapd.metrics`` beacons.
+
+Every process in a running fleet (solverd, the C++ managers/agents, busd)
+publishes its live-metrics registry snapshot on bus topic ``mapd.metrics``
+every ~2 s (obs/beacon.py and the cpp/common mirror).  This tool subscribes,
+merges the beacons with obs/fleet_aggregator.py, and renders the rollup:
+per-peer tick p50/p95 vs the 500 ms planning budget, wire-byte bandwidth,
+field-cache hit rate, task-latency percentiles, and last-seen staleness
+(dead or wedged peers surface as STALE).
+
+Usage:
+    python analysis/fleet_top.py [--port 7400] [--host 127.0.0.1]
+        [--interval 2.0]          # live view, ANSI-refreshed (watch-able)
+    python analysis/fleet_top.py --once [--json] [--wait 5.0]
+        # collect beacons for --wait seconds, print one rollup, exit 0
+        # (exit 1 if no beacon arrived) — the harness/CI entry point
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from p2p_distributed_tswap_tpu.obs.beacon import METRICS_TOPIC  # noqa: E402
+from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (  # noqa: E402
+    FleetAggregator,
+)
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402
+
+
+def _fmt(v, spec: str = "", dash: str = "-") -> str:
+    return dash if v is None else format(v, spec)
+
+
+def render(rollup: dict) -> str:
+    """Plain-text table over the rollup (the live view body)."""
+    f = rollup["fleet"]
+    lines = [
+        f"fleet_top — {f['peers']} peer(s), {f['stale_peers']} stale, "
+        f"{rollup['beacons_ingested']} beacon(s); "
+        f"ticks {f['ticks']} ({f['ticks_over_budget']} over "
+        f"{rollup['budget_ms']:.0f} ms budget)",
+        f"{'PEER':<28} {'PROC':<20} {'AGE':>5} {'TICKp50':>8} "
+        f"{'TICKp95':>8} {'OVER':>5} {'TX kbps':>8} {'RX kbps':>8} "
+        f"{'CACHE%':>7} {'RECOMP':>6} {'TASKS':>6} {'TASKp95':>8}",
+    ]
+    for peer, p in rollup["peers"].items():
+        t, c, k = p["tick"], p["cache"], p["tasks"]
+        bw = p["bandwidth"]
+        age = f"{p['age_s']:.0f}s" + ("!" if p["stale"] else "")
+        lines.append(
+            f"{peer[:28]:<28} {p['proc'][:20]:<20} {age:>5} "
+            f"{_fmt(t and t['p50_ms'], '.1f'):>8} "
+            f"{_fmt(t and t['p95_ms'], '.1f'):>8} "
+            f"{_fmt(t and t['over_budget']):>5} "
+            f"{bw['sent_kbps']:>8.1f} {bw['recv_kbps']:>8.1f} "
+            f"{_fmt(c and round(100 * c['hit_rate'], 1), '.1f'):>7} "
+            f"{_fmt(c and c['recompiles']):>6} "
+            f"{_fmt(k and k['completed']):>6} "
+            f"{_fmt(k and k['latency_p95_ms'], '.0f'):>8}")
+    return "\n".join(lines)
+
+
+def collect(agg: FleetAggregator, bus: BusClient, duration: float) -> int:
+    """Pump beacons into the aggregator for ``duration`` seconds; returns
+    the number ingested."""
+    n = 0
+    deadline = time.monotonic() + duration
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return n
+        frame = bus.recv(timeout=min(0.5, remaining))
+        if not frame or frame.get("op") != "msg":
+            continue
+        if frame.get("topic") != METRICS_TOPIC:
+            continue
+        if agg.ingest(frame.get("data") or {}):
+            n += 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7400)
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live-view refresh cadence (seconds)")
+    ap.add_argument("--once", action="store_true",
+                    help="collect for --wait seconds, print once, exit")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: print the raw rollup JSON")
+    ap.add_argument("--wait", type=float, default=5.0,
+                    help="--once collection window (seconds; spans at "
+                         "least two 2 s beacon intervals by default)")
+    ap.add_argument("--budget-ms", type=float, default=500.0)
+    args = ap.parse_args(argv)
+
+    try:
+        bus = BusClient(host=args.host, port=args.port, peer_id="fleet_top",
+                        reconnect=not args.once)
+    except OSError as e:
+        print(f"fleet_top: cannot reach bus at {args.host}:{args.port} "
+              f"({e})", file=sys.stderr)
+        return 1
+    bus.subscribe(METRICS_TOPIC)
+    agg = FleetAggregator(budget_ms=args.budget_ms)
+
+    if args.once:
+        collect(agg, bus, args.wait)
+        rollup = agg.rollup()
+        if not rollup["peers"]:
+            print("fleet_top: no metrics beacons observed "
+                  f"within {args.wait:.1f}s", file=sys.stderr)
+            return 1
+        print(json.dumps(rollup, indent=2) if args.json else render(rollup))
+        return 0
+
+    try:
+        while True:
+            collect(agg, bus, args.interval)
+            # ANSI clear + home: a poor man's curses, pipe-safe
+            out = render(agg.rollup())
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(out, flush=True)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,6 +71,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
+  bus.enable_metrics_beacon("agent_centralized");
 
   Cell my_pos = grid.random_free_cell(rng);
   std::optional<Json> my_task;

@@ -7,7 +7,7 @@
 // within Manhattan radius 15, wire coordination for goal swaps and target
 // rotations, the task state machine Idle -> MovingToPickup ->
 // MovingToDelivery, per-decision path_metric publishing, and periodic
-// NetworkMetrics prints.
+// network-summary prints (from the live-metrics registry).
 //
 // Usage: mapd_agent_decentralized [--port P] [--map FILE] [--radius R]
 //                                 [--seed S]
@@ -132,6 +132,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
+  bus.enable_metrics_beacon("agent_decentralized");
   log_info("🤖 agent %s up (radius %d)\n", my_id.c_str(), args.radius);
 
   // ---- initial position protocol (ref :518-650) ----
@@ -609,7 +610,8 @@ int main(int argc, char** argv) {
     dc.trim(256);
 
     if (now - last_metrics_print > 10000) {  // ref :786-789
-      log_info("%s\n", bus.net_metrics().to_string().c_str());
+      log_info("%s\n",
+               MetricsRegistry::instance().network_summary_string().c_str());
           last_metrics_print = now;
     }
   }

@@ -19,9 +19,11 @@
 #include <string>
 #include <vector>
 
+#include "../common/bus.hpp"  // unix_ms/mono_ms helpers
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
+#include "../common/metrics.hpp"
 #include "../common/net.hpp"
 
 using namespace mapd;
@@ -78,12 +80,38 @@ int main(int argc, char** argv) {
   auto broadcast = [&](const Json& frame, const std::string& topic,
                        int except_fd) {
     std::string line = frame.dump();
+    int fanout = 0;
     for (auto& [fd, c] : clients) {
       if (fd == except_fd) continue;
       if (!topic.empty() && !c->topics.count(topic)) continue;
       if (c->peer_id.empty()) continue;  // not yet hello'd
       c->conn.send_line(line);
+      ++fanout;
     }
+    // hub-side fan-out accounting (wire bytes incl. framing newline);
+    // rides the busd metrics beacon into the fleet rollup
+    if (fanout) {
+      std::string labels = "topic=\"" + topic + "\"";
+      metrics_count("bus.fanout_msgs", fanout, labels);
+      metrics_count("bus.fanout_bytes",
+                    static_cast<double>(fanout * (line.size() + 1)), labels);
+    }
+  };
+
+  // The hub beacons its own registry too (same schema as every BusClient):
+  // fan-out volume per topic + connected-client gauge, as peer "busd".
+  int64_t next_beacon_ms = 0;
+  auto maybe_beacon = [&]() {
+    int64_t now = mono_ms();
+    if (now < next_beacon_ms) return;
+    next_beacon_ms = now + 2000;
+    metrics_gauge("bus.clients", static_cast<double>(clients.size()));
+    Json msg;
+    msg.set("op", "msg")
+        .set("topic", "mapd.metrics")
+        .set("from", "busd")
+        .set("data", make_metrics_beacon("busd", "busd", 2.0));
+    broadcast(msg, "mapd.metrics", -1);
   };
 
   while (!g_stop) {
@@ -99,6 +127,7 @@ int main(int argc, char** argv) {
       if (errno == EINTR) continue;
       break;
     }
+    maybe_beacon();
 
     // accept new connections
     if (pfds[0].revents & POLLIN) {

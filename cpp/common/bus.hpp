@@ -20,6 +20,7 @@
 #pragma once
 
 #include <poll.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <chrono>
@@ -104,7 +105,17 @@ class BusClient {
   // mode stays alive across bus outages.
   bool connected() const { return conn_.valid() || reconnect_; }
   bool wants_write() const { return conn_.wants_write(); }
-  NetworkMetrics& net_metrics() { return net_; }
+
+  // Fleet-wide live metrics: publish this process's MetricsRegistry
+  // snapshot on topic "mapd.metrics" every `interval_ms` (same beacon
+  // schema as obs/beacon.py — obs/fleet_aggregator.py and fleet_top merge
+  // both sides).  The check rides every pump() call.
+  void enable_metrics_beacon(const std::string& proc,
+                             int64_t interval_ms = 2000) {
+    beacon_proc_ = proc;
+    beacon_interval_ms_ = interval_ms;
+    next_beacon_ms_ = 0;  // first pump publishes immediately
+  }
 
   void subscribe(const std::string& topic) {
     topics_.insert(topic);
@@ -118,7 +129,11 @@ class BusClient {
     Json j;
     j.set("op", "pub").set("topic", topic).set("data", data);
     std::string line = j.dump();
-    net_.record_sent(line.size());
+    // wire bytes: the framed line PLUS its newline (send_line appends it) —
+    // keeps py/cpp bandwidth numbers byte-identical (bus_client.py publish)
+    metrics_count("bus.msgs_sent", 1, "topic=\"" + topic + "\"");
+    metrics_count("bus.bytes_sent", static_cast<double>(line.size() + 1),
+                  "topic=\"" + topic + "\"");
     conn_.send_line(line);
   }
 
@@ -135,6 +150,7 @@ class BusClient {
   // on_msg: application messages; on_event: peer_joined/peer_left/peers.
   bool pump(const std::function<void(const Msg&)>& on_msg,
             const std::function<void(const Json&)>& on_event = nullptr) {
+    maybe_publish_beacon();
     if (!conn_.valid()) return try_reconnect();
     if (!conn_.on_readable()) return drop_or_retry();
     while (auto line = conn_.next_line()) {
@@ -143,9 +159,13 @@ class BusClient {
       const Json& j = *parsed;
       const std::string& op = j["op"].as_str();
       if (op == "msg") {
-        net_.record_received(line->size());
-        if (on_msg) on_msg(Msg{j["topic"].as_str(), j["from"].as_str(),
-                               j["data"]});
+        // wire bytes: framed line + its newline (stripped by next_line)
+        const std::string& topic = j["topic"].as_str();
+        metrics_count("bus.msgs_received", 1, "topic=\"" + topic + "\"");
+        metrics_count("bus.bytes_received",
+                      static_cast<double>(line->size() + 1),
+                      "topic=\"" + topic + "\"");
+        if (on_msg) on_msg(Msg{topic, j["from"].as_str(), j["data"]});
       } else if (on_event) {
         on_event(j);
       }
@@ -163,6 +183,16 @@ class BusClient {
  private:
   void send_control(const Json& j) {
     if (conn_.valid()) conn_.send_line(j.dump());
+  }
+
+  void maybe_publish_beacon() {
+    if (beacon_proc_.empty() || !conn_.valid()) return;
+    int64_t now = mono_ms();
+    if (now < next_beacon_ms_) return;
+    next_beacon_ms_ = now + beacon_interval_ms_;
+    publish("mapd.metrics",
+            make_metrics_beacon(peer_id_, beacon_proc_,
+                                beacon_interval_ms_ / 1000.0));
   }
 
   // Connection died mid-pump: without reconnect mode propagate the death;
@@ -226,7 +256,9 @@ class BusClient {
   std::set<std::string> topics_;
   int64_t backoff_ms_ = 0;
   int64_t next_attempt_ms_ = 0;
-  NetworkMetrics net_;
+  std::string beacon_proc_;  // empty = beacons off
+  int64_t beacon_interval_ms_ = 2000;
+  int64_t next_beacon_ms_ = 0;
 };
 
 }  // namespace mapd

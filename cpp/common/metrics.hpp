@@ -3,17 +3,31 @@
 // (SURVEY C11) and with the Python twin
 // (p2p_distributed_tswap_tpu/metrics/task_metrics.py); the pandas analysis
 // layer consumes either side's CSVs unchanged.
+//
+// Also home of MetricsRegistry: the native mirror of the unified
+// live-metrics registry (p2p_distributed_tswap_tpu/obs/registry.py) —
+// counters / gauges / fixed-bucket histograms keyed by the same flat
+// Prometheus-style strings, with the same snapshot JSON schema, so the
+// metrics beacons this side publishes (cpp/common/bus.hpp
+// enable_metrics_beacon) merge into one fleet rollup with the Python
+// processes' (obs/fleet_aggregator.py, analysis/fleet_top.py).
 #pragma once
 
+#include <unistd.h>
+
 #include <algorithm>
+#include <cctype>
 #include <chrono>
 #include <cstdint>
 #include <map>
+#include <mutex>
 #include <numeric>
 #include <optional>
 #include <sstream>
 #include <string>
 #include <vector>
+
+#include "json.hpp"
 
 namespace mapd {
 
@@ -271,62 +285,225 @@ class PathComputationMetrics {
   std::vector<std::optional<int64_t>> timestamps_;
 };
 
-class NetworkMetrics {
- public:
-  NetworkMetrics() : start_(std::chrono::steady_clock::now()) {}
+// ---------------------------------------------------------------------------
+// MetricsRegistry — native mirror of obs/registry.py (see header comment).
+// Series keys: `name` or `name{k="v",...}`; labels arrive pre-formatted
+// (`topic="solver"`) since C++ call sites know them statically.  Metric
+// names may contain dots (tracer style); Prometheus exposition sanitizes.
+// ---------------------------------------------------------------------------
 
-  void record_sent(size_t nbytes) {
-    ++messages_sent;
-    bytes_sent += nbytes;
+// Bucket bounds (ms) shared with obs/registry.py DEFAULT_MS_BUCKETS: the
+// 500 ms planning budget sits on a bucket edge.
+inline const std::vector<double>& default_ms_buckets() {
+  static const std::vector<double> b{1,   2,   5,    10,   20,   50,
+                                     100, 200, 500, 1000, 2000, 5000};
+  return b;
+}
+
+class MetricsRegistry {
+ public:
+  static MetricsRegistry& instance() {
+    static MetricsRegistry r;
+    return r;
   }
-  void record_received(size_t nbytes) {
-    ++messages_received;
-    bytes_received += nbytes;
+
+  static std::string key(const std::string& name,
+                         const std::string& labels = "") {
+    return labels.empty() ? name : name + "{" + labels + "}";
   }
-  double elapsed_secs() const {
+
+  void count(const std::string& name, double n = 1,
+             const std::string& labels = "") {
+    std::lock_guard<std::mutex> lk(mu_);
+    counters_[key(name, labels)] += n;
+  }
+
+  void gauge(const std::string& name, double v,
+             const std::string& labels = "") {
+    std::lock_guard<std::mutex> lk(mu_);
+    gauges_[key(name, labels)] = v;
+  }
+
+  void observe(const std::string& name, double v,
+               const std::string& labels = "") {
+    std::lock_guard<std::mutex> lk(mu_);
+    Hist& h = hists_[key(name, labels)];
+    if (h.counts.empty()) h.counts.assign(default_ms_buckets().size() + 1, 0);
+    size_t i = 0;
+    while (i < default_ms_buckets().size() && v > default_ms_buckets()[i]) ++i;
+    ++h.counts[i];
+    h.sum += v;
+    ++h.count;
+  }
+
+  double uptime_s() const {
     return std::chrono::duration<double>(std::chrono::steady_clock::now() -
                                          start_)
         .count();
   }
-  double send_rate() const {
-    double e = elapsed_secs();
-    return e > 0 ? static_cast<double>(messages_sent) / e : 0;
+
+  // Sum of every series of `name` across its labels (Python twin:
+  // Registry.counter_value with no label filter).
+  double counter_total(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    double total = 0;
+    const std::string prefix = name + "{";
+    for (const auto& [k, v] : counters_)
+      if (k == name || k.compare(0, prefix.size(), prefix) == 0) total += v;
+    return total;
   }
-  double recv_rate() const {
-    double e = elapsed_secs();
-    return e > 0 ? static_cast<double>(messages_received) / e : 0;
-  }
-  double bandwidth_sent_kbps() const {
-    double e = elapsed_secs();
-    return e > 0 ? static_cast<double>(bytes_sent) * 8.0 / (e * 1000.0) : 0;
-  }
-  double bandwidth_recv_kbps() const {
-    double e = elapsed_secs();
-    return e > 0 ? static_cast<double>(bytes_received) * 8.0 / (e * 1000.0)
-                 : 0;
-  }
-  std::string to_string() const {
+
+  // The operator-facing network rollup (managers' `metrics` command),
+  // derived from the same bus.* counters the beacons publish — the CLI
+  // print and fleet_top cannot disagree (Python twin:
+  // Registry.network_summary).
+  std::string network_summary_string() {
+    double e = uptime_s();
+    double ms = counter_total("bus.msgs_sent");
+    double mr = counter_total("bus.msgs_received");
+    double bs = counter_total("bus.bytes_sent");
+    double br = counter_total("bus.bytes_received");
     char buf[512];
     snprintf(buf, sizeof(buf),
              "\U0001F4E1 Network Communication Stats:\n"
-             "├─ Messages sent: %llu (%.1f msg/s)\n"
-             "├─ Messages received: %llu (%.1f msg/s)\n"
+             "├─ Messages sent: %.0f (%.1f msg/s)\n"
+             "├─ Messages received: %.0f (%.1f msg/s)\n"
              "├─ Bandwidth sent: %.2f KB (%.1f kbps)\n"
              "├─ Bandwidth received: %.2f KB (%.1f kbps)\n"
              "└─ Duration: %.1fs",
-             static_cast<unsigned long long>(messages_sent), send_rate(),
-             static_cast<unsigned long long>(messages_received), recv_rate(),
-             static_cast<double>(bytes_sent) / 1024.0, bandwidth_sent_kbps(),
-             static_cast<double>(bytes_received) / 1024.0,
-             bandwidth_recv_kbps(), elapsed_secs());
+             ms, e > 0 ? ms / e : 0.0, mr, e > 0 ? mr / e : 0.0,
+             bs / 1024.0, e > 0 ? bs * 8.0 / (e * 1000.0) : 0.0,
+             br / 1024.0, e > 0 ? br * 8.0 / (e * 1000.0) : 0.0, e);
     return buf;
   }
 
-  uint64_t messages_sent = 0, messages_received = 0;
-  uint64_t bytes_sent = 0, bytes_received = 0;
+  // Same schema as Registry.snapshot() on the Python side: the beacon body.
+  Json snapshot_json() {
+    std::lock_guard<std::mutex> lk(mu_);
+    // force Object type: a default Json is Null, and an empty section must
+    // serialize as {} (the Python aggregator's schema), not null
+    Json counters{JsonObject{}}, gauges{JsonObject{}}, hists{JsonObject{}};
+    for (const auto& [k, v] : counters_) counters.set(k, Json(v));
+    for (const auto& [k, v] : gauges_) gauges.set(k, Json(v));
+    for (const auto& [k, h] : hists_) {
+      Json jh, bounds, counts;
+      for (double b : default_ms_buckets()) bounds.push_back(Json(b));
+      for (uint64_t c : h.counts)
+        counts.push_back(Json(static_cast<int64_t>(c)));
+      jh.set("buckets", bounds)
+          .set("counts", counts)
+          .set("sum", Json(h.sum))
+          .set("count", Json(static_cast<int64_t>(h.count)));
+      hists.set(k, jh);
+    }
+    Json out;
+    out.set("uptime_s", Json(uptime_s()))
+        .set("counters", counters)
+        .set("gauges", gauges)
+        .set("hists", hists);
+    return out;
+  }
+
+  // Prometheus text exposition (parity with Registry.expose_text; dots in
+  // names become underscores, labels pass through).
+  std::string expose_text() {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::ostringstream out;
+    auto prom = [](const std::string& k) {
+      std::string name = k, labels;
+      size_t brace = k.find('{');
+      if (brace != std::string::npos) {
+        name = k.substr(0, brace);
+        labels = k.substr(brace);
+      }
+      for (size_t i = 0; i < name.size(); ++i) {
+        char& c = name[i];
+        // digits only past position 0, matching registry.py _prom_name
+        if (!(isalpha(static_cast<unsigned char>(c)) || c == '_' ||
+              c == ':' ||
+              (isdigit(static_cast<unsigned char>(c)) && i > 0)))
+          c = '_';
+      }
+      return std::make_pair(name, labels);
+    };
+    for (const auto& [k, v] : counters_) {
+      auto [n, l] = prom(k);
+      out << "# TYPE " << n << " counter\n" << n << l << ' ' << v << '\n';
+    }
+    for (const auto& [k, v] : gauges_) {
+      auto [n, l] = prom(k);
+      out << "# TYPE " << n << " gauge\n" << n << l << ' ' << v << '\n';
+    }
+    for (const auto& [k, h] : hists_) {
+      auto [n, l] = prom(k);
+      out << "# TYPE " << n << " histogram\n";
+      uint64_t cum = 0;
+      std::string base = l.empty() ? "" : l.substr(1, l.size() - 2);
+      for (size_t i = 0; i < default_ms_buckets().size(); ++i) {
+        cum += h.counts[i];
+        out << n << "_bucket{" << (base.empty() ? "" : base + ",")
+            << "le=\"" << default_ms_buckets()[i] << "\"} " << cum << '\n';
+      }
+      out << n << "_bucket{" << (base.empty() ? "" : base + ",")
+          << "le=\"+Inf\"} " << h.count << '\n';
+      out << n << "_sum" << l << ' ' << h.sum << '\n';
+      out << n << "_count" << l << ' ' << h.count << '\n';
+    }
+    return out.str();
+  }
 
  private:
+  struct Hist {
+    std::vector<uint64_t> counts;
+    double sum = 0;
+    uint64_t count = 0;
+  };
+  MetricsRegistry() : start_(std::chrono::steady_clock::now()) {}
+  std::map<std::string, double> counters_;
+  std::map<std::string, double> gauges_;
+  std::map<std::string, Hist> hists_;
   std::chrono::steady_clock::time_point start_;
+  std::mutex mu_;
 };
+
+inline void metrics_count(const std::string& name, double n = 1,
+                          const std::string& labels = "") {
+  MetricsRegistry::instance().count(name, n, labels);
+}
+
+inline void metrics_gauge(const std::string& name, double v,
+                          const std::string& labels = "") {
+  MetricsRegistry::instance().gauge(name, v, labels);
+}
+
+inline void metrics_observe(const std::string& name, double v,
+                            const std::string& labels = "") {
+  MetricsRegistry::instance().observe(name, v, labels);
+}
+
+// The one beacon-payload constructor (schema: obs/beacon.py) — used by
+// BusClient::maybe_publish_beacon AND busd's in-hub beacon, so the schema
+// cannot diverge between the hub and its clients.
+inline Json make_metrics_beacon(const std::string& peer_id,
+                                const std::string& proc, double interval_s) {
+  Json b;
+  b.set("type", "metrics_beacon")
+      .set("peer_id", peer_id)
+      .set("proc", proc)
+      .set("pid", static_cast<int64_t>(getpid()))
+      .set("ts_ms",
+           static_cast<int64_t>(
+               std::chrono::duration_cast<std::chrono::milliseconds>(
+                   std::chrono::system_clock::now().time_since_epoch())
+                   .count()))
+      .set("interval_s", interval_s)
+      .set("metrics", MetricsRegistry::instance().snapshot_json());
+  return b;
+}
+
+// (The old NetworkMetrics store lived here; bus accounting now has ONE
+// store — MetricsRegistry — and the operator print is
+// network_summary_string() above, exactly as the Python side's
+// registry.network_summary() replaced task_metrics.NetworkMetrics.)
 
 }  // namespace mapd

@@ -131,6 +131,9 @@ int main(int argc, char** argv) {
   // agents re-announce themselves on their own reconnect, so tracking
   // repopulates within a heartbeat
   bus.set_reconnect([]() {});
+  // live-metrics beacon: registry snapshot on mapd.metrics every ~2 s
+  // (fleet_top / obs.fleet_aggregator merge it with the Python peers')
+  bus.enable_metrics_beacon("manager_centralized");
   log_info("🧠 centralized manager %s up (grid %dx%d, solver=%s%s)\n",
            my_id.c_str(), grid.width, grid.height, solver.c_str(),
            clean ? ", clean" : "");
@@ -505,7 +508,10 @@ int main(int argc, char** argv) {
       log_info("%s\n", task_metrics.statistics().to_string().c_str());
       if (auto ps = path_metrics.statistics())
         log_info("%s\n", ps->to_string().c_str());
-      log_info("%s\n", bus.net_metrics().to_string().c_str());
+      log_info("%s\n",
+               MetricsRegistry::instance().network_summary_string().c_str());
+      // live registry dump (Prometheus text): ticks, cache, per-topic bytes
+      log_info("%s", MetricsRegistry::instance().expose_text().c_str());
     } else if (cmd == "save") {
       std::string a, b;
       in >> a >> b;
@@ -618,9 +624,14 @@ int main(int argc, char** argv) {
                 static_cast<uint64_t>(d["task_id"].as_int()),
                 d["timestamp_ms"].as_int());
           } else if (type == "task_metric_completed") {
-            task_metrics.update_completed(
-                static_cast<uint64_t>(d["task_id"].as_int()),
-                d["timestamp_ms"].as_int());
+            const uint64_t tid = static_cast<uint64_t>(d["task_id"].as_int());
+            task_metrics.update_completed(tid, d["timestamp_ms"].as_int());
+            // live task-latency histogram for the fleet rollup (beacons)
+            auto itm = task_metrics.metrics.find(tid);
+            if (itm != task_metrics.metrics.end())
+              if (auto t = itm->second.total_time())
+                metrics_observe("task.total_time_ms",
+                                static_cast<double>(*t));
           } else if (d["status"].as_str() == "done") {
             const std::string& peer = m.from;
             const long long tid = d["task_id"].as_int();
@@ -701,6 +712,7 @@ int main(int argc, char** argv) {
       Span sp("manager.plan_tick",
               "\"agents\":" + std::to_string(agents.size()));
       trace_count("manager.plan_ticks");
+      auto tick_t0 = std::chrono::steady_clock::now();
       last_plan = now;
       pickup_transitions();
       if (!agents.empty()) {
@@ -724,6 +736,18 @@ int main(int argc, char** argv) {
           plan_native();
         }
       }
+      // live tick accounting (registry, always on): p50/p95 vs the
+      // planning budget in the fleet rollup.  In tpu mode this covers
+      // only the host-side encode — the daemon's own tick_ms rides its
+      // beacon — so the number is honest either way.
+      double tick_ms_taken =
+          std::chrono::duration<double, std::milli>(
+              std::chrono::steady_clock::now() - tick_t0)
+              .count();
+      metrics_observe("tick_ms", tick_ms_taken);
+      if (tick_ms_taken > static_cast<double>(planning_ms))
+        metrics_count("tick.over_budget");
+      metrics_gauge("tick.agents", static_cast<double>(agents.size()));
     }
     if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;

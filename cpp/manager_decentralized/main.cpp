@@ -102,6 +102,7 @@ int main(int argc, char** argv) {
   // survive a bus restart (reconnect + resubscribe inside BusClient);
   // agents re-announce position+goal on their own reconnect
   bus.set_reconnect([]() {});
+  bus.enable_metrics_beacon("manager_decentralized");
   log_info("🧠 decentralized manager %s up (grid %dx%d)\n", my_id.c_str(),
            grid.width, grid.height);
   log_info("Commands: task | tasks N | metrics | save <file> | "
@@ -240,7 +241,10 @@ int main(int argc, char** argv) {
       log_info("%s\n", task_metrics.statistics().to_string().c_str());
       if (auto ps = path_metrics.statistics())
         log_info("%s\n", ps->to_string().c_str());
-      log_info("%s\n", bus.net_metrics().to_string().c_str());
+      log_info("%s\n",
+               MetricsRegistry::instance().network_summary_string().c_str());
+      // live registry dump (Prometheus text): ticks, tasks, per-topic bytes
+      log_info("%s", MetricsRegistry::instance().expose_text().c_str());
     } else if (cmd == "save") {
       std::string a, b;
       in >> a >> b;
@@ -404,9 +408,14 @@ int main(int argc, char** argv) {
                 static_cast<uint64_t>(d["task_id"].as_int()),
                 d["timestamp_ms"].as_int());
           } else if (type == "task_metric_completed") {
-            task_metrics.update_completed(
-                static_cast<uint64_t>(d["task_id"].as_int()),
-                d["timestamp_ms"].as_int());
+            const uint64_t tid = static_cast<uint64_t>(d["task_id"].as_int());
+            task_metrics.update_completed(tid, d["timestamp_ms"].as_int());
+            // live task-latency histogram for the fleet rollup (beacons)
+            auto itm = task_metrics.metrics.find(tid);
+            if (itm != task_metrics.metrics.end())
+              if (auto t = itm->second.total_time())
+                metrics_observe("task.total_time_ms",
+                                static_cast<double>(*t));
           } else if (type == "path_metric") {
             path_metrics.record_micros(d["duration_micros"].as_int(),
                                        d["timestamp_ms"].as_int());

@@ -1,8 +1,18 @@
-"""Runtime observability: span tracer (obs.trace), per-tick heartbeat
-(obs.heartbeat).  Enabled with JG_TRACE=1; near-zero-cost when off.  The
-C++ host runtime mirrors the span schema in cpp/common/trace.hpp; merged
-reports come from analysis/trace_report.py."""
+"""Runtime observability: unified live-metrics registry (obs.registry),
+span tracer (obs.trace), per-tick heartbeat (obs.heartbeat), metrics
+beacons (obs.beacon), and manager-side fleet aggregation
+(obs.fleet_aggregator).
 
+Counters/gauges/histograms are ALWAYS on (one dict op each) and flow into
+every read side — Prometheus ``/metrics`` (JG_METRICS_PORT), the periodic
+``mapd.metrics`` bus beacon, stats dumps, and trace-file counter events.
+Span tracing stays gated by JG_TRACE=1 (near-zero cost off).  The C++ host
+runtime mirrors the span schema in cpp/common/trace.hpp and the registry +
+beacon in cpp/common/metrics.hpp / bus.hpp; merged trace reports come from
+analysis/trace_report.py, the live fleet view from analysis/fleet_top.py.
+"""
+
+from p2p_distributed_tswap_tpu.obs import registry  # noqa: F401
 from p2p_distributed_tswap_tpu.obs import trace  # noqa: F401
 from p2p_distributed_tswap_tpu.obs.heartbeat import (  # noqa: F401
     TICK_BUDGET_MS,

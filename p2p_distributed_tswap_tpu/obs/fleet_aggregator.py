@@ -1,0 +1,210 @@
+"""Manager-side fleet aggregation: merge per-peer metrics beacons.
+
+Consumes ``metrics_beacon`` payloads (obs/beacon.py, topic
+``mapd.metrics``) from every process in the fleet — Python solverd, the
+C++ managers/agents (cpp/common/bus.hpp mirror), busd — and derives the
+operator-facing rollup ``analysis/fleet_top.py`` renders:
+
+- per-peer and per-topic bandwidth (wire bytes; rates from the delta
+  between consecutive beacons, falling back to the cumulative average
+  while only one beacon has arrived);
+- tick p50/p95 vs the 500 ms planning budget (``tick_ms`` histogram +
+  ``tick.over_budget`` counter, published by solverd's TickRunner and the
+  centralized manager's planning tick);
+- field-cache hit/recompile rates (solverd counters);
+- task-latency percentiles (``task.total_time_ms`` histogram, manager);
+- last-seen staleness: a peer whose beacon is older than 3 of its OWN
+  advertised beacon intervals (payload ``interval_s``; ``stale_after_s``
+  is the fallback for beacons without it) is flagged ``stale`` —
+  wedged-but-alive processes surface here, complementing
+  runtime/fleet.py's exit-code capture of processes that died outright.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from p2p_distributed_tswap_tpu.obs.beacon import BEACON_INTERVAL_S
+from p2p_distributed_tswap_tpu.obs.registry import hist_quantile, parse_key
+
+STALE_AFTER_S = 3 * BEACON_INTERVAL_S
+
+
+def _now_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+def counter_total(snapshot: dict, name: str) -> float:
+    """Sum every series of ``name`` in a beacon snapshot.  Sections may be
+    null rather than absent (a foreign emitter with nothing recorded yet),
+    hence ``or {}`` throughout."""
+    return sum(v for k, v in (snapshot.get("counters") or {}).items()
+               if parse_key(k)[0] == name)
+
+
+def counters_by_label(snapshot: dict, name: str, label: str
+                      ) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, v in (snapshot.get("counters") or {}).items():
+        n, labels = parse_key(k)
+        if n == name:
+            key = labels.get(label, "")
+            out[key] = out.get(key, 0.0) + v
+    return out
+
+
+def find_hist(snapshot: dict, name: str) -> Optional[dict]:
+    """First histogram series of ``name`` (merged across labels if several
+    share bucket bounds)."""
+    merged: Optional[dict] = None
+    for k, h in (snapshot.get("hists") or {}).items():
+        if parse_key(k)[0] != name:
+            continue
+        if merged is None:
+            merged = {"buckets": list(h["buckets"]),
+                      "counts": list(h["counts"]),
+                      "sum": h["sum"], "count": h["count"]}
+        elif merged["buckets"] == h["buckets"]:
+            merged["counts"] = [a + b for a, b in zip(merged["counts"],
+                                                      h["counts"])]
+            merged["sum"] += h["sum"]
+            merged["count"] += h["count"]
+    return merged
+
+
+class _PeerState:
+    __slots__ = ("payload", "last_seen_ms", "prev_metrics", "prev_ts_ms")
+
+    def __init__(self):
+        self.payload: dict = {}
+        self.last_seen_ms = 0
+        self.prev_metrics: Optional[dict] = None
+        self.prev_ts_ms = 0
+
+
+class FleetAggregator:
+    """Merge beacons into a live fleet rollup."""
+
+    def __init__(self, budget_ms: float = 500.0,
+                 stale_after_s: float = STALE_AFTER_S):
+        self.budget_ms = budget_ms
+        self.stale_after_s = stale_after_s
+        self._peers: Dict[str, _PeerState] = {}
+        self.beacons_ingested = 0
+
+    def ingest(self, payload: dict, now_ms: Optional[int] = None) -> bool:
+        """Feed one bus message's data dict; non-beacons are ignored
+        (returns False)."""
+        if not isinstance(payload, dict) \
+                or payload.get("type") != "metrics_beacon":
+            return False
+        peer = str(payload.get("peer_id") or payload.get("proc") or "?")
+        st = self._peers.get(peer)
+        if st is None:
+            st = self._peers[peer] = _PeerState()
+        else:
+            st.prev_metrics = st.payload.get("metrics")
+            st.prev_ts_ms = st.last_seen_ms
+        st.payload = payload
+        st.last_seen_ms = _now_ms() if now_ms is None else now_ms
+        self.beacons_ingested += 1
+        return True
+
+    # -- derivations ------------------------------------------------------
+    def _rates(self, st: _PeerState) -> dict:
+        cur = st.payload.get("metrics") or {}
+        sent = counter_total(cur, "bus.bytes_sent")
+        recv = counter_total(cur, "bus.bytes_received")
+        if st.prev_metrics is not None and st.last_seen_ms > st.prev_ts_ms:
+            dt = (st.last_seen_ms - st.prev_ts_ms) / 1000.0
+            d_sent = sent - counter_total(st.prev_metrics, "bus.bytes_sent")
+            d_recv = recv - counter_total(st.prev_metrics,
+                                          "bus.bytes_received")
+        else:  # single beacon so far: cumulative average over uptime
+            dt = max(cur.get("uptime_s", 0.0), 1e-9)
+            d_sent, d_recv = sent, recv
+        return {
+            "bytes_sent": int(sent),
+            "bytes_received": int(recv),
+            "msgs_sent": int(counter_total(cur, "bus.msgs_sent")),
+            "msgs_received": int(counter_total(cur, "bus.msgs_received")),
+            "sent_kbps": round(max(0.0, d_sent) * 8.0 / (dt * 1000.0), 3),
+            "recv_kbps": round(max(0.0, d_recv) * 8.0 / (dt * 1000.0), 3),
+            "by_topic_sent_bytes": {
+                k: int(v) for k, v in
+                counters_by_label(cur, "bus.bytes_sent", "topic").items()},
+        }
+
+    def _peer_rollup(self, st: _PeerState, now_ms: int) -> dict:
+        p = st.payload
+        m = p.get("metrics") or {}
+        age_s = max(0.0, (now_ms - st.last_seen_ms) / 1000.0)
+        # staleness paces against the peer's OWN advertised cadence (a peer
+        # beaconing every 10 s is healthy at age 8 s); the constructor
+        # threshold covers payloads that do not carry interval_s
+        interval = p.get("interval_s")
+        stale_after = (3.0 * interval
+                       if isinstance(interval, (int, float)) and interval > 0
+                       else self.stale_after_s)
+        tick_hist = find_hist(m, "tick_ms")
+        hits = counter_total(m, "solverd.field_cache_hits")
+        misses = counter_total(m, "solverd.field_cache_misses")
+        task_hist = find_hist(m, "task.total_time_ms")
+        out = {
+            "proc": p.get("proc", "?"),
+            "pid": p.get("pid"),
+            "last_seen_ms": st.last_seen_ms,
+            "age_s": round(age_s, 3),
+            "stale": age_s > stale_after,
+            "uptime_s": m.get("uptime_s"),
+            "bandwidth": self._rates(st),
+            "tick": None,
+            "cache": None,
+            "tasks": None,
+        }
+        if tick_hist and tick_hist["count"]:
+            out["tick"] = {
+                "count": tick_hist["count"],
+                "p50_ms": round(hist_quantile(tick_hist, 0.5), 3),
+                "p95_ms": round(hist_quantile(tick_hist, 0.95), 3),
+                "budget_ms": self.budget_ms,
+                "over_budget": int(counter_total(m, "tick.over_budget")),
+            }
+        if hits or misses:
+            out["cache"] = {
+                "hits": int(hits),
+                "misses": int(misses),
+                "hit_rate": round(hits / (hits + misses), 4),
+                "recompiles": int(counter_total(m, "solverd.recompiles")),
+            }
+        if task_hist and task_hist["count"]:
+            out["tasks"] = {
+                "completed": task_hist["count"],
+                "latency_p50_ms": round(hist_quantile(task_hist, 0.5), 1),
+                "latency_p95_ms": round(hist_quantile(task_hist, 0.95), 1),
+            }
+        return out
+
+    def rollup(self, now_ms: Optional[int] = None) -> dict:
+        """The fleet-wide snapshot fleet_top renders / dumps as JSON."""
+        now_ms = _now_ms() if now_ms is None else now_ms
+        peers = {peer: self._peer_rollup(st, now_ms)
+                 for peer, st in sorted(self._peers.items())}
+        ticks = [p["tick"] for p in peers.values() if p["tick"]]
+        return {
+            "ts_ms": now_ms,
+            "budget_ms": self.budget_ms,
+            "beacons_ingested": self.beacons_ingested,
+            "peers": peers,
+            "fleet": {
+                "peers": len(peers),
+                "stale_peers": sum(1 for p in peers.values() if p["stale"]),
+                "bytes_sent": sum(p["bandwidth"]["bytes_sent"]
+                                  for p in peers.values()),
+                "bytes_received": sum(p["bandwidth"]["bytes_received"]
+                                      for p in peers.values()),
+                "ticks": sum(t["count"] for t in ticks),
+                "ticks_over_budget": sum(t["over_budget"] for t in ticks),
+            },
+        }

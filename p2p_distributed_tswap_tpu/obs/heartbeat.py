@@ -14,6 +14,12 @@ Schema (all times ms):
 Writers are cheap enough to leave on whenever tracing is on: one dict, one
 ``json.dumps``, one buffered write per tick.  The file is line-buffered so
 ``tail -f`` sees ticks as they land.
+
+The LIVE budget accounting (tick_ms histogram, tick.over_budget counter)
+lives in the unified registry (obs/registry.py), written by
+``TickRunner.handle`` whether or not a heartbeat file is open — this
+writer's instance counters only feed the sidecar lines and the stats dump's
+``over_budget_ticks`` convenience field.
 """
 
 from __future__ import annotations

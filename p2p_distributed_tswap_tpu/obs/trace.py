@@ -32,6 +32,14 @@ carries its parent span name in ``args.parent`` so the report tool can
 attribute child phases to their tick without relying on timestamp
 containment alone.
 
+Counters and gauges are NOT stored here anymore: :func:`count` and
+:func:`gauge` delegate to the unified live-metrics registry
+(obs/registry.py) unconditionally — they are live telemetry (beacons,
+/metrics, stats dumps) and cost one dict op whether or not tracing is on.
+Only the *span/event* side stays gated by ``JG_TRACE``; when tracing is
+enabled, the registry's counters additionally ride the trace file as
+Chrome counter ("C") events on every flush, exactly as before.
+
 Environment:
   JG_TRACE=1        enable tracing
   JG_TRACE_DIR=DIR  where trace/heartbeat files land (default results/trace)
@@ -44,7 +52,9 @@ import json
 import os
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Iterator, Optional
+
+from p2p_distributed_tswap_tpu.obs import registry as _registry
 
 DEFAULT_CAPACITY = 65536
 DEFAULT_DIR = "results/trace"
@@ -112,8 +122,8 @@ class Tracer:
             maxlen=capacity)
         self._lock = threading.Lock()
         self._local = threading.local()
-        self.counters: Dict[str, int] = {}
-        self.gauges: Dict[str, float] = {}
+        # counters/gauges live in the unified registry (obs/registry.py)
+        self.registry = _registry.get_registry()
         # wall-clock anchor: ts_us = anchor + monotonic delta (see module doc)
         self._mono0 = time.perf_counter_ns()
         self._anchor_us = time.time_ns() // 1000
@@ -155,26 +165,20 @@ class Tracer:
         with self._lock:
             self._events.append(ev)
 
-    # -- counters / gauges ------------------------------------------------
+    # -- counters / gauges (live metrics: ALWAYS on, see module doc) ------
     def count(self, name: str, n: int = 1) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + n
+        self.registry.count(name, n)
 
     def gauge(self, name: str, value: float) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            self.gauges[name] = value
+        self.registry.gauge(name, value)
 
     def snapshot(self) -> dict:
         """Machine-readable point-in-time state (stats dumps, heartbeats)."""
         with self._lock:
             return {"proc": self.proc, "pid": self.pid,
                     "ts_ms": time.time_ns() // 1_000_000,
-                    "counters": dict(self.counters),
-                    "gauges": dict(self.gauges),
+                    "counters": self.registry.counters_flat(),
+                    "gauges": self.registry.gauges_flat(),
                     "buffered_events": len(self._events)}
 
     # -- export -----------------------------------------------------------
@@ -182,12 +186,14 @@ class Tracer:
         with self._lock:
             evs = list(self._events)
             self._events.clear()
-            # counters ride along as Chrome counter ("C") events so the
-            # merged timeline carries them without a side channel
+            # registry counters ride along as Chrome counter ("C") events so
+            # the merged timeline carries them without a side channel
             ts = self._ts_us(time.perf_counter_ns())
-            for cname, v in self.counters.items():
+            for cname, v in self.registry.counters_flat().items():
                 evs.append({"name": cname, "ph": "C", "ts": ts,
-                            "pid": self.pid, "args": {"value": v}})
+                            "pid": self.pid,
+                            "args": {"value": int(v) if float(v).is_integer()
+                                     else v}})
         return evs
 
     def jsonl_lines(self) -> Iterator[str]:
@@ -236,11 +242,14 @@ def configure(enabled: Optional[bool] = None, proc: Optional[str] = None,
               capacity: int = DEFAULT_CAPACITY) -> Tracer:
     """(Re)build the global tracer — call once at process entry (daemons
     pass their role name so flush files are self-identifying) or from tests.
-    Passing ``enabled=None`` re-reads JG_TRACE."""
+    Passing ``enabled=None`` re-reads JG_TRACE.  The process registry is
+    cleared too: configure marks a fresh observation epoch (process entry,
+    or test isolation)."""
     global _tracer
     with _config_lock:
         _tracer = Tracer(proc=proc or _tracer.proc, enabled=enabled,
                          capacity=capacity)
+        _registry.get_registry().clear()
     return _tracer
 
 

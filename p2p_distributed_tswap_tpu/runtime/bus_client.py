@@ -12,6 +12,14 @@ lossy broadcast medium) and ``recv`` behaves like a timeout.  The
 reference's brokerless gossipsub mesh has no hub to lose — with this,
 losing busd degrades the fleet instead of destroying it (VERDICT r2
 item 5).
+
+Network accounting lives in the unified live-metrics registry
+(obs/registry.py): per-topic ``bus.msgs_sent`` / ``bus.bytes_sent`` /
+``bus.msgs_received`` / ``bus.bytes_received`` counters, counting ACTUAL
+wire bytes (the framed line including its newline — the pre-registry
+NetworkMetrics counted the unframed line, so py and cpp bandwidth numbers
+disagreed by one byte per message).  ``registry.network_summary()`` is the
+rolled-up view; the ``mapd.metrics`` beacon ships the raw counters.
 """
 
 from __future__ import annotations
@@ -21,7 +29,7 @@ import socket
 import time
 from typing import Callable, Iterator, Optional
 
-from p2p_distributed_tswap_tpu.metrics.task_metrics import NetworkMetrics
+from p2p_distributed_tswap_tpu.obs import registry as _reg
 from p2p_distributed_tswap_tpu.obs import trace
 
 
@@ -29,7 +37,8 @@ class BusClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 7400,
                  peer_id: Optional[str] = None, timeout: float = 5.0,
                  reconnect: bool = False,
-                 on_reconnect: Optional[Callable[[], None]] = None):
+                 on_reconnect: Optional[Callable[[], None]] = None,
+                 registry: Optional[_reg.Registry] = None):
         self.peer_id = peer_id or f"py-{int(time.time() * 1000) % 10 ** 10}"
         self._host, self._port, self._timeout = host, port, timeout
         self._reconnect = reconnect
@@ -38,7 +47,9 @@ class BusClient:
         self._backoff = 0.0
         self._next_attempt = 0.0
         self.sock: Optional[socket.socket] = None
-        self.net = NetworkMetrics()
+        # network accounting sink: the process registry unless a test
+        # injects its own (obs/registry.py is the single source of truth)
+        self.registry = registry or _reg.get_registry()
         self._connect()  # initial connect still raises: startup contract
 
     # -- connection management -------------------------------------------
@@ -118,12 +129,13 @@ class BusClient:
         if self.sock is None:
             return  # dropped frames are NOT counted as sent (matches C++)
         try:
-            self.sock.sendall((line + "\n").encode())
-            self.net.record_sent(len(line))
-            trace.count("bus.msgs_sent")
-            trace.count("bus.bytes_sent", len(line))
+            wire = (line + "\n").encode()
+            self.sock.sendall(wire)
+            # count ACTUAL wire bytes (framed line + newline), per topic
+            self.registry.count("bus.msgs_sent", topic=topic)
+            self.registry.count("bus.bytes_sent", len(wire), topic=topic)
         except OSError:
-            trace.count("bus.send_drops")
+            self.registry.count("bus.send_drops")
             self._drop()
 
     def query_peers(self, topic: str) -> None:
@@ -156,9 +168,11 @@ class BusClient:
                 except json.JSONDecodeError:
                     continue
                 if frame.get("op") == "msg":
-                    self.net.record_received(len(line))
-                    trace.count("bus.msgs_received")
-                    trace.count("bus.bytes_received", len(line))
+                    # wire bytes: the framed line plus its newline
+                    topic = frame.get("topic", "")
+                    self.registry.count("bus.msgs_received", topic=topic)
+                    self.registry.count("bus.bytes_received", len(line) + 1,
+                                        topic=topic)
                 return frame
             try:
                 self.sock.settimeout(

@@ -203,6 +203,8 @@ def main(argv=None) -> int:
                args.log_dir) as fleet:
         print(f"fleet up: {args.mode}, {args.agents} agents, "
               f"bus port {args.port}; logs in {args.log_dir}")
+        print(f"   live view: python analysis/fleet_top.py "
+              f"--port {args.port}   (beacons on bus topic mapd.metrics)")
         time.sleep(3 + args.agents * 0.2)
         end = time.monotonic() + args.duration
         while time.monotonic() < end:

@@ -59,7 +59,9 @@ import numpy as np
 
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
-from p2p_distributed_tswap_tpu.obs import HeartbeatWriter, trace
+from p2p_distributed_tswap_tpu.obs import HeartbeatWriter, registry, trace
+from p2p_distributed_tswap_tpu.obs.beacon import MetricsBeacon
+from p2p_distributed_tswap_tpu.obs.heartbeat import TICK_BUDGET_MS
 from p2p_distributed_tswap_tpu.ops.distance import (
     PACKED_STAY,
     direction_fields,
@@ -228,12 +230,15 @@ class TickRunner:
     line, and the on-demand stats snapshot (SIGUSR1 / bus stats_request)."""
 
     def __init__(self, service: PlanService, grid: Grid,
-                 heartbeat: Optional[HeartbeatWriter] = None):
+                 heartbeat: Optional[HeartbeatWriter] = None,
+                 budget_ms: float = TICK_BUDGET_MS):
         self.service = service
         self.grid = grid
         self.heartbeat = heartbeat
+        self.budget_ms = budget_ms
         self.ticks = 0
         self.dropped_total = 0
+        self.registry = registry.get_registry()
 
     def handle(self, data: dict) -> Optional[dict]:
         """plan_request dict -> plan_response dict (None for empty fleets)."""
@@ -265,11 +270,18 @@ class TickRunner:
                 }
             t_end = time.perf_counter()
         self.ticks += 1
+        total_ms = 1000.0 * (t_end - t0)
+        # live tick accounting (always on): the fleet rollup's per-peer
+        # tick p50/p95 vs the 500 ms budget comes from this histogram
+        self.registry.observe("tick_ms", total_ms)
+        if total_ms > self.budget_ms:
+            self.registry.count("tick.over_budget")
+        self.registry.gauge("tick.agents", len(agents))
         if self.heartbeat is not None:
             phase_ms = dict(self.service.last_phase_ms)
             phase_ms["decode"] = 1000.0 * (t_dec - t0)
             phase_ms["encode"] = 1000.0 * (t_end - t_plan)
-            phase_ms["total"] = 1000.0 * (t_end - t0)
+            phase_ms["total"] = total_ms
             self.heartbeat.beat(seq, len(agents), phase_ms,
                                 counters=trace.snapshot()["counters"])
             trace.flush()
@@ -294,6 +306,10 @@ class TickRunner:
         if self.heartbeat is not None:
             snap["service"]["over_budget_ticks"] = \
                 self.heartbeat.over_budget_ticks
+        # bandwidth snapshot (ISSUE 2 satellite): the registry is the single
+        # source for bus accounting, so SIGUSR1 / stats_request dumps carry
+        # the same wire-byte numbers the metrics beacons publish
+        snap["network"] = self.registry.network_summary()
         return snap
 
 
@@ -363,6 +379,14 @@ def main(argv=None) -> int:
               f"(+ heartbeat sidecar)", flush=True)
     runner = TickRunner(service, grid, heartbeat=heartbeat)
 
+    # live-metrics plane: optional HTTP /metrics (JG_METRICS_PORT) and the
+    # periodic registry beacon on bus topic mapd.metrics (fleet_top reads it)
+    http_srv = registry.maybe_serve_http()
+    if http_srv is not None:
+        print(f"📡 /metrics on http://127.0.0.1:{http_srv.server_port}",
+              flush=True)
+    beacon = MetricsBeacon(bus, proc="solverd")
+
     # SIGUSR1 = operator stats dump: signal handlers only flip a flag (the
     # handler can interrupt the plan path mid-tick, where a full dump
     # would not be re-entrant); the loop below dumps between frames.
@@ -381,6 +405,7 @@ def main(argv=None) -> int:
 
     while True:
         frame = bus.recv(timeout=1.0)
+        beacon.maybe_beat()  # ~2 s cadence riding the 1 s recv timeout
         if stats_requested["flag"]:
             stats_requested["flag"] = False
             dump_stats()

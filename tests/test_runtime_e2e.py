@@ -854,6 +854,51 @@ def test_lost_done_retransmitted_and_counted_once(built, tiny_map, tmp_path,
                 new_bus.kill()
 
 
+def test_fleet_metrics_beacons_and_fleet_top(built, tiny_map, tmp_path):
+    """ISSUE 2 acceptance: with a fleet running (busd + centralized manager
+    + solverd + agents), every process beacons its live-metrics registry on
+    bus topic ``mapd.metrics`` and ``fleet_top --once --json`` returns a
+    rollup with >= 2 peers carrying tick/bandwidth/cache fields."""
+    import sys
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    with Fleet("centralized", num_agents=2, port=port, map_file=tiny_map,
+               solver="tpu", log_dir=str(log_dir),
+               solverd_args=["--cpu"]) as fleet:
+        time.sleep(4)  # discovery + initial positions
+        fleet.command("tasks 2")
+        time.sleep(4)  # let planning ticks + a beacon interval elapse
+        top = subprocess.run(
+            [sys.executable, "analysis/fleet_top.py", "--port", str(port),
+             "--once", "--json", "--wait", "6"],
+            capture_output=True, text=True, timeout=120,
+            cwd=str(Path(__file__).resolve().parents[1]))
+        fleet.quit()
+    assert top.returncode == 0, top.stderr + top.stdout
+    rollup = json.loads(top.stdout)
+    peers = rollup["peers"]
+    assert rollup["fleet"]["peers"] >= 2, rollup
+    by_proc = {p["proc"]: p for p in peers.values()}
+    # the hub, the manager, and the solver daemon all appear in one rollup
+    # (C++ registry mirror and Python registry publish the same schema)
+    assert "busd" in by_proc, sorted(by_proc)
+    assert "manager_centralized" in by_proc, sorted(by_proc)
+    assert "solverd" in by_proc, sorted(by_proc)
+    for proc in ("busd", "manager_centralized", "solverd"):
+        assert by_proc[proc]["stale"] is False, by_proc[proc]
+    # per-peer tick percentiles vs the 500 ms budget, from live histograms
+    mgr = by_proc["manager_centralized"]
+    assert mgr["tick"] and mgr["tick"]["p95_ms"] is not None, mgr
+    assert mgr["tick"]["budget_ms"] == 500.0
+    sd = by_proc["solverd"]
+    assert sd["tick"] and sd["tick"]["p95_ms"] is not None, sd
+    # wire-byte bandwidth (the corrected framed counts) and cache rates
+    assert sd["bandwidth"]["bytes_sent"] > 0
+    assert mgr["bandwidth"]["bytes_sent"] > 0
+    assert sd["cache"] is not None and 0 <= sd["cache"]["hit_rate"] <= 1, sd
+
+
 def test_python_bus_client_reconnects(built):
     """The Python BusClient (solverd's transport) must also survive a busd
     restart: resubscribe and resume delivery (VERDICT r2 item 5)."""

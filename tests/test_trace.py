@@ -84,6 +84,10 @@ def test_thread_safety_no_cross_thread_leak(tracer):
 
 
 def test_disabled_mode_is_noop(tmp_path):
+    """Disabled tracing: spans/instants/flush are no-ops.  Counters and
+    gauges are LIVE metrics since the unified registry (obs/registry.py)
+    and keep counting either way — beacons and stats dumps must work
+    without JG_TRACE."""
     t = trace.configure(enabled=False, proc="test")
     null_span = trace.span("anything")
     assert trace.span("other") is null_span  # one shared object, no alloc
@@ -91,10 +95,12 @@ def test_disabled_mode_is_noop(tmp_path):
         trace.count("x")
         trace.gauge("g", 1.0)
         trace.instant("i")
-    assert t.snapshot()["counters"] == {}
-    assert t.snapshot()["buffered_events"] == 0
+    assert t.snapshot()["counters"] == {"x": 1}  # registry-backed, always on
+    assert t.snapshot()["gauges"] == {"g": 1.0}
+    assert t.snapshot()["buffered_events"] == 0  # the instant was dropped
     assert trace.flush(str(tmp_path / "t.jsonl")) is None
     assert not (tmp_path / "t.jsonl").exists()
+    trace.configure(enabled=False)  # fresh registry epoch for later tests
 
 
 def test_ring_buffer_bounded():
