@@ -459,8 +459,17 @@ int main(int argc, char** argv) {
       } else if (type == "swap_response") {
         if (d["to_peer"].as_str() != my_id) return;
         // only the exchange we actually have outstanding: a late or
-        // duplicate response must not clobber a newer assignment
-        if (!pending_swap || d["request_id"].as_str() != pending_swap->req_id)
+        // duplicate response must not clobber a newer assignment.  A
+        // LEGACY reference peer answers without echoing request_id
+        // (agent.rs:1117-1122) — it has already adopted the task we
+        // offered, so dropping its response would leave a duplicate
+        // holder and strand its own task until the 60 s sweep (ADVICE r5
+        // medium): when the field is absent, match on the peer we are
+        // actually mid-exchange with instead.
+        if (!pending_swap) return;
+        if (d.has("request_id")
+                ? d["request_id"].as_str() != pending_swap->req_id
+                : d["from_peer"].as_str() != pending_swap->target)
           return;
         pending_swap.reset();
         if (d["declined"].as_bool()) return;  // busy peer: retry next tick

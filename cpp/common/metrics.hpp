@@ -23,6 +23,7 @@
 #include <mutex>
 #include <numeric>
 #include <optional>
+#include <set>
 #include <sstream>
 #include <string>
 #include <vector>
@@ -426,17 +427,26 @@ class MetricsRegistry {
       }
       return std::make_pair(name, labels);
     };
+    // ONE "# TYPE" line per metric name (labeled series share it) — the
+    // Prometheus text format rejects duplicates; mirrors registry.py's
+    // `typed` set
+    std::set<std::string> typed;
+    auto type_line = [&](const std::string& n, const char* kind) {
+      if (typed.insert(n).second) out << "# TYPE " << n << ' ' << kind << '\n';
+    };
     for (const auto& [k, v] : counters_) {
       auto [n, l] = prom(k);
-      out << "# TYPE " << n << " counter\n" << n << l << ' ' << v << '\n';
+      type_line(n, "counter");
+      out << n << l << ' ' << v << '\n';
     }
     for (const auto& [k, v] : gauges_) {
       auto [n, l] = prom(k);
-      out << "# TYPE " << n << " gauge\n" << n << l << ' ' << v << '\n';
+      type_line(n, "gauge");
+      out << n << l << ' ' << v << '\n';
     }
     for (const auto& [k, h] : hists_) {
       auto [n, l] = prom(k);
-      out << "# TYPE " << n << " histogram\n";
+      type_line(n, "histogram");
       uint64_t cum = 0;
       std::string base = l.empty() ? "" : l.substr(1, l.size() - 2);
       for (size_t i = 0; i < default_ms_buckets().size(); ++i) {

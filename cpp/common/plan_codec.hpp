@@ -1,0 +1,369 @@
+// Packed binary plan codec ("packed1") — native mirror of
+// p2p_distributed_tswap_tpu/runtime/plan_codec.py.  BYTE-IDENTICAL: the
+// golden round-trip tests (tests/test_plan_codec.py, via
+// probes/codec_golden.cpp) assert both encoders produce the same bytes for
+// the same fleet sequence, so keep every rule in lockstep with the Python
+// side (lane assignment, removal scan order, snapshot compaction).
+//
+// Packet layout (little-endian, 40-byte header):
+//   u32 magic "JGP1"  u16 version=1  u8 kind(1 snap|2 delta|3 response)
+//   u8 flags  i64 seq  i64 base_seq
+//   u32 n_entries  u32 n_removed  u32 n_named  u32 names_len
+//   i32 idx[]  i32 pos[]  i32 goal[]  i32 removed[]  i32 named_idx[]
+//   u8 names[]  ('\n'-joined peer ids)
+//
+// Framing: base64 in the "data" field of the existing bus-line JSON; the
+// "caps":["packed1"] field on requests is the negotiation — solverd
+// answers packed iff it is present, so plain-JSON peers keep working.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <optional>
+#include <queue>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace mapd {
+namespace codec {
+
+constexpr uint32_t kMagic = 0x3150474A;  // b"JGP1"
+constexpr uint16_t kVersion = 1;
+constexpr uint8_t kSnapshot = 1;
+constexpr uint8_t kDelta = 2;
+constexpr uint8_t kResponse = 3;
+constexpr const char* kCodecName = "packed1";
+constexpr int kSnapshotEvery = 64;
+
+struct Packet {
+  uint8_t kind = 0;
+  int64_t seq = 0;
+  int64_t base_seq = 0;
+  std::vector<int32_t> idx, pos, goal, removed, named_idx;
+  std::vector<std::string> names;
+};
+
+// ---------- base64 (standard alphabet, '=' padding) ----------
+
+inline std::string b64_encode(const std::string& in) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 3 <= in.size()) {
+    uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                 (static_cast<uint8_t>(in[i + 1]) << 8) |
+                 static_cast<uint8_t>(in[i + 2]);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+    i += 3;
+  }
+  size_t rem = in.size() - i;
+  if (rem == 1) {
+    uint32_t v = static_cast<uint8_t>(in[i]) << 16;
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (rem == 2) {
+    uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                 (static_cast<uint8_t>(in[i + 1]) << 8);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+inline std::optional<std::string> b64_decode(const std::string& in) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  if (in.size() % 4 != 0) return std::nullopt;
+  std::string out;
+  out.reserve(in.size() / 4 * 3);
+  for (size_t i = 0; i < in.size(); i += 4) {
+    int pad = 0;
+    uint32_t v = 0;
+    for (int k = 0; k < 4; ++k) {
+      char c = in[i + k];
+      if (c == '=') {
+        if (i + 4 != in.size() || k < 2) return std::nullopt;
+        ++pad;
+        v <<= 6;
+        continue;
+      }
+      if (pad) return std::nullopt;  // '=' only at the very end
+      int d = val(c);
+      if (d < 0) return std::nullopt;
+      v = (v << 6) | static_cast<uint32_t>(d);
+    }
+    out += static_cast<char>((v >> 16) & 0xFF);
+    if (pad < 2) out += static_cast<char>((v >> 8) & 0xFF);
+    if (pad < 1) out += static_cast<char>(v & 0xFF);
+  }
+  return out;
+}
+
+// ---------- binary encode / decode ----------
+
+namespace detail {
+inline void put_u16(std::string& b, uint16_t v) {
+  b += static_cast<char>(v & 0xFF);
+  b += static_cast<char>((v >> 8) & 0xFF);
+}
+inline void put_u32(std::string& b, uint32_t v) {
+  for (int k = 0; k < 4; ++k) b += static_cast<char>((v >> (8 * k)) & 0xFF);
+}
+inline void put_i64(std::string& b, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  for (int k = 0; k < 8; ++k) b += static_cast<char>((u >> (8 * k)) & 0xFF);
+}
+inline void put_i32v(std::string& b, const std::vector<int32_t>& v) {
+  for (int32_t x : v) put_u32(b, static_cast<uint32_t>(x));
+}
+inline uint32_t get_u32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+inline int64_t get_i64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int k = 7; k >= 0; --k) v = (v << 8) | p[k];
+  return static_cast<int64_t>(v);
+}
+}  // namespace detail
+
+// flags bit 0: narrow — arrays are u16, not i32 (auto-chosen when every
+// value < 65536: any grid up to 256x256, fleets up to 64k lanes)
+constexpr uint8_t kFlagNarrow = 1;
+
+inline std::string encode(const Packet& p) {
+  std::string blob;
+  for (size_t k = 0; k < p.names.size(); ++k) {
+    if (k) blob += '\n';
+    blob += p.names[k];
+  }
+  bool narrow = true;
+  for (const auto* arr : {&p.idx, &p.pos, &p.goal, &p.removed,
+                          &p.named_idx})
+    for (int32_t x : *arr)
+      narrow = narrow && x >= 0 && x < 65536;
+  const size_t width = narrow ? 2 : 4;
+  std::string out;
+  out.reserve(40 + width * (3 * p.idx.size() + p.removed.size() +
+                            p.named_idx.size()) + blob.size());
+  detail::put_u32(out, kMagic);
+  detail::put_u16(out, kVersion);
+  out += static_cast<char>(p.kind);
+  out += static_cast<char>(narrow ? kFlagNarrow : 0);
+  detail::put_i64(out, p.seq);
+  detail::put_i64(out, p.base_seq);
+  detail::put_u32(out, static_cast<uint32_t>(p.idx.size()));
+  detail::put_u32(out, static_cast<uint32_t>(p.removed.size()));
+  detail::put_u32(out, static_cast<uint32_t>(p.named_idx.size()));
+  detail::put_u32(out, static_cast<uint32_t>(blob.size()));
+  auto put = [&](const std::vector<int32_t>& v) {
+    if (narrow)
+      for (int32_t x : v) detail::put_u16(out, static_cast<uint16_t>(x));
+    else
+      detail::put_i32v(out, v);
+  };
+  put(p.idx);
+  put(p.pos);
+  put(p.goal);
+  put(p.removed);
+  put(p.named_idx);
+  out += blob;
+  return out;
+}
+
+inline std::optional<Packet> decode(const std::string& buf) {
+  if (buf.size() < 40) return std::nullopt;
+  const uint8_t* b = reinterpret_cast<const uint8_t*>(buf.data());
+  if (detail::get_u32(b) != kMagic) return std::nullopt;
+  uint16_t version = static_cast<uint16_t>(b[4] | (b[5] << 8));
+  if (version != kVersion) return std::nullopt;
+  Packet p;
+  p.kind = b[6];
+  const bool narrow = (b[7] & kFlagNarrow) != 0;
+  const size_t width = narrow ? 2 : 4;
+  p.seq = detail::get_i64(b + 8);
+  p.base_seq = detail::get_i64(b + 16);
+  uint32_t n_entries = detail::get_u32(b + 24);
+  uint32_t n_removed = detail::get_u32(b + 28);
+  uint32_t n_named = detail::get_u32(b + 32);
+  uint32_t names_len = detail::get_u32(b + 36);
+  uint64_t need = 40 +
+      width * (3ull * n_entries + n_removed + n_named) + names_len;
+  if (buf.size() != need) return std::nullopt;
+  const uint8_t* q = b + 40;
+  auto take = [&](std::vector<int32_t>& v, uint32_t n) {
+    v.resize(n);
+    for (uint32_t k = 0; k < n; ++k, q += width)
+      v[k] = narrow ? static_cast<int32_t>(q[0] | (q[1] << 8))
+                    : static_cast<int32_t>(detail::get_u32(q));
+  };
+  take(p.idx, n_entries);
+  take(p.pos, n_entries);
+  take(p.goal, n_entries);
+  take(p.removed, n_removed);
+  take(p.named_idx, n_named);
+  if (names_len) {
+    std::string blob(reinterpret_cast<const char*>(q), names_len);
+    size_t start = 0;
+    while (true) {
+      size_t nl = blob.find('\n', start);
+      if (nl == std::string::npos) {
+        p.names.push_back(blob.substr(start));
+        break;
+      }
+      p.names.push_back(blob.substr(start, nl - start));
+      start = nl + 1;
+    }
+  }
+  if (p.names.size() != n_named) return std::nullopt;
+  return p;
+}
+
+inline std::string encode_b64(const Packet& p) { return b64_encode(encode(p)); }
+
+inline std::optional<Packet> decode_b64(const std::string& data) {
+  auto raw = b64_decode(data);
+  if (!raw) return std::nullopt;
+  return decode(*raw);
+}
+
+// ---------- manager-side delta tracking ----------
+
+// Mirrors plan_codec.py PackedFleetEncoder exactly (see its docstring for
+// the determinism contract: ascending removal scan, lowest-free-lane
+// assignment, caller's fleet order, snapshot compaction).
+class PackedFleetEncoder {
+ public:
+  explicit PackedFleetEncoder(int snapshot_every = kSnapshotEvery)
+      : snapshot_every_(snapshot_every) {}
+
+  void request_snapshot() { force_snapshot_ = true; }
+  int64_t last_seq() const { return last_seq_; }
+
+  // fleet: ordered (peer_id, pos_cell, goal_cell) triplets.
+  Packet encode_tick(
+      int64_t seq,
+      const std::vector<std::tuple<std::string, int32_t, int32_t>>& fleet) {
+    Packet pkt;
+    pkt.seq = seq;
+    bool snapshot =
+        force_snapshot_ || since_snapshot_ + 1 >= snapshot_every_;
+    if (snapshot) {
+      roster_.clear();
+      roster_idx_.clear();
+      free_ = {};
+      shadow_.clear();
+      pkt.kind = kSnapshot;
+      pkt.base_seq = 0;
+      for (const auto& [name, p, g] : fleet) {
+        int32_t lane = static_cast<int32_t>(roster_.size());
+        roster_.push_back(name);
+        roster_idx_[name] = lane;
+        shadow_[lane] = {p, g};
+        pkt.idx.push_back(lane);
+        pkt.pos.push_back(p);
+        pkt.goal.push_back(g);
+        pkt.named_idx.push_back(lane);
+        pkt.names.push_back(name);
+      }
+      force_snapshot_ = false;
+      since_snapshot_ = 0;
+      last_seq_ = seq;
+      return pkt;
+    }
+    pkt.kind = kDelta;
+    pkt.base_seq = last_seq_;
+    std::set<std::string> current;
+    for (const auto& [name, p, g] : fleet) {
+      (void)p;
+      (void)g;
+      current.insert(name);
+    }
+    for (size_t lane = 0; lane < roster_.size(); ++lane) {
+      if (!roster_[lane].empty() && !current.count(roster_[lane])) {
+        pkt.removed.push_back(static_cast<int32_t>(lane));
+        roster_idx_.erase(roster_[lane]);
+        roster_[lane].clear();
+        shadow_.erase(static_cast<int32_t>(lane));
+        free_.push(static_cast<int32_t>(lane));
+      }
+    }
+    for (const auto& [name, p, g] : fleet) {
+      int32_t lane;
+      auto it = roster_idx_.find(name);
+      if (it == roster_idx_.end()) {
+        if (!free_.empty()) {
+          lane = free_.top();
+          free_.pop();
+          roster_[lane] = name;
+        } else {
+          lane = static_cast<int32_t>(roster_.size());
+          roster_.push_back(name);
+        }
+        roster_idx_[name] = lane;
+        pkt.named_idx.push_back(lane);
+        pkt.names.push_back(name);
+      } else {
+        lane = it->second;
+        auto sh = shadow_.find(lane);
+        if (sh != shadow_.end() && sh->second.first == p &&
+            sh->second.second == g)
+          continue;  // unchanged since the last packet
+      }
+      pkt.idx.push_back(lane);
+      pkt.pos.push_back(p);
+      pkt.goal.push_back(g);
+      shadow_[lane] = {p, g};
+    }
+    last_seq_ = seq;
+    ++since_snapshot_;
+    return pkt;
+  }
+
+  // lane -> peer id ("" for vacated lanes / out of range)
+  const std::string& peer_of(int32_t lane) const {
+    static const std::string empty;
+    if (lane < 0 || static_cast<size_t>(lane) >= roster_.size()) return empty;
+    return roster_[lane];
+  }
+
+  // (pos, goal) as last SENT for a lane — the packed analog of the JSON
+  // path's sent_goals map (phantom-exchange guard in the manager).
+  std::optional<std::pair<int32_t, int32_t>> shadow_of(int32_t lane) const {
+    auto it = shadow_.find(lane);
+    if (it == shadow_.end()) return std::nullopt;
+    return it->second;
+  }
+
+ private:
+  int snapshot_every_;
+  std::vector<std::string> roster_;  // lane -> peer id ("" = free)
+  std::map<std::string, int32_t> roster_idx_;
+  std::priority_queue<int32_t, std::vector<int32_t>, std::greater<int32_t>>
+      free_;
+  std::map<int32_t, std::pair<int32_t, int32_t>> shadow_;
+  int64_t last_seq_ = 0;
+  int since_snapshot_ = 0;
+  bool force_snapshot_ = true;
+};
+
+}  // namespace codec
+}  // namespace mapd

@@ -41,6 +41,7 @@
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
+#include "../common/plan_codec.hpp"
 #include "../common/trace.hpp"
 #include "../common/tswap.hpp"
 
@@ -96,6 +97,11 @@ int main(int argc, char** argv) {
   // been silent this long (the fleet must not stall if solverd dies).
   const int64_t solver_failover_ms =
       knobs.get_int("--solver-failover-ms", "MAPD_SOLVER_FAILOVER_MS", 5000);
+  // Plan-wire codec for --solver=tpu: "packed" (default fast path —
+  // base64 int32 snapshot/delta packets, see common/plan_codec.hpp) or
+  // "json" (the legacy per-agent object wire; solverd always accepts it).
+  const std::string plan_codec =
+      knobs.get_str("--plan-codec", "JG_PLAN_CODEC", "packed");
   // an agent that keeps reporting idle this long past dispatch never got
   // its task (delivery lost in a bus outage) — re-send the same task
   const int64_t task_resend_ms =
@@ -183,6 +189,12 @@ int main(int argc, char** argv) {
     return t;
   };
 
+  // Future-goal hints for the packed solver wire: the delivery cell of a
+  // freshly assigned task becomes a goal only at the pickup flip, many
+  // ticks later — shipping it as a hint lets solverd pre-sweep the field
+  // in its idle window instead of stalling the tick it goes live.
+  std::vector<int32_t> plan_hints;
+
   auto assign_task = [&](const std::string& peer, Json task) {
     task.set("peer_id", peer);
     uint64_t id = static_cast<uint64_t>(task["task_id"].as_int());
@@ -196,6 +208,10 @@ int main(int argc, char** argv) {
     a.phase = Phase::ToPickup;
     a.dispatched_ms = mono_ms();
     if (auto p = parse_point(task["pickup"])) a.goal = *p;
+    if (solver == "tpu" && plan_codec != "json")
+      if (auto dl = parse_point(task["delivery"]))
+        if (plan_hints.size() < 4096)
+          plan_hints.push_back(static_cast<int32_t>(*dl));
     bus.publish("mapd", task);
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
              peer.c_str());
@@ -409,9 +425,48 @@ int main(int argc, char** argv) {
   // request and response (completion, fresh assignment, idle reset) must
   // not be misread as an exchange
   std::map<std::string, Cell> sent_goals;
+  // packed fast path: delta tracking against the state as sent (shadow),
+  // periodic snapshot resync, and the seq-gap recovery trigger
+  const bool use_packed = (plan_codec != "json");
+  codec::PackedFleetEncoder plan_enc;
+  int64_t plan_sent_ms = 0;  // fresh-response RTT (manager.plan_rtt_ms)
 
   auto plan_request_tpu = [&]() {
     Span sp("manager.plan_request_encode");
+    if (use_packed) {
+      std::vector<std::tuple<std::string, int32_t, int32_t>> fleet;
+      fleet.reserve(agents.size());
+      for (auto& [peer, a] : agents)
+        fleet.emplace_back(peer, static_cast<int32_t>(a.pos),
+                           static_cast<int32_t>(a.goal));
+      if (fleet.empty()) return;
+      codec::Packet pkt = plan_enc.encode_tick(++plan_seq, fleet);
+      if (pkt.kind == codec::kSnapshot)
+        metrics_count("manager.plan_snapshots");
+      else
+        // snapshots carry the whole fleet by design; only genuine deltas
+        // feed the O(churn) evidence counter
+        metrics_count("manager.delta_agents",
+                      static_cast<double>(pkt.idx.size()));
+      Json caps;
+      caps.push_back(Json(codec::kCodecName));
+      Json req;
+      req.set("type", "plan_request")
+          .set("seq", plan_seq)
+          .set("codec", codec::kCodecName)
+          .set("caps", caps)
+          .set("base_seq", pkt.base_seq)
+          .set("data", codec::encode_b64(pkt));
+      if (!plan_hints.empty()) {
+        Json hints;
+        for (int32_t c : plan_hints) hints.push_back(Json(c));
+        req.set("hints", hints);
+        plan_hints.clear();
+      }
+      plan_sent_ms = mono_ms();
+      bus.publish("solver", req);
+      return;
+    }
     Json req;
     Json arr;
     std::map<std::string, Cell> snap;
@@ -426,6 +481,7 @@ int main(int argc, char** argv) {
     if (arr.is_null()) return;
     req.set("type", "plan_request").set("seq", ++plan_seq).set("agents", arr);
     sent_goals = std::move(snap);
+    plan_sent_ms = mono_ms();
     bus.publish("solver", req);
   };
 
@@ -453,25 +509,60 @@ int main(int argc, char** argv) {
     }
     int64_t us = d["duration_micros"].as_int();
     path_metrics.record_micros(us, unix_ms());
+    // end-to-end planning latency as the fleet pays it: request publish ->
+    // fresh response applied (the crossover harness compares this against
+    // the native path's tick_ms)
+    metrics_observe("manager.plan_rtt_ms",
+                    static_cast<double>(mono_ms() - plan_sent_ms));
     std::vector<std::string> ids;
     std::vector<Cell> next, old_goals, new_goals;
-    for (const auto& mv : d["moves"].as_array()) {
-      auto np = parse_point(mv["next_pos"]);
-      if (!np) continue;
-      const std::string& peer = mv["peer_id"].as_str();
-      auto it = agents.find(peer);
-      if (it == agents.end()) continue;
-      ids.push_back(peer);
-      next.push_back(*np);
-      // exchanges are judged against the goal THE REQUEST carried, and
-      // only for agents whose goal is unchanged since — a completion or
-      // fresh assignment in flight must not fabricate a phantom exchange
-      auto sg = sent_goals.find(peer);
-      const bool unchanged = sg != sent_goals.end()
-                             && sg->second == it->second.goal;
-      old_goals.push_back(it->second.goal);
-      auto ng = parse_point(mv["goal"]);
-      new_goals.push_back(ng && unchanged ? *ng : it->second.goal);
+    if (d["codec"].as_str() == codec::kCodecName) {
+      // packed response: int32 (lane, next_cell, goal_cell) triplets for
+      // lanes that moved or changed goal; lanes resolve through the
+      // encoder's roster, sent-state through its shadow
+      auto pkt = codec::decode_b64(d["data"].as_str());
+      if (!pkt || pkt->kind != codec::kResponse) {
+        metrics_count("manager.bad_plan_packets");
+        return;
+      }
+      const Cell cells = static_cast<Cell>(grid.width * grid.height);
+      for (size_t k = 0; k < pkt->idx.size(); ++k) {
+        Cell np = static_cast<Cell>(pkt->pos[k]);
+        if (np < 0 || np >= cells) continue;
+        const std::string& peer = plan_enc.peer_of(pkt->idx[k]);
+        if (peer.empty()) continue;
+        auto it = agents.find(peer);
+        if (it == agents.end()) continue;
+        ids.push_back(peer);
+        next.push_back(np);
+        // same phantom-exchange guard as the JSON path: judged against
+        // the goal the request carried (the encoder's shadow)
+        auto sh = plan_enc.shadow_of(pkt->idx[k]);
+        const bool unchanged = sh && sh->second == it->second.goal;
+        Cell ng = static_cast<Cell>(pkt->goal[k]);
+        old_goals.push_back(it->second.goal);
+        new_goals.push_back(unchanged && ng >= 0 && ng < cells
+                                ? ng : it->second.goal);
+      }
+    } else {
+      for (const auto& mv : d["moves"].as_array()) {
+        auto np = parse_point(mv["next_pos"]);
+        if (!np) continue;
+        const std::string& peer = mv["peer_id"].as_str();
+        auto it = agents.find(peer);
+        if (it == agents.end()) continue;
+        ids.push_back(peer);
+        next.push_back(*np);
+        // exchanges are judged against the goal THE REQUEST carried, and
+        // only for agents whose goal is unchanged since — a completion or
+        // fresh assignment in flight must not fabricate a phantom exchange
+        auto sg = sent_goals.find(peer);
+        const bool unchanged = sg != sent_goals.end()
+                               && sg->second == it->second.goal;
+        old_goals.push_back(it->second.goal);
+        auto ng = parse_point(mv["goal"]);
+        new_goals.push_back(ng && unchanged ? *ng : it->second.goal);
+      }
     }
     emit_moves(ids, next);
     // the daemon's returned post-swap goals re-assign tasks exactly like
@@ -615,6 +706,14 @@ int main(int argc, char** argv) {
             }
           } else if (type == "plan_response") {
             handle_plan_response(d);
+          } else if (type == "plan_snapshot_request") {
+            // solverd lost the delta chain (restart, dropped packet): the
+            // next planning tick re-sends the full fleet state
+            plan_enc.request_snapshot();
+            metrics_count("manager.plan_snapshot_requests");
+            log_info("🔁 solver daemon requested a plan snapshot "
+                     "(its chain ends at seq %lld)\n",
+                     static_cast<long long>(d["have_seq"].as_int()));
           } else if (type == "task_metric_received") {
             task_metrics.update_received(
                 static_cast<uint64_t>(d["task_id"].as_int()),

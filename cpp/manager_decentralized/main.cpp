@@ -129,6 +129,16 @@ int main(int argc, char** argv) {
   // outage stranding the handed-over task).
   std::map<long long, Json> inflight;        // task_id -> bare Task JSON
   std::map<long long, int64_t> last_claimed; // task_id -> last claim mono_ms
+  // Last peer whose heartbeat claimed each task, with its claim time:
+  // duplicate copies after a severed exchange make TWO live peers claim
+  // the same id, and believing every claim flips peer_busy between them
+  // on alternating heartbeats (each flip frees the other peer, so the
+  // done-refill can dispatch fresh work to a peer still holding its
+  // duplicate — ADVICE r5 low).  A claim only moves the bookkeeping when
+  // the recorded holder's own claim has gone stale (>= 2 heartbeat
+  // periods): a genuinely exchanged-away holder stops claiming within one.
+  std::map<long long, std::pair<std::string, int64_t>> holder_claim;
+  const int64_t claim_fresh_ms = 2500;
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
@@ -264,6 +274,7 @@ int main(int argc, char** argv) {
       completed_order.clear();
       inflight.clear();
       last_claimed.clear();
+      holder_claim.clear();
       log_info("🔄 state reset\n");
     } else if (!cmd.empty()) {
       Json raw;  // unknown lines broadcast raw (ref :389-395)
@@ -364,6 +375,21 @@ int main(int argc, char** argv) {
                   }
                 if (busy == peer_busy.end()
                     || busy->second["task_id"].as_int() != ctid) {
+                  // freshness guard (see holder_claim above): ignore a
+                  // claim that would evict a holder whose own claim is
+                  // fresher than the heartbeat cadence — ends the
+                  // peer_busy ping-pong between duplicate holders
+                  auto hc = holder_claim.find(ctid);
+                  if (hc != holder_claim.end() && hc->second.first != peer
+                      && mono_ms() - hc->second.second < claim_fresh_ms) {
+                    metrics_count("manager.duplicate_claims_ignored");
+                    log_debug("… ignoring %s's claim on task %lld (%s "
+                              "claimed it %lld ms ago)\n", peer.c_str(),
+                              ctid, hc->second.first.c_str(),
+                              static_cast<long long>(
+                                  mono_ms() - hc->second.second));
+                    return;
+                  }
                   log_info("🔁 %s now carries task %lld (peer-side "
                            "exchange); bookkeeping follows\n",
                            peer.c_str(), ctid);
@@ -381,6 +407,7 @@ int main(int argc, char** argv) {
                   peer_busy[peer].set("peer_id", peer);
                   busy_since[peer] = mono_ms();
                 }
+                holder_claim[ctid] = {peer, mono_ms()};
               }
             }
           } else if (type == "occupied_request") {
@@ -452,6 +479,7 @@ int main(int argc, char** argv) {
             completed_order.push_back(tid);
             inflight.erase(tid);
             last_claimed.erase(tid);
+            holder_claim.erase(tid);
             if (completed_order.size() > 4096) {
               completed_ids.erase(completed_order.front());
               completed_order.pop_front();
@@ -557,6 +585,7 @@ int main(int argc, char** argv) {
         const long long tid = inf->first;
         if (completed_ids.count(tid)) {
           last_claimed.erase(tid);
+          holder_claim.erase(tid);
           inf = inflight.erase(inf);
           continue;
         }
@@ -577,6 +606,7 @@ int main(int argc, char** argv) {
               break;
             }
           last_claimed[tid] = now;  // one shot per stale window
+          holder_claim.erase(tid);  // the re-dispatch's holder claims fresh
         }
         ++inf;
       }

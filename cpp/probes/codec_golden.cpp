@@ -1,0 +1,91 @@
+// codec_golden — drive the native packed-plan codec from a script on
+// stdin, so the Python side (tests/test_plan_codec.py, scripts/ci.sh fuzz
+// gate) can assert BYTE-IDENTICAL output against its own encoder and
+// round-trip decode equivalence.
+//
+//   --encode   stdin: one JSON per line
+//                {"seq":N, "fleet":[["peer",pos,goal],...],
+//                 "force_snapshot":bool?, "snapshot_every":int?}
+//              stdout: one base64 packet per line (PackedFleetEncoder,
+//              state carried across lines like a live manager tick stream)
+//   --decode   stdin: one base64 packet per line
+//              stdout: canonical JSON of the decoded packet per line
+//              ("null" for undecodable input)
+
+#include <cstdio>
+#include <iostream>
+#include <string>
+
+#include "../common/json.hpp"
+#include "../common/plan_codec.hpp"
+
+using namespace mapd;
+
+static Json i32_array(const std::vector<int32_t>& v) {
+  Json a;
+  for (int32_t x : v) a.push_back(Json(static_cast<int64_t>(x)));
+  if (a.is_null()) a = Json(JsonArray{});
+  return a;
+}
+
+int main(int argc, char** argv) {
+  const std::string mode = argc > 1 ? argv[1] : "";
+  if (mode != "--encode" && mode != "--decode") {
+    fprintf(stderr, "usage: codec_golden --encode|--decode < lines\n");
+    return 2;
+  }
+  codec::PackedFleetEncoder enc;
+  bool enc_configured = false;
+  std::string line;
+  while (std::getline(std::cin, line)) {
+    if (line.empty()) continue;
+    if (mode == "--decode") {
+      auto pkt = codec::decode_b64(line);
+      if (!pkt) {
+        printf("null\n");
+        continue;
+      }
+      Json names;
+      for (const auto& n : pkt->names) names.push_back(Json(n));
+      if (names.is_null()) names = Json(JsonArray{});
+      Json out;
+      out.set("kind", static_cast<int64_t>(pkt->kind))
+          .set("seq", pkt->seq)
+          .set("base_seq", pkt->base_seq)
+          .set("idx", i32_array(pkt->idx))
+          .set("pos", i32_array(pkt->pos))
+          .set("goal", i32_array(pkt->goal))
+          .set("removed", i32_array(pkt->removed))
+          .set("named_idx", i32_array(pkt->named_idx))
+          .set("names", names);
+      printf("%s\n", out.dump().c_str());
+      continue;
+    }
+    auto parsed = Json::parse(line);
+    if (!parsed || !parsed->is_object()) {
+      fprintf(stderr, "codec_golden: bad script line\n");
+      return 1;
+    }
+    const Json& j = *parsed;
+    if (!enc_configured && j.has("snapshot_every")) {
+      enc = codec::PackedFleetEncoder(
+          static_cast<int>(j["snapshot_every"].as_int()));
+    }
+    enc_configured = true;
+    if (j["force_snapshot"].as_bool()) enc.request_snapshot();
+    std::vector<std::tuple<std::string, int32_t, int32_t>> fleet;
+    for (const auto& e : j["fleet"].as_array()) {
+      const auto& t = e.as_array();
+      if (t.size() != 3) {
+        fprintf(stderr, "codec_golden: fleet entry needs [peer,pos,goal]\n");
+        return 1;
+      }
+      fleet.emplace_back(t[0].as_str(), static_cast<int32_t>(t[1].as_int()),
+                         static_cast<int32_t>(t[2].as_int()));
+    }
+    codec::Packet pkt = enc.encode_tick(j["seq"].as_int(), fleet);
+    printf("%s\n", codec::encode_b64(pkt).c_str());
+  }
+  fflush(stdout);
+  return 0;
+}

@@ -122,7 +122,9 @@ class FleetAggregator:
             d_recv = recv - counter_total(st.prev_metrics,
                                           "bus.bytes_received")
         else:  # single beacon so far: cumulative average over uptime
-            dt = max(cur.get("uptime_s", 0.0), 1e-9)
+            # `or 0.0`: a foreign emitter can send "uptime_s": null, and
+            # max(None, 1e-9) would crash every subsequent rollup
+            dt = max(cur.get("uptime_s") or 0.0, 1e-9)
             d_sent, d_recv = sent, recv
         return {
             "bytes_sent": int(sent),

@@ -261,6 +261,19 @@ def span(name: str, **args):
     return _tracer.span(name, **args)
 
 
+def complete(name: str, t0_ns: int, dur_ns: int, **args) -> None:
+    """Emit a span retroactively from explicit perf_counter_ns timestamps.
+
+    The pipelined solverd tick dispatches request k, then decodes k+1 and
+    encodes k-1 while the device runs — its phases no longer nest inside a
+    live ``with span(...)`` block, so the tick span is stamped after the
+    fact (children attribute via an explicit ``parent`` arg instead of the
+    span stack)."""
+    if not _tracer.enabled:
+        return
+    _tracer._emit(name, t0_ns, dur_ns, None, args or None)
+
+
 def instant(name: str, **args) -> None:
     _tracer.instant(name, **args)
 

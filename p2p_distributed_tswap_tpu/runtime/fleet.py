@@ -202,7 +202,7 @@ def main(argv=None) -> int:
     with Fleet(args.mode, args.agents, args.port, args.map, args.solver,
                args.log_dir) as fleet:
         print(f"fleet up: {args.mode}, {args.agents} agents, "
-              f"bus port {args.port}; logs in {args.log_dir}")
+              f"bus port {args.port}; logs in {fleet.log_dir}")
         print(f"   live view: python analysis/fleet_top.py "
               f"--port {args.port}   (beacons on bus topic mapd.metrics)")
         time.sleep(3 + args.agents * 0.2)

@@ -1,0 +1,333 @@
+"""Packed binary plan codec ("packed1") — the solverd fast-path wire format.
+
+The JSON plan wire costs ~100 bytes per agent per direction per tick and a
+full-fleet encode/decode on both sides (runtime/solverd.py,
+cpp/manager_centralized/main.cpp) — the host-bound bottleneck that dominates
+end-to-end ms/tick at the 1k–10k-agent rungs.  This codec replaces the
+per-agent JSON objects with packed little-endian int32 arrays (12 bytes per
+agent entry) and, after an initial full snapshot, **delta packets** carrying
+only the agents whose pos/goal changed since the previous packet, so a
+steady-state tick ships O(churn) bytes instead of O(N).
+
+Framing: the binary packet rides base64 in a ``data`` field of the existing
+line-framed bus JSON, so busd and every non-planning peer are untouched:
+
+    {"type": "plan_request", "seq": N, "codec": "packed1",
+     "caps": ["packed1"], "base_seq": B, "data": "<base64>"}
+    {"type": "plan_response", "seq": N, "codec": "packed1",
+     "duration_micros": U, "data": "<base64>"}
+
+Negotiation rides the ``caps`` field: solverd answers packed iff the request
+advertises ``packed1``; a plain-JSON manager never does and keeps getting
+the legacy JSON wire, so mixed fleets interoperate.
+
+Packet layout (all little-endian; header 40 bytes):
+
+    u32 magic      "JGP1" (0x3150474A)
+    u16 version    1
+    u8  kind       1=snapshot  2=delta  3=response
+    u8  flags      bit 0: narrow — arrays are u16, not i32 (chosen
+                   automatically when every value < 65536, i.e. any grid
+                   up to 256x256 and fleets up to 64k lanes; halves the
+                   wire cost of the common rungs)
+    i64 seq
+    i64 base_seq   delta: the seq this packet's diff is relative to
+    u32 n_entries
+    u32 n_removed
+    u32 n_named
+    u32 names_len
+    i32 idx[n_entries]      roster lane per entry
+    i32 pos[n_entries]      flat cell (request: pos; response: next_pos)
+    i32 goal[n_entries]     flat cell
+    i32 removed[n_removed]  roster lanes vacated since base_seq
+    i32 named_idx[n_named]  lanes whose peer-id string is (re)declared
+    u8  names[names_len]    '\\n'-joined peer ids, one per named_idx
+
+Delta state machine (PackedFleetEncoder / PackedStateDecoder): packets form
+a chain — each delta's ``base_seq`` must equal the seq the decoder last
+applied.  A gap (lost packet, restarted solverd) raises :class:`SeqGapError`
+and the decoder's owner publishes ``plan_snapshot_request``; the encoder
+answers with a full snapshot, which also recurs every ``snapshot_every``
+packets as belt-and-braces resync.  The C++ mirror
+(cpp/common/plan_codec.hpp) is byte-identical — tests/test_plan_codec.py
+locks the golden bytes across both encoders.
+"""
+
+from __future__ import annotations
+
+import base64
+import heapq
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = 0x3150474A  # b"JGP1" little-endian
+VERSION = 1
+KIND_SNAPSHOT = 1
+KIND_DELTA = 2
+KIND_RESPONSE = 3
+CODEC_NAME = "packed1"
+SNAPSHOT_EVERY = 64  # periodic resync cadence (packets)
+
+_HEADER = struct.Struct("<IHBBqqIIII")
+
+
+class CodecError(ValueError):
+    """Malformed packet (bad magic/version/lengths)."""
+
+
+class SeqGapError(RuntimeError):
+    """A delta arrived whose base_seq is not the decoder's last applied
+    seq: some packet in the chain was lost.  Owner must request a
+    snapshot."""
+
+    def __init__(self, have_seq: int, base_seq: int):
+        super().__init__(f"delta base_seq {base_seq} != last applied "
+                         f"{have_seq}")
+        self.have_seq = have_seq
+        self.base_seq = base_seq
+
+
+@dataclass
+class Packet:
+    kind: int
+    seq: int
+    base_seq: int = 0
+    idx: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    pos: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    goal: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    removed: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    named_idx: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.int32))
+    names: List[str] = field(default_factory=list)
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.int32))
+
+
+FLAG_NARROW = 1  # u16 arrays (all values < 65536)
+
+
+def encode(pkt: Packet) -> bytes:
+    idx, pos, goal = _i32(pkt.idx), _i32(pkt.pos), _i32(pkt.goal)
+    removed, named_idx = _i32(pkt.removed), _i32(pkt.named_idx)
+    if not (idx.size == pos.size == goal.size):
+        raise CodecError("idx/pos/goal length mismatch")
+    if named_idx.size != len(pkt.names):
+        raise CodecError("named_idx/names length mismatch")
+    arrays = (idx, pos, goal, removed, named_idx)
+    narrow = all(a.size == 0 or (a.min() >= 0 and a.max() < 65536)
+                 for a in arrays)
+    flags = FLAG_NARROW if narrow else 0
+    if narrow:
+        arrays = tuple(a.astype("<u2") for a in arrays)
+    blob = "\n".join(pkt.names).encode() if pkt.names else b""
+    head = _HEADER.pack(MAGIC, VERSION, pkt.kind, flags, pkt.seq,
+                        pkt.base_seq, idx.size, removed.size,
+                        named_idx.size, len(blob))
+    return b"".join((head,) + tuple(a.tobytes() for a in arrays) + (blob,))
+
+
+def decode(buf: bytes) -> Packet:
+    if len(buf) < _HEADER.size:
+        raise CodecError("short packet")
+    (magic, version, kind, flags, seq, base_seq, n_entries, n_removed,
+     n_named, names_len) = _HEADER.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise CodecError(f"bad magic 0x{magic:08x}")
+    if version != VERSION:
+        raise CodecError(f"unsupported codec version {version}")
+    width = 2 if flags & FLAG_NARROW else 4
+    dtype = np.dtype("<u2") if width == 2 else np.dtype("<i4")
+    need = _HEADER.size + width * (3 * n_entries + n_removed + n_named) \
+        + names_len
+    if len(buf) != need:
+        raise CodecError(f"packet length {len(buf)} != expected {need}")
+    off = _HEADER.size
+
+    def take(n):
+        nonlocal off
+        out = np.frombuffer(buf, dtype, count=n, offset=off)
+        off += width * n
+        return out.astype(np.int32, copy=True)
+
+    idx, pos, goal = take(n_entries), take(n_entries), take(n_entries)
+    removed, named_idx = take(n_removed), take(n_named)
+    blob = buf[off:off + names_len]
+    names = blob.decode().split("\n") if names_len else []
+    if len(names) != n_named:
+        raise CodecError("names blob count mismatch")
+    return Packet(kind=kind, seq=seq, base_seq=base_seq, idx=idx, pos=pos,
+                  goal=goal, removed=removed, named_idx=named_idx,
+                  names=names)
+
+
+def encode_b64(pkt: Packet) -> str:
+    return base64.b64encode(encode(pkt)).decode()
+
+
+def decode_b64(data: str) -> Packet:
+    try:
+        raw = base64.b64decode(data, validate=True)
+    except Exception as e:  # binascii.Error subclasses ValueError
+        raise CodecError(f"bad base64 framing: {e}") from None
+    return decode(raw)
+
+
+class PackedFleetEncoder:
+    """Manager-side delta tracking: diff the current fleet against the
+    state as of the last packet sent and emit the smallest valid packet.
+
+    The C++ manager implements the same rules natively
+    (cpp/common/plan_codec.hpp PackedFleetEncoder); determinism contract —
+    identical fleet sequences produce identical bytes on both sides:
+
+    - removals scan roster lanes ascending;
+    - a new peer takes the lowest free lane, else appends;
+    - entries follow the caller's fleet iteration order;
+    - a snapshot compacts the roster to fleet order and resets the chain.
+    """
+
+    def __init__(self, snapshot_every: int = SNAPSHOT_EVERY):
+        self.snapshot_every = snapshot_every
+        self.roster: List[Optional[str]] = []  # lane -> peer id
+        self.roster_idx: Dict[str, int] = {}
+        self.free: List[int] = []  # min-heap of vacated lanes
+        self.shadow: Dict[int, Tuple[int, int]] = {}  # lane -> (pos, goal)
+        self.last_seq = 0
+        self.since_snapshot = 0
+        self.force_snapshot = True  # first packet is always a snapshot
+
+    def request_snapshot(self) -> None:
+        """The decoder reported a seq gap: resync on the next tick."""
+        self.force_snapshot = True
+
+    def encode_tick(self, seq: int,
+                    fleet: Iterable[Tuple[str, int, int]]) -> Packet:
+        """One planning tick's packet for ``fleet`` = ordered
+        ``(peer_id, pos_cell, goal_cell)``."""
+        fleet = list(fleet)
+        snapshot = (self.force_snapshot
+                    or self.since_snapshot + 1 >= self.snapshot_every)
+        if snapshot:
+            self.roster = [name for name, _, _ in fleet]
+            self.roster_idx = {name: k for k, name in enumerate(self.roster)}
+            self.free = []
+            self.shadow = {k: (p, g) for k, (_, p, g) in enumerate(fleet)}
+            self.force_snapshot = False
+            self.since_snapshot = 0
+            self.last_seq = seq
+            lanes = np.arange(len(fleet), dtype=np.int32)
+            return Packet(
+                kind=KIND_SNAPSHOT, seq=seq, base_seq=0, idx=lanes,
+                pos=_i32([p for _, p, _ in fleet]),
+                goal=_i32([g for _, _, g in fleet]),
+                named_idx=lanes.copy(), names=[n for n, _, _ in fleet])
+        current = {name for name, _, _ in fleet}
+        removed = []
+        for lane, name in enumerate(self.roster):
+            if name is not None and name not in current:
+                removed.append(lane)
+                del self.roster_idx[name]
+                self.roster[lane] = None
+                self.shadow.pop(lane, None)
+                heapq.heappush(self.free, lane)
+        idx, pos, goal, named_idx, names = [], [], [], [], []
+        for name, p, g in fleet:
+            lane = self.roster_idx.get(name)
+            if lane is None:
+                if self.free:
+                    lane = heapq.heappop(self.free)
+                    self.roster[lane] = name
+                else:
+                    lane = len(self.roster)
+                    self.roster.append(name)
+                self.roster_idx[name] = lane
+                named_idx.append(lane)
+                names.append(name)
+            elif self.shadow.get(lane) == (p, g):
+                continue  # unchanged since the last packet
+            idx.append(lane)
+            pos.append(p)
+            goal.append(g)
+            self.shadow[lane] = (p, g)
+        pkt = Packet(kind=KIND_DELTA, seq=seq, base_seq=self.last_seq,
+                     idx=_i32(idx), pos=_i32(pos), goal=_i32(goal),
+                     removed=_i32(removed), named_idx=_i32(named_idx),
+                     names=names)
+        self.last_seq = seq
+        self.since_snapshot += 1
+        return pkt
+
+
+@dataclass
+class DecodedUpdate:
+    """A validated, applied request packet, normalized for the consumer
+    (solverd scatters ``idx/pos/goal`` into its device-resident arrays)."""
+    seq: int
+    is_snapshot: bool
+    idx: np.ndarray
+    pos: np.ndarray
+    goal: np.ndarray
+    removed: np.ndarray  # lanes deactivated this packet (incl. snapshot GC)
+
+
+class PackedStateDecoder:
+    """Solverd-side mirror of the manager's roster + fleet state.
+
+    ``apply`` validates the delta chain (:class:`SeqGapError` on a break)
+    and keeps a host-side state map so responses can be encoded per lane
+    and tests can assert full-state equivalence."""
+
+    def __init__(self):
+        self.names: List[Optional[str]] = []  # lane -> peer id
+        self.state: Dict[int, Tuple[int, int]] = {}  # lane -> (pos, goal)
+        self.last_seq: Optional[int] = None
+
+    def name_of(self, lane: int) -> Optional[str]:
+        return self.names[lane] if 0 <= lane < len(self.names) else None
+
+    def apply(self, pkt: Packet) -> DecodedUpdate:
+        if pkt.kind == KIND_DELTA:
+            if self.last_seq is None or pkt.base_seq != self.last_seq:
+                raise SeqGapError(-1 if self.last_seq is None
+                                  else self.last_seq, pkt.base_seq)
+        elif pkt.kind != KIND_SNAPSHOT:
+            raise CodecError(f"not a request packet (kind {pkt.kind})")
+        removed = pkt.removed
+        if pkt.kind == KIND_SNAPSHOT:
+            live = set(int(i) for i in pkt.idx)
+            removed = _i32(sorted(l for l in self.state if l not in live))
+            self.names = []
+            self.state = {}
+        top = int(max(pkt.idx.max() if pkt.idx.size else -1,
+                      pkt.named_idx.max() if pkt.named_idx.size else -1))
+        if top >= len(self.names):
+            self.names.extend([None] * (top + 1 - len(self.names)))
+        # removals strictly BEFORE names/entries: a lane vacated and handed
+        # to a new peer in the same packet belongs to the new peer
+        for lane in pkt.removed:
+            self.state.pop(int(lane), None)
+            if 0 <= int(lane) < len(self.names):
+                self.names[int(lane)] = None
+        for lane, name in zip(pkt.named_idx, pkt.names):
+            self.names[int(lane)] = name
+        for lane, p, g in zip(pkt.idx, pkt.pos, pkt.goal):
+            self.state[int(lane)] = (int(p), int(g))
+        self.last_seq = pkt.seq
+        return DecodedUpdate(seq=pkt.seq,
+                             is_snapshot=pkt.kind == KIND_SNAPSHOT,
+                             idx=pkt.idx, pos=pkt.pos, goal=pkt.goal,
+                             removed=removed)
+
+
+def encode_response(seq: int, idx: Sequence[int], next_pos: Sequence[int],
+                    goal: Sequence[int]) -> Packet:
+    """Response packet: only lanes whose next_pos or goal changed (absent
+    lanes mean "no move, goal unchanged" — exactly the no-op the manager
+    already skips)."""
+    return Packet(kind=KIND_RESPONSE, seq=seq, base_seq=0, idx=_i32(idx),
+                  pos=_i32(next_pos), goal=_i32(goal))

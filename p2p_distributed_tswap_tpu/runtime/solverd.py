@@ -13,7 +13,8 @@ O(log N) recompiles; direction-field rows are cached per goal and recomputed
 only for goals not seen before (LRU eviction), since TSWAP goal exchange
 permutes goals far more often than the task lifecycle creates new ones.
 
-Wire: plan_request  {type, seq, agents:[{peer_id, pos:[x,y], goal:[x,y]}]}
+Wire (legacy JSON, always accepted):
+      plan_request  {type, seq, agents:[{peer_id, pos:[x,y], goal:[x,y]}]}
       plan_response {type, seq, duration_micros,
                      moves:[{peer_id, next_pos:[x,y], goal:[x,y]}]}
       (``goal`` in a move carries the step's swap/rotation decisions; the
@@ -22,6 +23,19 @@ Wire: plan_request  {type, seq, agents:[{peer_id, pos:[x,y], goal:[x,y]}]}
       (manager_centralized adopt_goal_exchanges).  Round 4 ignored the
       returned goals, which livelocked head-on pairs: rotation, retreat,
       goal reset, repeat.)
+
+Fast path (packed1, negotiated via the request's ``caps`` field — see
+runtime/plan_codec.py): requests carry base64 packed int32 snapshots/deltas
+instead of per-agent JSON.  The fleet state then lives DEVICE-RESIDENT
+between ticks (pos/goal/slot/active arrays at capacity) and a delta tick
+scatters in only the O(churn) changed lanes instead of re-uploading O(N);
+a seq gap in the delta chain makes the daemon publish
+``plan_snapshot_request`` and the manager resyncs with a full snapshot.
+Responses are packed too (only lanes that moved or changed goal).  The
+daemon loop is PIPELINED: the device step for request k is dispatched
+without blocking, the decode of request k+1 and the encode of response k
+overlap its execution, and the output fetch happens only when the response
+is actually due (dispatch-then-poll; ``solverd.pipeline_overlap_ms``).
 
 Usage: python -m p2p_distributed_tswap_tpu.runtime.solverd
            [--port 7400] [--map FILE] [--capacity-min 16] [--warm N]
@@ -33,6 +47,9 @@ dispatch -> device sync -> encode) into Chrome trace-event JSONL plus a
 per-tick heartbeat line judged against the manager's 500 ms planning
 budget; ``kill -USR1`` or a bus ``stats_request`` message dumps a
 machine-readable stats snapshot at any time (tracing not required).
+Live registry counters for the fast path: ``solverd.decode_bytes``,
+``solverd.delta_agents``, ``solverd.pipeline_overlap_ms``,
+``solverd.seq_gaps``, ``solverd.snapshots_applied``.
 
 ``--warm N`` pre-compiles the whole planning path for an N-agent fleet
 BEFORE the readiness banner: the step program at capacity(N), the
@@ -45,8 +62,10 @@ round-4 hardware run opened with a 77 s capacity-recompile stall).
 from __future__ import annotations
 
 import argparse
+import base64
 import functools
 import json
+import os
 import signal
 import sys
 import time
@@ -68,12 +87,51 @@ from p2p_distributed_tswap_tpu.ops.distance import (
     pack_directions,
     packed_cells,
 )
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pcodec
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
 from p2p_distributed_tswap_tpu.solver.step import step_parallel
 
 
+def _donation_ok() -> bool:
+    """Donate resident buffers to the scatter program only where donation
+    actually works: real TPU/GPU backends.  The axon tunnel raises
+    INVALID_ARGUMENT on donated programs and the CPU backend ignores
+    donation with a warning (see .claude/skills/verify — 'never rely on
+    donate_argnums here'), so both default off.  ``JG_DONATE=1`` forces it
+    on, ``JG_DONATE=0`` off."""
+    env = os.environ.get("JG_DONATE", "")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+    except RuntimeError:
+        return False
+
+
+class PendingPlan:
+    """A dispatched-but-unfetched device step (dispatch-then-poll): holds
+    the device output handles plus everything fetch() needs to finish the
+    plan after host work has overlapped the device execution."""
+
+    __slots__ = ("mode", "agents", "cap", "n", "new_pos", "new_goal",
+                 "base_pos", "base_goal", "base_active",
+                 "t_plan0", "t_sweep0", "t_disp0", "t_disp_end")
+
+
 class PlanService:
-    """Batched one-step planner with goal-field caching."""
+    """Batched one-step planner with goal-field caching.
+
+    Two request paths share the step program and the field cache:
+
+    - ``plan()`` / ``dispatch()``: stateless legacy path — the request
+      carries the whole fleet (JSON wire).
+    - ``resident_apply()`` + ``resident_dispatch()``: the packed fast
+      path — fleet state (pos/goal/slot/active) stays on device between
+      ticks and deltas scatter in O(churn) lanes.  Goals referenced by
+      resident agents are pinned against LRU eviction via refcounts.
+    """
 
     # Fresh-goal sweeps per jitted program call: new goals arrive a few per
     # tick (task churn), so a fixed small chunk keeps the program cached
@@ -84,6 +142,10 @@ class PlanService:
     # round-3 stress run showed each cache-growth recompile stalling whole
     # ticks (tests/test_solverd_stress.py).
     CACHE_BYTES = 256 << 20
+    # Delta scatters pad to the next power of two at least this size, so
+    # churn bursts retrace the scatter program O(log churn) times, not per
+    # distinct delta length.
+    SCATTER_CHUNK_MIN = 8
 
     def __init__(self, grid: Grid, capacity_min: int = 16,
                  field_cache: int = 4096):
@@ -103,6 +165,38 @@ class PlanService:
             direction_fields(self.free, goals).reshape(goals.shape[0], -1)))
         self._last_cap = 0
         self._seen_programs = 0
+        # device-resident fleet state (packed fast path); host mirrors stay
+        # in lockstep so responses and delta diffs never fetch the arrays
+        self.r_cap = 0
+        self.d_pos = self.d_goal = self.d_slot = self.d_active = None
+        self.h_pos = np.zeros(0, np.int32)
+        self.h_goal = np.zeros(0, np.int32)
+        self.h_slot = np.zeros(0, np.int32)
+        self.h_active = np.zeros(0, bool)
+        self.goal_ref: Dict[int, int] = {}  # resident goal -> lane count
+        self._scatter = None
+        self._scatter_donate = _donation_ok()
+        # Deferred field repair (packed fast path): a fresh goal whose
+        # direction field is not cached yet does NOT stall the tick — the
+        # agent plans one tick on the reserved all-STAY row (it waits in
+        # place; the goal-adjacency shortcut still moves it if 1 cell
+        # away) while the sweep runs in the daemon's idle window between
+        # ticks (process_field_queue).  On the CPU fallback one sweep
+        # program costs ~300 ms of dispatch-bound time — paying it inline
+        # would eat half the 500 ms tick budget for ONE task arrival.
+        # Off by default on accelerator backends (sweeps are ms there);
+        # JG_DEFER_FIELDS=1/0 overrides.
+        env_defer = os.environ.get("JG_DEFER_FIELDS", "")
+        if env_defer in ("0", "1"):
+            self.defer_fields = env_defer == "1"
+        else:
+            try:
+                self.defer_fields = jax.default_backend() == "cpu"
+            except RuntimeError:
+                self.defer_fields = False
+        self.field_queue: "OrderedDict[int, None]" = OrderedDict()
+        self.lane_wait: Dict[int, int] = {}   # lane -> goal it awaits
+        self.wait_lanes: Dict[int, set] = {}  # goal -> waiting lanes
         # observability: cumulative counters + the last plan's per-phase
         # wall times (obs/ heartbeat pulls these; a handful of
         # perf_counter reads per tick, negligible against the tick budget)
@@ -117,71 +211,81 @@ class PlanService:
             c *= 2
         return c
 
-    def _ensure_fields(self, goals: List[int]) -> None:
+    def _ensure_fields(self, goals: List[int], min_rows: int = 0) -> None:
         missing = [g for g in dict.fromkeys(goals) if g not in self.goal_rows]
-        pc = packed_cells(self.grid.num_cells)
-        rows_budget = max(self.max_fields, self._capacity(len(goals)))
+        rows_budget = max(self.max_fields,
+                          self._capacity(max(len(goals), min_rows)))
         if self.dirs is None or self.dirs.shape[0] < rows_budget:
-            old = self.dirs
-            self.dirs = jnp.full((rows_budget, pc), PACKED_STAY, jnp.uint32)
-            if old is not None:  # only on a capacity jump past the budget
-                self.dirs = self.dirs.at[:old.shape[0]].set(old)
+            # only grows on a capacity jump past the budget
+            self._grow_dirs(rows_budget)
         if not missing:
             return
         # evict LRU rows when over budget — never a goal of the current
-        # request (they sit at the LRU tail because plan() touches them
-        # before calling us, and the budget covers the request size)
+        # request (they sit at the LRU tail because the caller touches
+        # them first, and ``keep`` belt-and-braces that) nor a goal some
+        # resident agent still references (goal_ref pin; this also covers
+        # the permanent all-STAY pseudo-goal row, key -1)
+        keep = set(goals)
         while len(self.goal_rows) + len(missing) > self.dirs.shape[0]:
-            self.goal_rows.popitem(last=False)
+            victim = next((g for g in self.goal_rows
+                           if self.goal_ref.get(g, 0) == 0
+                           and g not in keep), None)
+            if victim is None:
+                break
+            del self.goal_rows[victim]
+        if len(self.goal_rows) + len(missing) > self.dirs.shape[0]:
+            # every cached row is pinned by live goals: grow the buffer
+            self._grow_dirs(self._capacity(len(self.goal_rows)
+                                           + len(missing)))
         used = set(self.goal_rows.values())
         free_rows = [r for r in range(self.dirs.shape[0]) if r not in used]
         rows = free_rows[:len(missing)]
         c = self.FIELD_CHUNK
-        # compute in fixed chunks (cached program), scatter ONCE: each
+        # compute in power-of-two chunks no larger than FIELD_CHUNK
+        # (bounded program count: 1, 2, 4, 8), scatter ONCE: each
         # .at[].set on the preallocated buffer copies the whole cache, so a
-        # startup burst must not pay one copy per chunk
+        # startup burst must not pay one copy per chunk.  The sub-chunk
+        # sizing matters on the CPU fallback, where one 8-wide sweep costs
+        # hundreds of ms — the steady-state single-fresh-goal tick must
+        # not pay 8x padding waste for 1 field.
         parts = []
-        for o in range(0, len(missing), c):
-            chunk = missing[o:o + c]
-            padded = chunk + [chunk[-1]] * (c - len(chunk))
+        o = 0
+        while o < len(missing):
+            rem = len(missing) - o
+            take = c if rem >= c else rem
+            size = c if rem >= c else 1 << (take - 1).bit_length()
+            chunk = missing[o:o + take]
+            padded = chunk + [chunk[-1]] * (size - take)
             parts.append(self._fields(jnp.asarray(padded,
-                                                  jnp.int32))[:len(chunk)])
+                                                  jnp.int32))[:take])
+            o += take
         fields = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         self.dirs = self.dirs.at[jnp.asarray(rows, jnp.int32)].set(fields)
         for g, r in zip(missing, rows):
             self.goal_rows[g] = r
 
-    def plan(self, agents: List[Tuple[str, int, int]]
-             ) -> List[Tuple[str, int, int]]:
-        """agents: [(peer_id, pos_cell, goal_cell)] ->
-        [(peer_id, next_cell, goal_cell)] after one TSWAP step."""
+    # -- stateless legacy path (JSON wire) --------------------------------
+
+    def dispatch(self, agents: List[Tuple[str, int, int]]) -> PendingPlan:
+        """Start one step for an explicit fleet; returns the un-synced
+        device handles (see :class:`PendingPlan`)."""
         n = len(agents)
         cap = self._capacity(n)
-        # Operator-visible recompile stalls (survivable — the manager keeps
-        # its own tick and drops the stale seq — but they must not be
-        # silent).  Detected via the jit cache size, which catches EVERY
-        # retrace — capacity changes AND dirs-buffer growth — and stays
-        # quiet on cache hits (e.g. shrinking back to a known capacity).
         t_plan0 = time.perf_counter()
         goals = [g for _, _, g in agents]
-        with trace.span("solverd.cache_lookup", agents=n):
-            uniq = dict.fromkeys(goals)
-            misses = sum(1 for g in uniq if g not in self.goal_rows)
-            hits = len(uniq) - misses
-            self.cache_hits += hits
-            self.cache_misses += misses
-            trace.count("solverd.field_cache_hits", hits)
-            trace.count("solverd.field_cache_misses", misses)
-            # LRU-touch cached request goals FIRST so eviction inside
-            # _ensure_fields can only hit goals absent from this request
-            for g in goals:
-                if g in self.goal_rows:
-                    self.goal_rows.move_to_end(g)
+        with trace.span("solverd.cache_lookup", agents=n,
+                        parent="solverd.tick"):
+            # counts hits/misses and LRU-touches cached request goals
+            # FIRST so eviction inside _ensure_fields can only hit goals
+            # absent from this request
+            misses = self._count_cache(goals)
         t_sweep0 = time.perf_counter()
-        with trace.span("solverd.field_sweep", fresh_goals=misses):
+        with trace.span("solverd.field_sweep", fresh_goals=misses,
+                        parent="solverd.tick"):
             self._ensure_fields(goals)
         t_disp0 = time.perf_counter()
-        with trace.span("solverd.step_dispatch", capacity=cap):
+        with trace.span("solverd.step_dispatch", capacity=cap,
+                        parent="solverd.tick"):
             cfg = SolverConfig(height=self.grid.height, width=self.grid.width,
                                num_agents=cap)
             pos = np.zeros(cap, np.int32)
@@ -196,38 +300,390 @@ class PlanService:
             new_pos, new_goal, _ = self._step(
                 cfg, jnp.asarray(pos), jnp.asarray(goal), jnp.asarray(slot),
                 self.dirs, jnp.asarray(active))
+        p = PendingPlan()
+        p.mode = "legacy"
+        p.agents = agents
+        p.cap, p.n = cap, n
+        p.new_pos, p.new_goal = new_pos, new_goal
+        p.base_pos = p.base_goal = p.base_active = None
+        p.t_plan0, p.t_sweep0, p.t_disp0 = t_plan0, t_sweep0, t_disp0
+        p.t_disp_end = time.perf_counter()
+        return p
+
+    def fetch(self, p: PendingPlan):
+        """Block on the device outputs of a dispatched step and finish the
+        plan.  Legacy mode returns ``[(peer_id, next_cell, goal_cell)]``;
+        resident mode returns ``(lanes, next_cells, goal_cells)`` int32
+        arrays holding only the lanes that moved or changed goal."""
         t_sync0 = time.perf_counter()
-        with trace.span("solverd.device_sync"):
-            new_pos = np.asarray(new_pos)
-            new_goal = np.asarray(new_goal)
+        with trace.span("solverd.device_sync", parent="solverd.tick"):
+            new_pos = np.asarray(p.new_pos)
+            new_goal = np.asarray(p.new_goal)
         t_end = time.perf_counter()
+        # Operator-visible recompile stalls (survivable — the manager keeps
+        # its own tick and drops the stale seq — but they must not be
+        # silent).  Detected via the jit cache size, which catches EVERY
+        # retrace — capacity changes AND dirs-buffer growth — and stays
+        # quiet on cache hits (e.g. shrinking back to a known capacity).
         new_cache = getattr(self._step, "_cache_size", lambda: None)()
         if new_cache is not None and new_cache > self._seen_programs:
             self.recompiles += 1
             trace.count("solverd.recompiles")
-            trace.instant("solverd.recompile", capacity=cap,
+            trace.instant("solverd.recompile", capacity=p.cap,
                           field_rows=int(self.dirs.shape[0]))
             print(f"⏳ recompiled step program "
-                  f"(capacity {self._last_cap} -> {cap}, "
+                  f"(capacity {self._last_cap} -> {p.cap}, "
                   f"{self.dirs.shape[0]} field rows): plan stalled "
-                  f"{time.perf_counter() - t_plan0:.1f}s", flush=True)
+                  f"{time.perf_counter() - p.t_plan0:.1f}s", flush=True)
             self._seen_programs = new_cache
-        self._last_cap = cap
+        self._last_cap = p.cap
         self.last_phase_ms = {
-            "cache_lookup": 1000.0 * (t_sweep0 - t_plan0),
-            "field_sweep": 1000.0 * (t_disp0 - t_sweep0),
-            "step_dispatch": 1000.0 * (t_sync0 - t_disp0),
+            "cache_lookup": 1000.0 * (p.t_sweep0 - p.t_plan0),
+            "field_sweep": 1000.0 * (p.t_disp0 - p.t_sweep0),
+            "step_dispatch": 1000.0 * (p.t_disp_end - p.t_disp0),
             "device_sync": 1000.0 * (t_end - t_sync0),
         }
-        return [(agents[k][0], int(new_pos[k]), int(new_goal[k]))
-                for k in range(n)]
+        if p.mode == "legacy":
+            return [(p.agents[k][0], int(new_pos[k]), int(new_goal[k]))
+                    for k in range(p.n)]
+        changed = p.base_active & ((new_pos != p.base_pos)
+                                   | (new_goal != p.base_goal))
+        lanes = np.flatnonzero(changed).astype(np.int32)
+        return (lanes, new_pos[lanes].astype(np.int32),
+                new_goal[lanes].astype(np.int32))
+
+    def plan(self, agents: List[Tuple[str, int, int]]
+             ) -> List[Tuple[str, int, int]]:
+        """agents: [(peer_id, pos_cell, goal_cell)] ->
+        [(peer_id, next_cell, goal_cell)] after one TSWAP step."""
+        return self.fetch(self.dispatch(agents))
+
+    # -- device-resident fast path (packed wire) --------------------------
+
+    def _resident_grow(self, lanes_needed: int) -> None:
+        cap = self._capacity(max(lanes_needed, 1))
+        if cap <= self.r_cap:
+            return
+        pad = cap - self.r_cap
+        self.h_pos = np.concatenate([self.h_pos, np.zeros(pad, np.int32)])
+        self.h_goal = np.concatenate([self.h_goal, np.zeros(pad, np.int32)])
+        self.h_slot = np.concatenate([self.h_slot, np.zeros(pad, np.int32)])
+        self.h_active = np.concatenate([self.h_active, np.zeros(pad, bool)])
+        if self.d_pos is None:
+            self.d_pos = jnp.zeros(cap, jnp.int32)
+            self.d_goal = jnp.zeros(cap, jnp.int32)
+            self.d_slot = jnp.zeros(cap, jnp.int32)
+            self.d_active = jnp.zeros(cap, bool)
+        else:
+            zi = jnp.zeros(pad, jnp.int32)
+            self.d_pos = jnp.concatenate([self.d_pos, zi])
+            self.d_goal = jnp.concatenate([self.d_goal, zi])
+            self.d_slot = jnp.concatenate([self.d_slot, zi])
+            self.d_active = jnp.concatenate([self.d_active,
+                                             jnp.zeros(pad, bool)])
+        self.r_cap = cap
+
+    def _scatter_fn(self):
+        if self._scatter is None:
+            def scatter(pos, goal, slot, active, idx, vp, vg, vs, va):
+                return (pos.at[idx].set(vp), goal.at[idx].set(vg),
+                        slot.at[idx].set(vs), active.at[idx].set(va))
+            kw = {"donate_argnums": (0, 1, 2, 3)} if self._scatter_donate \
+                else {}
+            self._scatter = jax.jit(scatter, **kw)
+        return self._scatter
+
+    def _ref_goal(self, goal: int, delta: int) -> None:
+        r = self.goal_ref.get(goal, 0) + delta
+        if r > 0:
+            self.goal_ref[goal] = r
+        else:
+            self.goal_ref.pop(goal, None)
+
+    def _count_cache(self, goals: List[int]) -> int:
+        uniq = dict.fromkeys(goals)
+        misses = sum(1 for g in uniq if g not in self.goal_rows)
+        hits = len(uniq) - misses
+        self.cache_hits += hits
+        self.cache_misses += misses
+        trace.count("solverd.field_cache_hits", hits)
+        trace.count("solverd.field_cache_misses", misses)
+        for g in goals:
+            if g in self.goal_rows:
+                self.goal_rows.move_to_end(g)
+        return misses
+
+    def _grow_dirs(self, rows: int) -> None:
+        """Reallocate the dirs buffer at ``rows`` capacity, preserving
+        existing rows (recompiles the step program, like a capacity
+        jump)."""
+        pc = packed_cells(self.grid.num_cells)
+        old = self.dirs
+        self.dirs = jnp.full((rows, pc), PACKED_STAY, jnp.uint32)
+        if old is not None:
+            self.dirs = self.dirs.at[:old.shape[0]].set(old)
+
+    def _stay_row(self) -> int:
+        """The permanent all-STAY row (pseudo-goal key -1, pinned): lanes
+        whose field is still being swept park here for a tick or two."""
+        row = self.goal_rows.get(-1)
+        if row is not None:
+            return row
+        if self.dirs is None:
+            self._ensure_fields([])  # allocates the dirs buffer
+        used = set(self.goal_rows.values())
+        row = next((r for r in range(self.dirs.shape[0]) if r not in used),
+                   None)
+        if row is None:
+            # cache saturated: evict an unpinned LRU goal, else grow
+            victim = next((g for g in self.goal_rows
+                           if self.goal_ref.get(g, 0) == 0), None)
+            if victim is not None:
+                row = self.goal_rows.pop(victim)
+            else:
+                row = self.dirs.shape[0]
+                self._grow_dirs(self._capacity(row + 1))
+        # a reused (previously evicted) row still holds its old field —
+        # the reserved row must genuinely say STAY everywhere
+        pc = packed_cells(self.grid.num_cells)
+        self.dirs = self.dirs.at[row].set(
+            jnp.full((pc,), PACKED_STAY, jnp.uint32))
+        self.goal_rows[-1] = row
+        self.goal_ref[-1] = 1  # never evicted, never swept
+        return row
+
+    def _unwait(self, lane: int) -> None:
+        g = self.lane_wait.pop(lane, None)
+        if g is not None:
+            s = self.wait_lanes.get(g)
+            if s is not None:
+                s.discard(lane)
+                if not s:
+                    del self.wait_lanes[g]
+
+    def _slot_of(self, lane: int, goal: int) -> int:
+        """Field row for a lane's goal; with deferred fields on, a missing
+        row parks the lane on the STAY row and queues the sweep (front of
+        the queue: a waiting agent outranks speculative prefetch)."""
+        self._unwait(lane)
+        row = self.goal_rows.get(goal)
+        if row is not None:
+            return row
+        self.lane_wait[lane] = goal
+        self.wait_lanes.setdefault(goal, set()).add(lane)
+        self.field_queue[goal] = None
+        self.field_queue.move_to_end(goal, last=False)
+        return self._stay_row()
+
+    def prefetch_goals(self, cells) -> None:
+        """Queue future goals (manager hints: e.g. delivery cells at task
+        assignment) for the idle-window sweep, so the field is resident
+        long before the pickup->delivery flip makes it live."""
+        for g in cells:
+            try:
+                g = int(g)
+            except (TypeError, ValueError):
+                continue
+            if 0 <= g < self.grid.num_cells and g not in self.goal_rows \
+                    and g not in self.field_queue:
+                self.field_queue[g] = None
+        registry.get_registry().gauge("solverd.field_queue",
+                                      len(self.field_queue))
+
+    def process_field_queue(self, max_goals: Optional[int] = None) -> int:
+        """Sweep up to one chunk of queued goal fields (called from the
+        daemon's idle window, NOT the tick path) and repair lanes parked
+        on the STAY row.  Returns goals processed."""
+        if not self.field_queue:
+            return 0
+        budget = max_goals or self.FIELD_CHUNK
+        popped = []
+        while self.field_queue and len(popped) < budget:
+            g, _ = self.field_queue.popitem(last=False)
+            popped.append(g)
+        missing = [g for g in popped if g not in self.goal_rows]
+        if missing:
+            with trace.span("solverd.field_prefetch", goals=len(missing)):
+                self._ensure_fields(missing, min_rows=len(self.goal_ref))
+            registry.get_registry().count("solverd.prefetched_fields",
+                                          len(missing))
+        registry.get_registry().gauge("solverd.field_queue",
+                                      len(self.field_queue))
+        # repair waiters for EVERY popped goal, not just freshly swept
+        # ones — a goal can enter goal_rows through another request path
+        # (e.g. a legacy JSON peer on the same daemon) while queued, and
+        # its parked lanes must still be released
+        lanes, slots = [], []
+        for g in popped:
+            for lane in sorted(self.wait_lanes.pop(g, ())):
+                if self.lane_wait.get(lane) == g and self.h_active[lane] \
+                        and int(self.h_goal[lane]) == g:
+                    del self.lane_wait[lane]
+                    lanes.append(lane)
+                    slots.append(self.goal_rows[g])
+                else:
+                    self.lane_wait.pop(lane, None)
+        if lanes:
+            la = np.asarray(lanes, np.int32)
+            vs = np.asarray(slots, np.int32)
+            self.h_slot[la] = vs
+            self._scatter_lanes(la, self.h_pos[la].copy(),
+                                self.h_goal[la].copy(), vs,
+                                self.h_active[la].copy())
+        return len(popped)
+
+    def _scatter_lanes(self, lanes, vp, vg, vs, va) -> None:
+        """O(churn) device update: scatter per-lane values into the
+        resident arrays, padded to a power-of-two chunk with duplicate
+        writes of entry 0 (same values -> idempotent) so churn bursts
+        retrace the program O(log churn) times."""
+        m = len(lanes)
+        chunk = self.SCATTER_CHUNK_MIN
+        while chunk < m:
+            chunk *= 2
+        if chunk > m:
+            pad = chunk - m
+            lanes = np.concatenate([lanes, np.full(pad, lanes[0], np.int32)])
+            vp = np.concatenate([vp, np.full(pad, vp[0], np.int32)])
+            vg = np.concatenate([vg, np.full(pad, vg[0], np.int32)])
+            vs = np.concatenate([vs, np.full(pad, vs[0], np.int32)])
+            va = np.concatenate([va, np.full(pad, va[0], bool)])
+        scatter = self._scatter_fn()
+        self.d_pos, self.d_goal, self.d_slot, self.d_active = scatter(
+            self.d_pos, self.d_goal, self.d_slot, self.d_active,
+            jnp.asarray(lanes), jnp.asarray(vp), jnp.asarray(vg),
+            jnp.asarray(vs), jnp.asarray(va))
+        registry.get_registry().count("solverd.resident_scatter_lanes", m)
+
+    def _ensure_rows_or_defer(self, goals: List[int]) -> None:
+        """Inline sweep for fresh goals — unless deferred fields are on,
+        in which case the tick path never sweeps (lanes park on the STAY
+        row via _slot_of and the idle window catches up)."""
+        misses = self._count_cache(goals)
+        if self.defer_fields:
+            return
+        with trace.span("solverd.field_sweep", fresh_goals=misses,
+                        parent="solverd.tick"):
+            self._ensure_fields(goals, min_rows=len(self.goal_ref))
+
+    def resident_apply(self, upd: "pcodec.DecodedUpdate") -> int:
+        """Fold one decoded snapshot/delta into the resident fleet state;
+        returns the number of lanes written."""
+        reg = registry.get_registry()
+        if upd.is_snapshot:
+            lanes = upd.idx.astype(np.int64)
+            self._resident_grow(int(lanes.max()) + 1 if lanes.size
+                                else self.capacity_min)
+            self.h_active[:] = False
+            self.h_pos[:] = 0
+            self.h_goal[:] = 0
+            self.h_slot[:] = 0
+            stay_pin = self.goal_ref.get(-1)
+            self.goal_ref = {} if stay_pin is None else {-1: stay_pin}
+            self.lane_wait = {}
+            self.wait_lanes = {}
+            goals = [int(g) for g in upd.goal]
+            for g in goals:
+                self._ref_goal(g, +1)
+            self._ensure_rows_or_defer(goals)
+            self.h_pos[lanes] = upd.pos
+            self.h_goal[lanes] = upd.goal
+            self.h_slot[lanes] = np.fromiter(
+                (self._slot_of(int(l), g)
+                 for l, g in zip(lanes, goals)), np.int32, len(goals))
+            self.h_active[lanes] = True
+            # a snapshot IS the O(N) resync: one full upload
+            self.d_pos = jnp.asarray(self.h_pos)
+            self.d_goal = jnp.asarray(self.h_goal)
+            self.d_slot = jnp.asarray(self.h_slot)
+            self.d_active = jnp.asarray(self.h_active)
+            reg.count("solverd.snapshots_applied")
+            return int(lanes.size)
+        # delta: one final value per lane (a lane can be vacated AND
+        # re-assigned to a new peer in the same packet — last write wins,
+        # matching PackedStateDecoder order)
+        final: Dict[int, Optional[Tuple[int, int]]] = {}
+        for lane in upd.removed:
+            final[int(lane)] = None
+        for lane, p, g in zip(upd.idx, upd.pos, upd.goal):
+            final[int(lane)] = (int(p), int(g))
+        if not final:
+            return 0
+        self._resident_grow(max(final) + 1)
+        goals = []
+        for lane, v in final.items():
+            if self.h_active[lane]:
+                self._ref_goal(int(self.h_goal[lane]), -1)
+            if v is not None:
+                self._ref_goal(v[1], +1)
+                goals.append(v[1])
+        self._ensure_rows_or_defer(goals)
+        m = len(final)
+        lanes = np.fromiter(final.keys(), np.int32, m)
+        vp = np.zeros(m, np.int32)
+        vg = np.zeros(m, np.int32)
+        vs = np.zeros(m, np.int32)
+        va = np.zeros(m, bool)
+        for k, (lane, v) in enumerate(final.items()):
+            if v is None:
+                self._unwait(lane)
+                continue
+            vp[k], vg[k] = v
+            vs[k] = self._slot_of(lane, v[1])
+            va[k] = True
+        self.h_pos[lanes] = vp
+        self.h_goal[lanes] = vg
+        self.h_slot[lanes] = vs
+        self.h_active[lanes] = va
+        self._scatter_lanes(lanes, vp, vg, vs, va)
+        return m
+
+    def resident_dispatch(self) -> Optional[PendingPlan]:
+        """Start one step over the device-resident fleet (no host->device
+        upload beyond what deltas already scattered); None if no lanes are
+        active."""
+        n = int(self.h_active.sum())
+        if n == 0:
+            return None
+        cap = self.r_cap
+        t0 = time.perf_counter()
+        with trace.span("solverd.step_dispatch", capacity=cap,
+                        parent="solverd.tick"):
+            cfg = SolverConfig(height=self.grid.height,
+                               width=self.grid.width, num_agents=cap)
+            new_pos, new_goal, _ = self._step(
+                cfg, self.d_pos, self.d_goal, self.d_slot, self.dirs,
+                self.d_active)
+        p = PendingPlan()
+        p.mode = "resident"
+        p.agents = None
+        p.cap, p.n = cap, n
+        p.new_pos, p.new_goal = new_pos, new_goal
+        # diff baselines: the resident mirrors AS OF this dispatch (the
+        # pipelined loop may scatter the next delta before fetch())
+        p.base_pos = self.h_pos.copy()
+        p.base_goal = self.h_goal.copy()
+        p.base_active = self.h_active.copy()
+        p.t_plan0 = p.t_sweep0 = p.t_disp0 = t0
+        p.t_disp_end = time.perf_counter()
+        return p
+
+
+class PendingTick:
+    """A tick in flight between :meth:`TickRunner.begin` and
+    :meth:`TickRunner.finish` (its device step is dispatched, its response
+    not yet encoded)."""
+
+    __slots__ = ("req", "plan", "t_dispatched")
 
 
 class TickRunner:
-    """One solverd planning tick, decode -> plan -> encode, as a plain
-    callable — the daemon loop drives it with bus frames; tests drive it
-    in-process with dicts.  Owns the tick span, the per-tick heartbeat
-    line, and the on-demand stats snapshot (SIGUSR1 / bus stats_request)."""
+    """One solverd planning tick, decode -> plan -> encode — as a plain
+    synchronous callable (:meth:`handle`: tests and simple drivers) or as
+    the split :meth:`ingest` / :meth:`begin` / :meth:`finish` phases the
+    pipelined daemon loop interleaves across requests.  Owns the tick
+    span, the per-tick heartbeat line, and the on-demand stats snapshot
+    (SIGUSR1 / bus stats_request)."""
 
     def __init__(self, service: PlanService, grid: Grid,
                  heartbeat: Optional[HeartbeatWriter] = None,
@@ -239,53 +695,209 @@ class TickRunner:
         self.ticks = 0
         self.dropped_total = 0
         self.registry = registry.get_registry()
+        self.packed = pcodec.PackedStateDecoder()
+        self.snapshot_needed = False
+        self._req: Optional[dict] = None
 
-    def handle(self, data: dict) -> Optional[dict]:
-        """plan_request dict -> plan_response dict (None for empty fleets)."""
-        seq = data.get("seq")
+    MAX_LANES = 1 << 20  # sanity ceiling on roster lanes (1M agents)
+
+    def _packet_sane(self, pkt) -> bool:
+        """Range-validate a decoded request packet: lanes within the sane
+        roster ceiling, cells within this grid."""
+        for a in (pkt.idx, pkt.named_idx, pkt.removed):
+            if a.size and (int(a.min()) < 0
+                           or int(a.max()) >= self.MAX_LANES):
+                return False
+        n_cells = self.grid.num_cells
+        for a in (pkt.pos, pkt.goal):
+            if a.size and (int(a.min()) < 0 or int(a.max()) >= n_cells):
+                return False
+        return True
+
+    def ingest(self, data: dict, stale: bool = False) -> bool:
+        """Decode one plan_request and fold it into solver state.  Packed
+        deltas are order-sensitive, so superseded (stale-drained) packed
+        requests are still APPLIED; stale JSON requests are skipped
+        outright (stateless wire).  Returns True when ``data`` became the
+        request to plan (:meth:`begin`)."""
         t0 = time.perf_counter()
-        with trace.span("solverd.tick", seq=seq):
-            with trace.span("solverd.request_decode"):
-                agents = []
-                w = self.grid.width
-                for e in data.get("agents", []):
-                    px, py = e["pos"]
-                    gx, gy = e["goal"]
-                    agents.append((e["peer_id"], py * w + px, gy * w + gx))
-                t_dec = time.perf_counter()
-            if not agents:
+        t0_ns = time.perf_counter_ns()
+        if data.get("codec") == pcodec.CODEC_NAME:
+            with trace.span("solverd.request_decode", parent="solverd.tick"):
+                try:
+                    raw = base64.b64decode(data.get("data") or "",
+                                           validate=True)
+                    pkt = pcodec.decode(raw)
+                except (ValueError, pcodec.CodecError):
+                    self.registry.count("solverd.bad_packets")
+                    return False
+                if not self._packet_sane(pkt):
+                    # a malformed-but-well-framed packet (bit flip, buggy
+                    # peer) must not wrap negative lanes into live ones or
+                    # allocate unbounded arrays — contain it like any
+                    # other bad packet
+                    self.registry.count("solverd.bad_packets")
+                    return False
+                self.registry.count("solverd.decode_bytes", len(raw))
+                if pkt.kind == pcodec.KIND_DELTA:
+                    # snapshots carry the whole fleet by design and have
+                    # their own counter — folding them into delta_agents
+                    # would overstate the O(churn) steady-state evidence
+                    self.registry.count("solverd.delta_agents",
+                                        int(pkt.idx.size))
+                    self.registry.gauge("solverd.last_delta_agents",
+                                        int(pkt.idx.size))
+                try:
+                    upd = self.packed.apply(pkt)
+                except pcodec.SeqGapError as e:
+                    self.snapshot_needed = True
+                    self.registry.count("solverd.seq_gaps")
+                    trace.instant("solverd.seq_gap", have=e.have_seq,
+                                  base=e.base_seq)
+                    return False
+                self.service.resident_apply(upd)
+                # manager hints (e.g. delivery cells at task assignment):
+                # sweep their fields in the idle window, long before the
+                # pickup flip makes them live goals
+                self.service.prefetch_goals(data.get("hints") or [])
+            if stale:
+                return False
+            caps = data.get("caps") or []
+            self._req = {"mode": "packed", "seq": data.get("seq"),
+                         "caps": caps, "t0": t0, "t0_ns": t0_ns,
+                         "t_dec": time.perf_counter()}
+            if pcodec.CODEC_NAME not in caps:
+                # JSON-response fallback: the pipelined loop ingests
+                # request k+1 (mutating the roster) before finishing k,
+                # so the names must be captured as of THIS request
+                self._req["names"] = list(self.packed.names)
+            return True
+        if stale:
+            return False  # stateless wire: only the newest matters
+        with trace.span("solverd.request_decode", parent="solverd.tick"):
+            agents = []
+            w = self.grid.width
+            for e in data.get("agents", []):
+                px, py = e["pos"]
+                gx, gy = e["goal"]
+                agents.append((e["peer_id"], py * w + px, gy * w + gx))
+        if not agents:
+            self._req = None
+            return False
+        self._req = {"mode": "json", "seq": data.get("seq"),
+                     "agents": agents, "t0": t0, "t0_ns": t0_ns,
+                     "t_dec": time.perf_counter()}
+        return True
+
+    def begin(self) -> Optional[PendingTick]:
+        """Dispatch the device step for the last ingested request (no
+        blocking on device outputs)."""
+        r, self._req = self._req, None
+        if r is None:
+            return None
+        if r["mode"] == "json":
+            plan = self.service.dispatch(r["agents"])
+        else:
+            plan = self.service.resident_dispatch()
+            if plan is None:
                 return None
-            moves = self.service.plan(agents)
-            t_plan = time.perf_counter()
-            us = int((t_plan - t0) * 1e6)
-            with trace.span("solverd.reply_encode"):
+        p = PendingTick()
+        p.req, p.plan = r, plan
+        p.t_dispatched = time.perf_counter()
+        return p
+
+    def finish(self, pending: PendingTick,
+               pipelined: bool = False) -> Optional[dict]:
+        """Fetch the step outputs, encode and return the plan_response."""
+        r, plan = pending.req, pending.plan
+        t_fetch0 = time.perf_counter()
+        # host time that ran concurrently with the device step (decode of
+        # the next request, response publish, bus polling)
+        overlap_ms = 1000.0 * (t_fetch0 - pending.t_dispatched)
+        self.registry.observe("solverd.pipeline_overlap_ms", overlap_ms)
+        result = self.service.fetch(plan)
+        t_plan = time.perf_counter()
+        # busy time only: decode+dispatch plus fetch — the pipeline's idle
+        # overlap window is not the daemon's cost
+        us = int(1e6 * ((pending.t_dispatched - r["t0"])
+                        + (t_plan - t_fetch0)))
+        with trace.span("solverd.reply_encode", parent="solverd.tick"):
+            w = self.grid.width
+            if r["mode"] == "json":
                 resp = {
                     "type": "plan_response",
-                    "seq": seq,
+                    "seq": r["seq"],
                     "duration_micros": us,
                     "moves": [{"peer_id": pid,
                                "next_pos": [c % w, c // w],
                                "goal": [g % w, g // w]}
-                              for pid, c, g in moves],
+                              for pid, c, g in result],
                 }
-            t_end = time.perf_counter()
+            else:
+                lanes, npos, ngoal = result
+                if pcodec.CODEC_NAME in r["caps"]:
+                    resp = {
+                        "type": "plan_response",
+                        "seq": r["seq"],
+                        "codec": pcodec.CODEC_NAME,
+                        "duration_micros": us,
+                        "data": pcodec.encode_b64(
+                            pcodec.encode_response(r["seq"], lanes, npos,
+                                                   ngoal)),
+                    }
+                else:
+                    # packed request from a peer that cannot read packed
+                    # responses: answer on the legacy wire via the roster
+                    # AS OF this request (captured in ingest — the live
+                    # roster may already reflect the next delta)
+                    names = r.get("names") or []
+                    moves = []
+                    for lane, c, g in zip(lanes, npos, ngoal):
+                        pid = names[int(lane)] \
+                            if 0 <= int(lane) < len(names) else None
+                        if pid is None:
+                            continue
+                        moves.append({"peer_id": pid,
+                                      "next_pos": [int(c) % w, int(c) // w],
+                                      "goal": [int(g) % w, int(g) // w]})
+                    resp = {"type": "plan_response", "seq": r["seq"],
+                            "duration_micros": us, "moves": moves}
+        t_end = time.perf_counter()
         self.ticks += 1
-        total_ms = 1000.0 * (t_end - t0)
+        total_ms = 1000.0 * (t_end - r["t0"])
+        # the tick span is stamped retroactively (phases carry an explicit
+        # parent arg): in pipelined mode the phases of one tick interleave
+        # with other requests' work, so no live span can wrap them — and
+        # the span must be emitted BEFORE the heartbeat's flush either way
+        trace.complete("solverd.tick",
+                       r["t0_ns"], time.perf_counter_ns() - r["t0_ns"],
+                       seq=r["seq"], pipelined=pipelined)
         # live tick accounting (always on): the fleet rollup's per-peer
         # tick p50/p95 vs the 500 ms budget comes from this histogram
         self.registry.observe("tick_ms", total_ms)
         if total_ms > self.budget_ms:
             self.registry.count("tick.over_budget")
-        self.registry.gauge("tick.agents", len(agents))
+        self.registry.gauge("tick.agents", plan.n)
         if self.heartbeat is not None:
             phase_ms = dict(self.service.last_phase_ms)
-            phase_ms["decode"] = 1000.0 * (t_dec - t0)
+            phase_ms["decode"] = 1000.0 * (r["t_dec"] - r["t0"])
             phase_ms["encode"] = 1000.0 * (t_end - t_plan)
+            if pipelined:
+                phase_ms["overlap"] = overlap_ms
             phase_ms["total"] = total_ms
-            self.heartbeat.beat(seq, len(agents), phase_ms,
+            self.heartbeat.beat(r["seq"], plan.n, phase_ms,
                                 counters=trace.snapshot()["counters"])
             trace.flush()
         return resp
+
+    def handle(self, data: dict) -> Optional[dict]:
+        """plan_request dict -> plan_response dict (None for empty fleets
+        or non-planning packets) — the synchronous decode->plan->encode
+        path tests and simple drivers use."""
+        pending = self.begin() if self.ingest(data) else None
+        if pending is None:
+            return None
+        return self.finish(pending)
 
     def stats(self) -> dict:
         """Machine-readable daemon state: tracer snapshot + service view."""
@@ -300,6 +912,12 @@ class TickRunner:
             "max_fields": svc.max_fields,
             "recompiles": svc.recompiles,
             "capacity": svc._last_cap,
+            "resident_lanes": int(svc.h_active.sum()),
+            "resident_capacity": svc.r_cap,
+            "packed_last_seq": self.packed.last_seq,
+            "defer_fields": svc.defer_fields,
+            "field_queue": len(svc.field_queue),
+            "deferred_lanes": len(svc.lane_wait),
             "last_phase_ms": {k: round(v, 3)
                               for k, v in svc.last_phase_ms.items()},
         }
@@ -369,8 +987,13 @@ def main(argv=None) -> int:
         sel = rng.choice(free_idx, size=2 * n, replace=False)
         service.plan([(f"warm{k}", int(sel[k]), int(sel[n + k]))
                       for k in range(n)])
+        # also pre-compile the small sweep chunk programs (1/2/4): steady
+        # task churn arrives a goal or two per tick and must not pay a
+        # first-use compile mid-fleet
+        for size in (1, 2, 4):
+            service._fields(jnp.asarray([int(sel[0])] * size, jnp.int32))
         print(f"🔥 pre-warmed: capacity {service._capacity(n)} step "
-              f"program, field chunk program, {n} field rows in "
+              f"program, field chunk programs, {n} field rows in "
               f"{time.perf_counter() - t0:.1f}s", flush=True)
     heartbeat = None
     if tracer.enabled:
@@ -398,26 +1021,49 @@ def main(argv=None) -> int:
         print("📈 stats " + json.dumps(runner.stats()), flush=True)
         trace.flush()
 
+    def answer_stats() -> None:
+        # on-demand machine-readable snapshot over the bus (the
+        # operator-CLI / harness analog of SIGUSR1)
+        bus.publish("solver", {"type": "stats_response", **runner.stats()})
+        trace.flush()
+
     trace.instant("solverd.up", port=args.port)
     print(f"🧮 solverd up on port {args.port} "
           f"(grid {grid.height}x{grid.width}, devices={jax.devices()})")
     sys.stdout.flush()
 
+    # Pipelined tick loop (dispatch-then-poll): after dispatching the step
+    # for request k the daemon returns to the bus instead of blocking on
+    # the device — the decode of request k+1 and the publish of response k
+    # overlap the device execution; the output fetch happens when the next
+    # request arrives or a short poll timeout fires.
+    pending: Optional[PendingTick] = None
     while True:
-        frame = bus.recv(timeout=1.0)
-        beacon.maybe_beat()  # ~2 s cadence riding the 1 s recv timeout
+        # short poll while a step is in flight; medium poll while queued
+        # field sweeps wait for an idle window (they must run BETWEEN
+        # ticks, not only when the bus goes fully silent for 1 s)
+        frame = bus.recv(timeout=0.002 if pending is not None
+                         else (0.02 if service.field_queue else 1.0))
+        beacon.maybe_beat()  # ~2 s cadence riding the recv timeout
         if stats_requested["flag"]:
             stats_requested["flag"] = False
             dump_stats()
-        if frame is None or frame.get("op") != "msg":
+        if frame is None:
+            if pending is not None:
+                resp = runner.finish(pending, pipelined=True)
+                pending = None
+                if resp is not None:
+                    bus.publish("solver", resp)
+            elif service.field_queue:
+                # idle window between ticks: sweep queued/prefetched goal
+                # fields OFF the tick path (deferred field repair)
+                service.process_field_queue()
+            continue
+        if frame.get("op") != "msg":
             continue
         data = frame.get("data") or {}
         if data.get("type") == "stats_request":
-            # on-demand machine-readable snapshot over the bus (the
-            # operator-CLI / harness analog of SIGUSR1)
-            bus.publish("solver", {"type": "stats_response",
-                                   **runner.stats()})
-            trace.flush()
+            answer_stats()
             continue
         if data.get("type") != "plan_request":
             continue
@@ -425,8 +1071,10 @@ def main(argv=None) -> int:
         # plan, recompile stall), requests queue up on the socket.  Only the
         # NEWEST is worth computing — the manager discards stale seqs anyway
         # (manager_centralized handle_plan_response) — so drain the queue
-        # and plan once.
-        dropped = 0
+        # and plan once.  Packed deltas are order-sensitive: superseded
+        # packed requests still fold into resident state (ingest stale=True)
+        # before the newest is planned.
+        reqs = [data]
         while True:
             # small positive timeout: 0.0 would flip the socket into
             # non-blocking mode, whose BlockingIOError recv() doesn't catch
@@ -437,22 +1085,37 @@ def main(argv=None) -> int:
                 continue
             ndata = nxt.get("data") or {}
             if ndata.get("type") == "plan_request":
-                data = ndata
-                dropped += 1
+                reqs.append(ndata)
             elif ndata.get("type") == "stats_request":
                 # a stats_request queued behind plan_requests must not be
                 # swallowed by the stale drain — answer it right here
-                bus.publish("solver", {"type": "stats_response",
-                                       **runner.stats()})
+                answer_stats()
+        for stale_req in reqs[:-1]:
+            runner.ingest(stale_req, stale=True)
+        ok = runner.ingest(reqs[-1])
+        if runner.snapshot_needed:
+            runner.snapshot_needed = False
+            bus.publish("solver", {
+                "type": "plan_snapshot_request",
+                "have_seq": (runner.packed.last_seq
+                             if runner.packed.last_seq is not None else -1)})
+            print("🔁 plan delta chain broken; requested full snapshot",
+                  flush=True)
+        dropped = len(reqs) - 1
         if dropped:
             runner.dropped_total += dropped
             trace.count("solverd.dropped_stale", dropped)
             print(f"⏭️  dropped {dropped} stale plan_request(s) "
                   f"({runner.dropped_total} total); planning seq "
-                  f"{data.get('seq')}", flush=True)
-        resp = runner.handle(data)
-        if resp is not None:
-            bus.publish("solver", resp)
+                  f"{reqs[-1].get('seq')}", flush=True)
+        nxt_pending = runner.begin() if ok else None
+        if pending is not None:
+            # request k+1 is already on the device; its decode (above) and
+            # this fetch+encode+publish of response k are the overlap
+            resp = runner.finish(pending, pipelined=True)
+            if resp is not None:
+                bus.publish("solver", resp)
+        pending = nxt_pending
 
 
 if __name__ == "__main__":

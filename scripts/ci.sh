@@ -30,6 +30,12 @@ if [[ "${1:-}" == "--syntax" ]]; then
     exit 0
 fi
 
+echo "== codec fuzz gate =="
+# random fleets through both plan codecs (ISSUE 3 satellite): py/cpp
+# packed encoders must be byte-identical and resident packed planning
+# must equal stateless JSON planning
+JAX_PLATFORMS=cpu python scripts/codec_fuzz.py
+
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting before the DOTS_PASSED diagnostic

@@ -231,6 +231,163 @@ def test_centralized_tpu_solver_fleet(built, tiny_map, tmp_path):
         assert "solverd up" in solverd_log
 
 
+def test_packed_plan_wire_live_fleet(built, tiny_map, tmp_path):
+    """ISSUE 3 tentpole e2e: the default --solver=tpu wire is the packed
+    codec.  A live fleet completes tasks end-to-end while every
+    plan_request on the bus carries base64 packed data (no JSON agents
+    arrays), responses come back packed, and after the initial snapshot
+    the requests are deltas."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    with Fleet("centralized", num_agents=2, port=port, map_file=tiny_map,
+               solver="tpu", log_dir=str(log_dir),
+               solverd_args=["--cpu"]) as fleet:
+        spy = BusClient(port=port, peer_id="wire-spy")
+        spy.subscribe("solver")
+        time.sleep(4)
+        fleet.command("tasks 2")
+
+        kinds = []
+        packed_resps = 0
+        json_frames = 0
+        deadline = time.monotonic() + 90
+
+        def agents_done():
+            return sum(f.read_text(errors="ignore").count("DONE")
+                       for f in log_dir.glob("agent_*.log")) >= 2
+
+        while time.monotonic() < deadline:
+            f = spy.recv(timeout=1.0)
+            if f and f.get("op") == "msg":
+                d = f.get("data") or {}
+                if d.get("type") == "plan_request":
+                    if d.get("codec") == pc.CODEC_NAME:
+                        kinds.append(pc.decode_b64(d["data"]).kind)
+                    else:
+                        json_frames += 1
+                elif (d.get("type") == "plan_response"
+                        and d.get("codec") == pc.CODEC_NAME):
+                    packed_resps += 1
+            if agents_done() and len(kinds) >= 5:
+                break
+        done = agents_done()
+        spy.close()
+        fleet.quit()
+        assert done, "".join(f.read_text(errors="ignore")[-500:]
+                             for f in sorted(log_dir.glob("*.log")))
+    assert json_frames == 0, "legacy JSON plan_requests on a packed fleet"
+    assert kinds and kinds[0] == pc.KIND_SNAPSHOT, kinds
+    assert pc.KIND_DELTA in kinds, f"no delta ticks observed: {kinds}"
+    assert packed_resps >= 1, "no packed plan_responses observed"
+
+
+def test_json_codec_manager_interops_with_solverd(built, tiny_map,
+                                                  tmp_path):
+    """Caps negotiation: a JSON-only manager (JG_PLAN_CODEC=json — the
+    stand-in for any plain-JSON peer) still completes tasks against the
+    same solverd, which must answer on the legacy JSON wire."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    with Fleet("centralized", num_agents=2, port=port, map_file=tiny_map,
+               solver="tpu", log_dir=str(log_dir),
+               solverd_args=["--cpu"],
+               env={"JG_PLAN_CODEC": "json"}) as fleet:
+        spy = BusClient(port=port, peer_id="wire-spy")
+        spy.subscribe("solver")
+        time.sleep(4)
+        fleet.command("tasks 2")
+
+        json_moves = 0
+        deadline = time.monotonic() + 90
+
+        def agents_done():
+            return sum(f.read_text(errors="ignore").count("DONE")
+                       for f in log_dir.glob("agent_*.log")) >= 2
+
+        while time.monotonic() < deadline:
+            f = spy.recv(timeout=1.0)
+            if f and f.get("op") == "msg":
+                d = f.get("data") or {}
+                if d.get("type") == "plan_response" and "moves" in d:
+                    json_moves += 1
+            if agents_done() and json_moves >= 2:
+                break
+        done = agents_done()
+        spy.close()
+        fleet.quit()
+        assert done, "".join(f.read_text(errors="ignore")[-500:]
+                             for f in sorted(log_dir.glob("*.log")))
+    assert json_moves >= 1, "solverd never answered on the JSON wire"
+
+
+def test_solverd_restart_triggers_snapshot_resync(built, tiny_map,
+                                                  tmp_path):
+    """Seq-gap recovery end-to-end: kill solverd mid-run and start a fresh
+    one — its empty delta chain must make it publish
+    plan_snapshot_request, the manager must answer with a full snapshot,
+    and the fleet must keep completing tasks on the packed wire."""
+    import sys
+
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    sd2 = None
+    sd2_log = None
+    with Fleet("centralized", num_agents=2, port=port, map_file=tiny_map,
+               solver="tpu", log_dir=str(log_dir),
+               solverd_args=["--cpu"],
+               env={"MAPD_SOLVER_FAILOVER_MS": "2000"}) as fleet:
+        try:
+            time.sleep(4)
+            fleet.command("tasks 2")
+
+            def done_count():
+                return sum(f.read_text(errors="ignore").count("DONE")
+                           for f in log_dir.glob("agent_*.log"))
+
+            assert _wait_for(lambda: done_count() >= 1, timeout=60), \
+                "fleet not functional before the solverd restart"
+            fleet.procs[1].kill()  # [bus, solverd, manager, agents...]
+            time.sleep(1.0)
+            sd2_log = open(tmp_path / "solverd2.log", "w")
+            sd2 = subprocess.Popen(
+                [sys.executable, "-m",
+                 "p2p_distributed_tswap_tpu.runtime.solverd",
+                 "--port", str(port), "--map", tiny_map, "--cpu"],
+                stdout=sd2_log, stderr=subprocess.STDOUT,
+                cwd=str(Path(__file__).resolve().parents[1]))
+
+            def resynced():
+                mgr = (log_dir / "manager.log").read_text(errors="ignore")
+                sd = (tmp_path / "solverd2.log").read_text(errors="ignore")
+                return ("requested a plan snapshot" in mgr
+                        and "requested full snapshot" in sd)
+
+            assert _wait_for(resynced, timeout=60), (
+                (log_dir / "manager.log").read_text(
+                    errors="ignore")[-1500:]
+                + (tmp_path / "solverd2.log").read_text(
+                    errors="ignore")[-1500:])
+            base = done_count()
+            fleet.command("tasks 2")
+            assert _wait_for(lambda: done_count() >= base + 2, timeout=60), (
+                "no completions after the snapshot resync:\n"
+                + (log_dir / "manager.log").read_text(
+                    errors="ignore")[-1500:])
+            fleet.quit()
+        finally:
+            if sd2 is not None:
+                sd2.kill()
+            if sd2_log is not None:
+                sd2_log.close()
+
+
 def test_task_requeued_on_mute_agent(built, tiny_map, tmp_path):
     """SIGSTOP an agent mid-task: its TCP stays open (no peer_left), but the
     decentralized manager's stale sweep must re-queue the task so another
@@ -634,6 +791,89 @@ def test_bus_fault_injection_drops_one_frame(built, tmp_path):
         bus_log.close()
     log = (tmp_path / "bus.log").read_text(errors="ignore")
     assert "fault injection: dropped chat frame" in log, log[-1000:]
+
+
+def test_legacy_swap_response_without_request_id_accepted(built, tiny_map,
+                                                          tmp_path):
+    """ADVICE r5 medium: the reference agent answers swap_request WITHOUT
+    echoing request_id (agent.rs:1117-1122).  A scripted legacy peer parks
+    on our agent's next hop (claiming it as its goal), waits for the
+    agent's swap_request, and answers request_id-less carrying its own
+    task.  The agent must ACCEPT the response — observable as its goal
+    moving to the offered task's pickup — instead of silently dropping it
+    and keeping a duplicate task holder on the wire."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    with Fleet("decentralized", num_agents=1, port=port, map_file=tiny_map,
+               log_dir=str(log_dir)) as fleet:
+        time.sleep(3.5)
+        legacy = BusClient(port=port, peer_id="legacy-swapper")
+        legacy.subscribe("mapd")
+        fleet.command("tasks 1")
+
+        def next_hop(pos, goal):
+            # reference neighbor order, first strict improvement — same
+            # next hop the agent's own BFS descent picks on an empty map
+            x, y = pos
+            gx, gy = goal
+            d0 = abs(x - gx) + abs(y - gy)
+            for dx, dy in ((0, 1), (1, 0), (0, -1), (-1, 0)):
+                nx, ny = x + dx, y + dy
+                if 0 <= nx < 12 and 0 <= ny < 12 \
+                        and abs(nx - gx) + abs(ny - gy) < d0:
+                    return [nx, ny]
+            return None
+
+        fake_pickup, fake_delivery = [10, 11], [0, 11]
+        agent_id = None
+        agent_pos = agent_goal = None
+        swap_seen = False
+        goal_adopted = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not goal_adopted:
+            f = legacy.recv(timeout=1.0)
+            if not f or f.get("op") != "msg":
+                continue
+            d = f.get("data") or {}
+            typ = d.get("type")
+            if typ == "position" and d.get("peer_id") != "legacy-swapper":
+                agent_id = d["peer_id"]
+                agent_pos, agent_goal = d.get("pos"), d.get("goal")
+                if swap_seen and agent_goal == fake_pickup:
+                    goal_adopted = True
+                elif not swap_seen and agent_pos and agent_goal \
+                        and agent_pos != agent_goal:
+                    hop = next_hop(agent_pos, agent_goal)
+                    if hop:
+                        # park "at our goal" on the agent's next hop: its
+                        # decision tick reads Rule 3 -> swap_request to us
+                        legacy.publish("mapd", {
+                            "type": "position",
+                            "peer_id": "legacy-swapper",
+                            "pos": hop, "goal": hop,
+                            "position": hop})
+            elif typ == "swap_request" \
+                    and d.get("to_peer") == "legacy-swapper":
+                swap_seen = True
+                legacy.publish("mapd", {  # NOTE: no request_id (legacy)
+                    "type": "swap_response",
+                    "from_peer": "legacy-swapper",
+                    "to_peer": d["from_peer"],
+                    "task": {"pickup": fake_pickup,
+                             "delivery": fake_delivery,
+                             "task_id": 999, "peer_id": None},
+                    "phase": "pickup"})
+        legacy.close()
+        fleet.quit()
+        agent_log = "".join(f.read_text(errors="ignore")
+                            for f in sorted(log_dir.glob("agent_*.log")))
+        assert swap_seen, ("agent never sent a swap_request to the parked "
+                           "legacy peer:\n" + agent_log[-2000:])
+        assert goal_adopted, (
+            "request_id-less swap_response was dropped — the agent never "
+            "adopted the offered task's pickup goal:\n" + agent_log[-2000:])
 
 
 def test_legacy_goal_swap_cannot_strand_agent(built, tiny_map, tmp_path):

@@ -1,0 +1,44 @@
+"""Slow wrapper for the live-fleet crossover harness (ISSUE 3
+acceptance artifact): a tiny-rung run proving the harness end-to-end —
+fleet comes up, beacons flow, rows carry latency + wire numbers.  The
+committed artifact (results/solver_crossover_r06.json) comes from the
+full ``--counts 50,300,1000,3000`` run; tier-1 excludes this via the
+``slow`` marker."""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not (ROOT / "cpp" / "build" / "mapd_bus").exists()
+        and (shutil.which("cmake") is None or shutil.which("ninja") is None),
+        reason="C++ toolchain unavailable"),
+]
+
+
+def test_crossover_harness_smoke(tmp_path):
+    out = tmp_path / "crossover.json"
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "analysis" / "solver_crossover.py"),
+         "--counts", "20", "--variants", "native,packed",
+         "--window", "8", "--settle", "5", "--out", str(out)],
+        capture_output=True, text=True, timeout=900, cwd=str(ROOT))
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    result = json.loads(out.read_text())
+    rows = {r["variant"]: r for r in result["rows"]}
+    assert rows["native"]["ticks"] > 5
+    assert rows["packed"]["ticks"] > 5
+    assert "ms_per_tick_p50" in rows["native"]
+    # the packed run must actually have exercised the fast path
+    assert rows["packed"]["responses_applied"] > 0
+    assert rows["packed"]["solverd"]["seq_gaps"] == 0
+    assert rows["packed"]["solver_wire_bytes_per_tick"] > 0
+    assert (out.with_name(out.name + ".md")).exists()
