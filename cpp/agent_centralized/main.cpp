@@ -24,6 +24,8 @@
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
+#include "../common/plan_codec.hpp"
+#include "../common/region.hpp"
 
 using namespace mapd;
 
@@ -49,6 +51,21 @@ int main(int argc, char** argv) {
   // done retransmit cadence until the manager acks (lost-done desync fix)
   const int64_t done_retry_ms =
       knobs.get_int("--done-retry-ms", "MAPD_DONE_RETRY_MS", 2000);
+  // Region-sharded heartbeats (ISSUE 4): the manager is the only consumer
+  // of a centralized agent's position, yet the flat "mapd" broadcast fans
+  // every heartbeat to every OTHER agent too.  With region gossip on the
+  // heartbeat is a packed pos1 beacon on mapd.pos.<rx>.<ry>, which only
+  // the wildcard-subscribed manager receives — fanout N, not N².
+  // JG_REGION_GOSSIP=0 restores the flat JSON wire.
+  const bool region_gossip =
+      knobs.get_int("--region-gossip", "JG_REGION_GOSSIP", 1) != 0;
+  const RegionMap regions(static_cast<int>(
+      knobs.get_int("--region-cells", "JG_REGION_CELLS",
+                    kDefaultRegionCells)));
+  // slow JSON heartbeat cadence under region gossip, so a flat-wire
+  // manager (kill-switched or reference-wire) still tracks this agent
+  const int64_t legacy_pos_ms =
+      knobs.get_int("--legacy-pos-ms", "JG_LEGACY_POS_MS", 2000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -101,7 +118,25 @@ int main(int argc, char** argv) {
     return grid.cell(x, y);
   };
 
+  int64_t last_legacy_pos_ms = 0;
   auto broadcast_position = [&]() {
+    if (region_gossip) {
+      // packed heartbeat on the region topic (goal = pos: the centralized
+      // agent has no local goal; the manager steers it by instruction)
+      Json b;
+      b.set("type", "pos1")
+          .set("data", codec::encode_pos1_b64(
+                           my_pos, my_pos, my_task.has_value(),
+                           my_task ? (*my_task)["task_id"].as_int() : 0));
+      bus.publish(regions.topic_for(grid, my_pos), b);
+      // a slow JSON heartbeat rides along so a flat-wire manager (the
+      // kill switch set on its side, or a reference-wire build) still
+      // gets liveness + busy tracking
+      const int64_t now = mono_ms();
+      if (legacy_pos_ms <= 0 || now - last_legacy_pos_ms < legacy_pos_ms)
+        return;
+      last_legacy_pos_ms = now;
+    }
     Json upd;
     upd.set("type", "position_update")
         .set("peer_id", my_id)

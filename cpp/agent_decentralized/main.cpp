@@ -30,6 +30,8 @@
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
+#include "../common/plan_codec.hpp"
+#include "../common/region.hpp"
 #include "../common/tswap.hpp"
 
 using namespace mapd;
@@ -109,6 +111,23 @@ int main(int argc, char** argv) {
   args.done_retry_ms =
       knobs.get_int("--done-retry-ms", "MAPD_DONE_RETRY_MS",
                     args.done_retry_ms);
+  // Region-sharded position gossip (ISSUE 4 tentpole): beacons go to
+  // mapd.pos.<rx>.<ry> as packed pos1, subscriptions cover only the
+  // region neighborhood of the radius-15 view — fanout becomes O(local
+  // density) instead of O(N).  JG_REGION_GOSSIP=0 falls back to the flat
+  // legacy wire (JSON position+position_update on "mapd").
+  const bool region_gossip =
+      knobs.get_int("--region-gossip", "JG_REGION_GOSSIP", 1) != 0;
+  const RegionMap regions(static_cast<int>(
+      knobs.get_int("--region-cells", "JG_REGION_CELLS",
+                    kDefaultRegionCells)));
+  // Legacy-peer interop (caps negotiation): a slow JSON `position`
+  // discovery beacon on "mapd" every legacy_pos_ms lets flat-topic JSON
+  // peers find us; hearing a capsless JSON position (or a capsless
+  // occupied_request) switches to full-rate JSON echo for legacy_ttl_ms.
+  const int64_t legacy_pos_ms =
+      knobs.get_int("--legacy-pos-ms", "JG_LEGACY_POS_MS", 2000);
+  const int64_t legacy_ttl_ms = 15000;
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -142,6 +161,9 @@ int main(int argc, char** argv) {
   {
     Json req;
     req.set("type", "occupied_request").set("peer_id", my_id);
+    Json caps;  // capability marker (see legacy echo below)
+    caps.push_back(Json("pos1"));
+    req.set("caps", caps);
     bus.publish("mapd", req);
     int64_t deadline = mono_ms() + 2000;
     while (mono_ms() < deadline && !g_stop) {
@@ -209,14 +231,52 @@ int main(int argc, char** argv) {
   long long unacked_done_id = -1;
   int64_t done_last_sent_ms = 0;
 
-  auto publish_position = [&]() {
+  // ---- region-sharded position gossip state ----
+  std::set<std::string> region_subs;  // current neighborhood topics
+  Cell subs_region = -1;           // region anchor of region_subs
+  int64_t legacy_until = 0;        // JSON echo active until this mono_ms
+  int64_t last_legacy_pos_ms = 0;  // slow discovery-beacon cadence
+
+  // Re-subscribe on region crossings: diff the wanted neighborhood
+  // against the current one.  New topics are subscribed BEFORE this
+  // tick's beacon goes out on the new region topic, and the overlap of
+  // consecutive neighborhoods stays subscribed throughout, so no
+  // neighbor beacon is missed at a border.  The neighborhood depends
+  // only on the REGION index, so ticks that stay inside one region — the
+  // overwhelming majority — return before building any topic strings.
+  auto update_region_subs = [&]() {
+    const Cell anchor = grid.cell(grid.x_of(my_pos) / regions.cells(),
+                                  grid.y_of(my_pos) / regions.cells());
+    if (anchor == subs_region) return;
+    subs_region = anchor;
+    auto want = regions.neighborhood(grid, my_pos, args.radius);
+    size_t changed = 0;
+    for (const auto& t : want)
+      if (!region_subs.count(t)) {
+        bus.subscribe(t);
+        ++changed;
+      }
+    for (const auto& t : region_subs)
+      if (!want.count(t)) {
+        bus.unsubscribe(t);
+        ++changed;
+      }
+    if (changed) metrics_count("agent.region_resubs", changed);
+    region_subs = std::move(want);
+  };
+
+  auto publish_legacy_position = [&](bool with_update) {
     Json pos;
     pos.set("type", "position")
         .set("peer_id", my_id)
         .set("pos", point_json(grid, my_pos))
         .set("goal", point_json(grid, my_goal))
         .set("timestamp", unix_ms() / 1000);
+    Json caps;  // capability marker: capable peers never trigger echo
+    caps.push_back(Json("pos1"));
+    pos.set("caps", caps);
     bus.publish("mapd", pos);
+    if (!with_update) return;
     Json upd;
     upd.set("type", "position_update")
         .set("peer_id", my_id)
@@ -225,6 +285,34 @@ int main(int argc, char** argv) {
     // Task whose delivery was lost in an outage (idle-but-marked-busy)
     if (my_task) upd.set("busy_task", (*my_task)["task_id"]);
     bus.publish("mapd", upd);
+  };
+
+  auto publish_position = [&]() {
+    if (!region_gossip) {  // kill switch: the flat legacy wire, verbatim
+      publish_legacy_position(true);
+      return;
+    }
+    update_region_subs();
+    // one pos1 beacon replaces the JSON position + position_update pair:
+    // peers in the region neighborhood feed their nearby cache from it,
+    // the manager (wildcard-subscribed) feeds tracking + busy claims
+    Json b;
+    b.set("type", "pos1")
+        .set("data", codec::encode_pos1_b64(
+                         my_pos, my_goal, my_task.has_value(),
+                         my_task ? (*my_task)["task_id"].as_int() : 0));
+    bus.publish(regions.topic_for(grid, my_pos), b);
+    const int64_t now = mono_ms();
+    if (now < legacy_until
+        || (legacy_pos_ms > 0 && now - last_legacy_pos_ms >= legacy_pos_ms)) {
+      // flat-topic JSON peers: low-rate discovery beacon, full-rate echo
+      // while legacy evidence is fresh.  The full pair (position AND
+      // position_update) goes out so a flat-wire MANAGER — one running
+      // with the JG_REGION_GOSSIP=0 kill switch, or a reference-wire
+      // build — keeps liveness/busy tracking of region-gossip agents.
+      last_legacy_pos_ms = now;
+      publish_legacy_position(true);
+    }
   };
 
   // Builds, publishes, and RETURNS the metric payload (the completed
@@ -276,6 +364,12 @@ int main(int argc, char** argv) {
         done_last_sent_ms = mono_ms();
         my_task.reset();
         task_state = TaskState::Idle;
+        // ADVICE r5: an outstanding exchange offered THIS task — now that
+        // it completed locally the offer is moot.  Clearing it makes the
+        // late swap_response a no-op; matching it instead could re-adopt
+        // the finished task (re-executing it) or clobber the fresh task
+        // the manager's done-refill is about to assign.
+        pending_swap.reset();
       }
     }
   };
@@ -335,14 +429,36 @@ int main(int argc, char** argv) {
     bool alive = bus.pump([&](const BusClient::Msg& m) {
       const Json& d = m.data;
       const std::string& type = d["type"].as_str();
+      auto has_pos1_caps = [&]() {
+        for (const auto& c : d["caps"].as_array())
+          if (c.as_str() == "pos1") return true;
+        return false;
+      };
 
-      if (type == "position") {
+      if (type == "pos1") {
+        // packed region beacon: peer identity rides the bus frame's from
+        if (m.from == my_id) return;
+        auto p1 = codec::decode_pos1_b64(d["data"].as_str());
+        if (!p1) return;
+        const Cell cells = static_cast<Cell>(grid.free.size());
+        if (p1->pos < 0 || p1->pos >= cells || p1->goal < 0 ||
+            p1->goal >= cells)
+          return;
+        nearby[m.from] = NearbyEntry{p1->pos, p1->goal, mono_ms()};
+      } else if (type == "position") {
         const std::string& peer = d["peer_id"].as_str();
         if (peer == my_id) return;
+        if (region_gossip && !has_pos1_caps()) {
+          // a flat-topic JSON peer is live: echo JSON positions for it
+          // at full rate until the evidence goes stale
+          legacy_until = mono_ms() + legacy_ttl_ms;
+        }
         auto p = parse_point(grid, d["pos"]);
         auto g = parse_point(grid, d["goal"]);
         if (p && g) nearby[peer] = NearbyEntry{*p, *g, mono_ms()};
       } else if (type == "occupied_request") {
+        if (region_gossip && !has_pos1_caps())
+          legacy_until = mono_ms() + legacy_ttl_ms;
         Json resp;  // peers answer with their own point (ref :1007-1025)
         Json pts;
         pts.push_back(point_json(grid, my_pos));
@@ -591,7 +707,12 @@ int main(int argc, char** argv) {
           .set("peer_id", my_id)
           .set("duration_micros", us)
           .set("timestamp_ms", unix_ms());
-      bus.publish("mapd", pm);
+      // interest-scoped: the manager is the only consumer, and this
+      // fires every decision tick — on the flat topic it would fan to
+      // every agent like the position beacons did ("mapd.path" is in
+      // busd's droppable set; the manager also still ingests legacy
+      // path_metric arriving on "mapd" from foreign peers)
+      bus.publish(region_gossip ? "mapd.path" : "mapd", pm);
 
       switch (d.kind) {
         case LocalDecision::Kind::Move:

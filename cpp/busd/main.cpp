@@ -7,12 +7,38 @@
 // broadcast on topic "mapd").  peer_joined / peer_left events give managers
 // the discovered/expired capability of mDNS.
 //
+// Relay fast path (ISSUE 4): the hub is the fleet's measured ceiling, so
+// the hot path avoids ALL JSON work:
+//
+// - Topic-prefix framing.  Clients that advertise `caps:["relay1"]` in
+//   hello publish `P<topic> <payload>\n` and receive
+//   `M<topic> <from> <payload>\n`; the hub peeks the topic with one
+//   memchr and splices relays without parsing the payload (legacy JSON
+//   peers keep the `{"op":"pub"...}` / `{"op":"msg"...}` wire — both
+//   renderings are built at most once per publish and byte-shared across
+//   the fanout).
+// - Coalesced writes.  Per-client outbound queues hold refcounted frames;
+//   each wakeup flushes everything queued with one writev batch instead
+//   of a syscall (and a buffer copy) per message per client.
+// - Bounded queues / slow-consumer policy.  A consumer that stops reading
+//   first loses its queued position/metrics beacons oldest-first
+//   (`bus.slow_consumer_drops` / `_dropped_bytes` counters — beacons are
+//   superseded by the next one anyway), and is evicted outright past the
+//   hard limit (`bus.slow_consumer_evictions`, emits peer_left) so one
+//   stalled peer can never head-of-line-block the fleet.
+// - Wildcard subscriptions.  A topic ending in `.*` subscribes by prefix
+//   (managers use `mapd.pos.*` to see every region beacon without
+//   enumerating regions).
+//
 // Usage: mapd_bus [port]           (default 7400)
 
+#include <limits.h>
 #include <poll.h>
 #include <signal.h>
+#include <sys/uio.h>
 
 #include <cstdio>
+#include <deque>
 #include <map>
 #include <memory>
 #include <set>
@@ -25,20 +51,41 @@
 #include "../common/log.hpp"
 #include "../common/metrics.hpp"
 #include "../common/net.hpp"
+#include "../common/region.hpp"  // kPosTopicPrefix (droppable beacons)
 
 using namespace mapd;
 
 namespace {
 
+struct OutFrame {
+  std::shared_ptr<const std::string> data;  // framed line incl. '\n'
+  bool droppable;
+};
+
 struct Client {
-  LineConn conn;
+  LineConn conn;  // input framing only; output goes through the queue
   std::string peer_id;
+  bool fast = false;  // advertised caps:["relay1"] in hello
   std::set<std::string> topics;
+  std::set<std::string> prefixes;  // from "<prefix>.*" subscriptions
+  std::deque<OutFrame> outq;
+  size_t out_bytes = 0;   // total queued
+  size_t front_off = 0;   // bytes of outq.front() already written
   explicit Client(int fd) : conn(fd) {}
 };
 
 volatile sig_atomic_t g_stop = 0;
 void handle_stop(int) { g_stop = 1; }
+
+// Position beacons, metrics beacons, and per-decision path-metric
+// samples are periodic/sampled streams a consumer can afford to lose —
+// the only frames the slow-consumer policy may shed.
+bool droppable_topic(const std::string& topic) {
+  return topic.compare(0, strlen(kPosTopicPrefix), kPosTopicPrefix) == 0 ||
+         topic == "mapd.metrics" || topic == "mapd.path";
+}
+
+std::string json_quote(const std::string& s) { return Json(s).dump(); }
 
 }  // namespace
 
@@ -57,11 +104,24 @@ int main(int argc, char** argv) {
   // (e.g. sever the swap_response of a task exchange to prove the
   // manager's unclaimed-task sweep rescues the stranded task).  The bus
   // is a deliberately lossy medium — this makes a SPECIFIC loss
-  // reproducible instead of waiting for an outage race.
+  // reproducible instead of waiting for an outage race.  (The filter
+  // needs the payload's `type`, so configuring it re-enables a JSON parse
+  // per published frame — test mode only.)
   const std::string drop_type =
       knobs.get_str("--drop-type", "MAPD_BUS_DROP_TYPE", "");
   int64_t drop_left = knobs.get_int("--drop-count", "MAPD_BUS_DROP_COUNT",
                                     drop_type.empty() ? 0 : 1);
+  // Slow-consumer queue limits: past `soft` the client's queued BEACONS
+  // drop oldest-first; past `hard` the client is evicted.
+  const size_t queue_soft = static_cast<size_t>(
+      knobs.get_int("--queue-soft-kb", "JG_BUS_QUEUE_SOFT_KB", 256)) * 1024;
+  const size_t queue_hard = static_cast<size_t>(
+      knobs.get_int("--queue-hard-kb", "JG_BUS_QUEUE_HARD_KB", 4096)) * 1024;
+  // Per-client kernel send buffer (KB; 0 = kernel default).  The kernel
+  // buffer sits IN FRONT of the user-space queue the limits above govern,
+  // so backpressure tests shrink it to hit the policy deterministically.
+  const int sndbuf_kb = static_cast<int>(
+      knobs.get_int("--sndbuf-kb", "JG_BUS_SNDBUF_KB", 0));
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -76,25 +136,133 @@ int main(int argc, char** argv) {
   log_info("mapd_bus listening on %s:%u\n", bind_addr.c_str(), port);
 
   std::map<int, std::unique_ptr<Client>> clients;
+  std::map<std::string, std::set<int>> subs_exact;  // topic -> fds
+  std::vector<std::pair<std::string, int>> subs_prefix;  // (prefix, fd)
+  std::set<int> evict;  // hard-limit overflows, reaped with the dead list
 
-  auto broadcast = [&](const Json& frame, const std::string& topic,
-                       int except_fd) {
-    std::string line = frame.dump();
-    int fanout = 0;
-    for (auto& [fd, c] : clients) {
-      if (fd == except_fd) continue;
-      if (!topic.empty() && !c->topics.count(topic)) continue;
-      if (c->peer_id.empty()) continue;  // not yet hello'd
-      c->conn.send_line(line);
-      ++fanout;
+  auto enqueue = [&](Client& c, int fd,
+                     const std::shared_ptr<const std::string>& frame,
+                     bool droppable) {
+    if (evict.count(fd)) return;
+    c.outq.push_back(OutFrame{frame, droppable});
+    c.out_bytes += frame->size();
+    if (c.out_bytes <= queue_soft) return;
+    // drop-oldest policy: shed queued beacons (never the partially
+    // written front frame) until back under the soft limit
+    size_t k = c.front_off ? 1 : 0;
+    size_t dropped = 0, dropped_bytes = 0;
+    while (c.out_bytes > queue_soft && k < c.outq.size()) {
+      if (!c.outq[k].droppable) {
+        ++k;
+        continue;
+      }
+      dropped_bytes += c.outq[k].data->size();
+      c.out_bytes -= c.outq[k].data->size();
+      c.outq.erase(c.outq.begin() + static_cast<long>(k));
+      ++dropped;
     }
-    // hub-side fan-out accounting (wire bytes incl. framing newline);
+    if (dropped) {
+      metrics_count("bus.slow_consumer_drops", static_cast<double>(dropped));
+      metrics_count("bus.slow_consumer_dropped_bytes",
+                    static_cast<double>(dropped_bytes));
+    }
+    if (c.out_bytes > queue_hard) {
+      metrics_count("bus.slow_consumer_evictions");
+      log_warn("🐌 evicting slow consumer fd=%d peer=%s (%zu bytes "
+               "queued > %zu hard limit)\n", fd, c.peer_id.c_str(),
+               c.out_bytes, queue_hard);
+      evict.insert(fd);
+    }
+  };
+
+  // One writev batch of everything queued; returns false on write error.
+  auto flush_client = [&](Client& c) -> bool {
+    while (!c.outq.empty()) {
+      iovec iov[64];
+      int n = 0;
+      size_t first = c.front_off;
+      for (const auto& f : c.outq) {
+        if (n == 64) break;
+        iov[n].iov_base = const_cast<char*>(f.data->data()) +
+                          (n == 0 ? first : 0);
+        iov[n].iov_len = f.data->size() - (n == 0 ? first : 0);
+        ++n;
+      }
+      ssize_t wrote = writev(c.conn.fd(), iov, n);
+      if (wrote < 0)
+        return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+      size_t left = static_cast<size_t>(wrote);
+      c.out_bytes -= left;
+      while (left > 0) {
+        size_t avail = c.outq.front().data->size() - c.front_off;
+        if (left >= avail) {
+          left -= avail;
+          c.front_off = 0;
+          c.outq.pop_front();
+        } else {
+          c.front_off += left;
+          left = 0;
+        }
+      }
+    }
+    return true;
+  };
+
+  // Fan a payload out to `topic`'s subscribers.  `raw` is the payload
+  // text (valid JSON from well-behaved peers) — NEVER parsed here; the
+  // two wire renderings are built lazily, at most once each, and the
+  // same buffer is shared by every recipient's queue.
+  auto relay_payload = [&](const std::string& topic, const std::string& from,
+                           const std::string& raw, int except_fd) {
+    std::shared_ptr<const std::string> fast, legacy;
+    const bool droppable = droppable_topic(topic);
+    int fanout = 0;
+    double fanout_bytes = 0;
+    auto deliver = [&](int fd) {
+      auto it = clients.find(fd);
+      if (it == clients.end()) return;
+      Client& c = *it->second;
+      if (fd == except_fd || c.peer_id.empty()) return;
+      const auto& frame = c.fast
+          ? (fast ? fast
+                  : (fast = std::make_shared<const std::string>(
+                         "M" + topic + " " + from + " " + raw + "\n")))
+          : (legacy ? legacy
+                    : (legacy = std::make_shared<const std::string>(
+                           "{\"op\":\"msg\",\"topic\":" +
+                           json_quote(topic) + ",\"from\":" +
+                           json_quote(from) + ",\"data\":" + raw + "}\n")));
+      enqueue(c, fd, frame, droppable);
+      ++fanout;
+      fanout_bytes += static_cast<double>(frame->size());
+    };
+    auto ex = subs_exact.find(topic);
+    if (ex != subs_exact.end())
+      for (int fd : ex->second) deliver(fd);
+    std::set<int> seen;  // exact + overlapping prefixes: one frame per fd
+    for (const auto& [prefix, fd] : subs_prefix)
+      if (topic.compare(0, prefix.size(), prefix) == 0 &&
+          (ex == subs_exact.end() || !ex->second.count(fd)) &&
+          seen.insert(fd).second)
+        deliver(fd);
+    // hub-side fan-out accounting (actual wire bytes incl. framing);
     // rides the busd metrics beacon into the fleet rollup
     if (fanout) {
       std::string labels = "topic=\"" + topic + "\"";
       metrics_count("bus.fanout_msgs", fanout, labels);
-      metrics_count("bus.fanout_bytes",
-                    static_cast<double>(fanout * (line.size() + 1)), labels);
+      metrics_count("bus.fanout_bytes", fanout_bytes, labels);
+    }
+  };
+
+  // Control frames (welcome / peers / peer_joined / peer_left) stay JSON
+  // on both wires; `topic` routes them ("" = every client).
+  auto broadcast_control = [&](const Json& frame, const std::string& topic,
+                               int except_fd) {
+    auto line = std::make_shared<const std::string>(frame.dump() + "\n");
+    for (auto& [fd, c] : clients) {
+      if (fd == except_fd || c->peer_id.empty()) continue;
+      if (!topic.empty() && !c->topics.count(topic)) continue;
+      enqueue(*c, fd, line, false);
     }
   };
 
@@ -106,12 +274,20 @@ int main(int argc, char** argv) {
     if (now < next_beacon_ms) return;
     next_beacon_ms = now + 2000;
     metrics_gauge("bus.clients", static_cast<double>(clients.size()));
-    Json msg;
-    msg.set("op", "msg")
-        .set("topic", "mapd.metrics")
-        .set("from", "busd")
-        .set("data", make_metrics_beacon("busd", "busd", 2.0));
-    broadcast(msg, "mapd.metrics", -1);
+    relay_payload("mapd.metrics", "busd",
+                  make_metrics_beacon("busd", "busd", 2.0).dump(), -1);
+  };
+
+  auto drop_subs = [&](int fd, Client& c) {
+    for (const auto& t : c.topics) {
+      auto it = subs_exact.find(t);
+      if (it != subs_exact.end()) {
+        it->second.erase(fd);
+        if (it->second.empty()) subs_exact.erase(it);
+      }
+    }
+    for (auto it = subs_prefix.begin(); it != subs_prefix.end();)
+      it = (it->second == fd) ? subs_prefix.erase(it) : std::next(it);
   };
 
   while (!g_stop) {
@@ -119,7 +295,7 @@ int main(int argc, char** argv) {
     pfds.push_back({listen_fd, POLLIN, 0});
     for (auto& [fd, c] : clients) {
       short ev = POLLIN;
-      if (c->conn.wants_write()) ev |= POLLOUT;
+      if (c->out_bytes > 0) ev |= POLLOUT;
       pfds.push_back({fd, ev, 0});
     }
     int rc = poll(pfds.data(), pfds.size(), 1000);
@@ -135,6 +311,10 @@ int main(int argc, char** argv) {
         int cfd = accept(listen_fd, nullptr, nullptr);
         if (cfd < 0) break;
         set_nonblocking(cfd);
+        if (sndbuf_kb > 0) {
+          int v = sndbuf_kb * 1024;
+          setsockopt(cfd, SOL_SOCKET, SO_SNDBUF, &v, sizeof(v));
+        }
         clients.emplace(cfd, std::make_unique<Client>(cfd));
       }
     }
@@ -146,9 +326,10 @@ int main(int argc, char** argv) {
       if (it == clients.end()) continue;
       Client& c = *it->second;
       bool ok = true;
+      bool closing = false;  // disconnect AFTER draining buffered lines
       const char* why = "";
       if (pfds[k].revents & (POLLERR | POLLHUP)) {
-        ok = false;
+        closing = true;
         why = "pollerr/hup";
         // poll() sets no errno for revents; fetch the socket's own error
         // so the drop diagnostic doesn't print a stale one
@@ -157,32 +338,92 @@ int main(int argc, char** argv) {
         getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
         errno = soerr;
       }
-      if (ok && (pfds[k].revents & POLLIN)) {
-        ok = c.conn.on_readable();
-        if (!ok) why = "read-eof/err";
+      if (pfds[k].revents & POLLIN) {
+        if (!c.conn.on_readable()) {
+          closing = true;
+          why = "read-eof/err";
+        }
       }
+      // A publish-then-close burst lands data and FIN in one read: the
+      // complete lines already buffered are valid frames and MUST relay
+      // before the disconnect is honored (a quitting chat peer's last
+      // message used to vanish when the hub saw the EOF in the same
+      // wakeup — the pub-then-close race, now deterministic in tests).
       while (ok) {
         auto line = c.conn.next_line();
         if (!line) break;
+        if (!line->empty() && (*line)[0] == 'P') {
+          // fast publish: `P<topic> <payload>` — topic peek, no parse
+          size_t sp = line->find(' ');
+          if (sp == std::string::npos || sp < 2) continue;
+          const std::string topic = line->substr(1, sp - 1);
+          const std::string raw = line->substr(sp + 1);
+          if (drop_left > 0 && !drop_type.empty()) {
+            auto parsed = Json::parse(raw);  // fault-injection test mode
+            if (parsed && (*parsed)["type"].as_str() == drop_type) {
+              --drop_left;
+              log_warn("💉 fault injection: dropped %s frame from %s "
+                       "(%lld more)\n", drop_type.c_str(),
+                       c.peer_id.c_str(),
+                       static_cast<long long>(drop_left));
+              continue;
+            }
+          }
+          metrics_count("bus.relay_fast_frames");
+          relay_payload(topic, c.peer_id, raw, fd);
+          continue;
+        }
         auto parsed = Json::parse(*line);
-        if (!parsed || !parsed->is_object()) continue;
+        if (!parsed || !parsed->is_object()) continue;  // ignore garbage
         const Json& j = *parsed;
         const std::string& op = j["op"].as_str();
         if (op == "hello") {
           c.peer_id = j["peer_id"].as_str();
+          for (const auto& cap : j["caps"].as_array())
+            if (cap.as_str() == "relay1") c.fast = true;
+          Json caps;
+          caps.push_back(Json("relay1"));
           Json welcome;
-          welcome.set("op", "welcome").set("peer_id", c.peer_id);
-          c.conn.send_line(welcome.dump());
+          welcome.set("op", "welcome")
+              .set("peer_id", c.peer_id)
+              .set("caps", caps);
+          enqueue(c, fd, std::make_shared<const std::string>(
+                             welcome.dump() + "\n"), false);
         } else if (op == "sub") {
           const std::string& topic = j["topic"].as_str();
-          c.topics.insert(topic);
-          Json joined;  // discovery event, like an mDNS "discovered"
-          joined.set("op", "peer_joined")
-              .set("peer_id", c.peer_id)
-              .set("topic", topic);
-          broadcast(joined, topic, fd);
+          if (topic.size() > 2 &&
+              topic.compare(topic.size() - 2, 2, ".*") == 0) {
+            // wildcard: subscribe every topic under the prefix (managers'
+            // "mapd.pos.*"); no peer_joined — prefix consumers are
+            // infrastructure, not discoverable fleet members
+            const std::string prefix = topic.substr(0, topic.size() - 1);
+            if (c.prefixes.insert(prefix).second)
+              subs_prefix.emplace_back(prefix, fd);
+          } else if (c.topics.insert(topic).second) {
+            subs_exact[topic].insert(fd);
+            Json joined;  // discovery event, like an mDNS "discovered"
+            joined.set("op", "peer_joined")
+                .set("peer_id", c.peer_id)
+                .set("topic", topic);
+            broadcast_control(joined, topic, fd);
+          }
         } else if (op == "unsub") {
-          c.topics.erase(j["topic"].as_str());
+          const std::string& topic = j["topic"].as_str();
+          if (topic.size() > 2 &&
+              topic.compare(topic.size() - 2, 2, ".*") == 0) {
+            const std::string prefix = topic.substr(0, topic.size() - 1);
+            c.prefixes.erase(prefix);
+            for (auto pit = subs_prefix.begin(); pit != subs_prefix.end();)
+              pit = (pit->second == fd && pit->first == prefix)
+                        ? subs_prefix.erase(pit)
+                        : std::next(pit);
+          } else if (c.topics.erase(topic)) {
+            auto ex = subs_exact.find(topic);
+            if (ex != subs_exact.end()) {
+              ex->second.erase(fd);
+              if (ex->second.empty()) subs_exact.erase(ex);
+            }
+          }
         } else if (op == "pub") {
           const std::string& topic = j["topic"].as_str();
           if (drop_left > 0 && !drop_type.empty()
@@ -193,12 +434,8 @@ int main(int argc, char** argv) {
                      static_cast<long long>(drop_left));
             continue;
           }
-          Json msg;
-          msg.set("op", "msg")
-              .set("topic", topic)
-              .set("from", c.peer_id)
-              .set("data", j["data"]);
-          broadcast(msg, topic, fd);
+          metrics_count("bus.relay_json_frames");
+          relay_payload(topic, c.peer_id, j["data"].dump(), fd);
         } else if (op == "peers") {
           const std::string& topic = j["topic"].as_str();
           Json peers;
@@ -209,11 +446,13 @@ int main(int argc, char** argv) {
           if (peers.is_null()) peers = Json(JsonArray{});
           Json reply;
           reply.set("op", "peers").set("topic", topic).set("peers", peers);
-          c.conn.send_line(reply.dump());
+          enqueue(c, fd, std::make_shared<const std::string>(
+                             reply.dump() + "\n"), false);
         }
       }
-      if (ok && (c.conn.wants_write())) {
-        ok = c.conn.on_writable();
+      if (closing) ok = false;
+      if (ok && c.out_bytes > 0) {
+        ok = flush_client(c);
         if (!ok) why = "write-err";
       }
       if (!ok) {
@@ -223,16 +462,19 @@ int main(int argc, char** argv) {
       }
     }
 
+    for (int fd : evict) dead.push_back(fd);
+    evict.clear();
     for (int fd : dead) {
       auto it = clients.find(fd);
       if (it == clients.end()) continue;
       std::string peer = it->second->peer_id;
+      drop_subs(fd, *it->second);
       it->second->conn.close_fd();
       clients.erase(it);
       if (!peer.empty()) {
         Json left;  // discovery event, like an mDNS "expired"
         left.set("op", "peer_left").set("peer_id", peer);
-        broadcast(left, "", -1);
+        broadcast_control(left, "", -1);
       }
     }
   }

@@ -10,13 +10,26 @@
 // gossipsub::all_peers the managers use for round-robin dispatch).
 //
 // Frame protocol (one JSON per line):
-//   client->bus: {"op":"hello","peer_id":s} | {"op":"sub","topic":s}
+//   client->bus: {"op":"hello","peer_id":s,"caps":[s...]}
+//                | {"op":"sub","topic":s}
 //                | {"op":"unsub","topic":s} | {"op":"pub","topic":s,"data":v}
 //                | {"op":"peers","topic":s}
 //   bus->client: {"op":"msg","topic":s,"from":s,"data":v}
+//                | {"op":"welcome","peer_id":s,"caps":[s...]}
 //                | {"op":"peer_joined","peer_id":s,"topic":s}
 //                | {"op":"peer_left","peer_id":s}
 //                | {"op":"peers","topic":s,"peers":[s...]}
+//
+// Relay fast framing (ISSUE 4, caps-negotiated): a client advertises
+// `caps:["relay1"]` in hello; when the hub's welcome echoes the cap, the
+// hot path switches to topic-prefix lines the hub relays without JSON
+// parsing (topics must not contain spaces):
+//   client->bus publish: `P<topic> <payload-json>`
+//   bus->client deliver: `M<topic> <from> <payload-json>`
+// Everything else (hello/sub/welcome/peers/discovery events) stays JSON.
+// Kill switch: JG_BUS_FASTFRAME=0 keeps this client on the legacy JSON
+// wire end to end; an old hub (welcome without caps) does the same.
+// A topic ending in ".*" subscribes by prefix (busd wildcard matching).
 #pragma once
 
 #include <poll.h>
@@ -25,6 +38,8 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
+#include <cstring>
 #include <deque>
 #include <functional>
 #include <random>
@@ -47,6 +62,12 @@ inline int64_t mono_ms() {
   using namespace std::chrono;
   return duration_cast<milliseconds>(steady_clock::now().time_since_epoch())
       .count();
+}
+
+// JG_BUS_FASTFRAME=0 pins this client to the legacy JSON wire (default on).
+inline bool fastframe_enabled() {
+  const char* v = getenv("JG_BUS_FASTFRAME");
+  return !v || (*v && strcmp(v, "0") && strcmp(v, "false"));
 }
 
 // Random peer id, shaped like a libp2p PeerId for log familiarity.
@@ -79,9 +100,8 @@ class BusClient {
     if (fd < 0) return false;
     set_nonblocking(fd);
     conn_ = LineConn(fd);
-    Json hello;
-    hello.set("op", "hello").set("peer_id", peer_id);
-    conn_.send_line(hello.dump());
+    fast_hub_ = false;  // until the hub's welcome advertises relay1
+    send_hello();
     return true;
   }
 
@@ -124,11 +144,28 @@ class BusClient {
     send_control(j);
   }
 
+  void unsubscribe(const std::string& topic) {
+    topics_.erase(topic);
+    Json j;
+    j.set("op", "unsub").set("topic", topic);
+    send_control(j);
+  }
+
+  // True once the hub's welcome advertised the relay1 fast framing (and
+  // JG_BUS_FASTFRAME didn't veto it): publishes go out as P-frames.
+  bool fast_hub() const { return fast_hub_; }
+
   void publish(const std::string& topic, const Json& data) {
     if (!conn_.valid()) return;  // disconnected: lossy medium, drop
-    Json j;
-    j.set("op", "pub").set("topic", topic).set("data", data);
-    std::string line = j.dump();
+    std::string line;
+    if (fast_hub_ && topic.find(' ') == std::string::npos) {
+      // fast framing: the hub relays on a topic peek, no JSON parse
+      line = "P" + topic + " " + data.dump();
+    } else {
+      Json j;
+      j.set("op", "pub").set("topic", topic).set("data", data);
+      line = j.dump();
+    }
     // wire bytes: the framed line PLUS its newline (send_line appends it) —
     // keeps py/cpp bandwidth numbers byte-identical (bus_client.py publish)
     metrics_count("bus.msgs_sent", 1, "topic=\"" + topic + "\"");
@@ -154,6 +191,23 @@ class BusClient {
     if (!conn_.valid()) return try_reconnect();
     if (!conn_.on_readable()) return drop_or_retry();
     while (auto line = conn_.next_line()) {
+      if (!line->empty() && (*line)[0] == 'M') {
+        // fast relay frame: `M<topic> <from> <payload-json>`
+        size_t s1 = line->find(' ');
+        size_t s2 = s1 == std::string::npos ? std::string::npos
+                                            : line->find(' ', s1 + 1);
+        if (s2 == std::string::npos) continue;
+        auto data = Json::parse(line->substr(s2 + 1));
+        if (!data) continue;  // garbage payload: ignore like any bad frame
+        const std::string topic = line->substr(1, s1 - 1);
+        metrics_count("bus.msgs_received", 1, "topic=\"" + topic + "\"");
+        metrics_count("bus.bytes_received",
+                      static_cast<double>(line->size() + 1),
+                      "topic=\"" + topic + "\"");
+        if (on_msg)
+          on_msg(Msg{topic, line->substr(s1 + 1, s2 - s1 - 1), *data});
+        continue;
+      }
       auto parsed = Json::parse(*line);
       if (!parsed || !parsed->is_object()) continue;  // ignore garbage frames
       const Json& j = *parsed;
@@ -166,8 +220,15 @@ class BusClient {
                       static_cast<double>(line->size() + 1),
                       "topic=\"" + topic + "\"");
         if (on_msg) on_msg(Msg{topic, j["from"].as_str(), j["data"]});
-      } else if (on_event) {
-        on_event(j);
+      } else {
+        if (op == "welcome") {
+          // caps negotiation: switch publishes to the fast framing only
+          // when the hub advertises it (an old hub stays legacy)
+          if (fastframe_enabled())
+            for (const auto& cap : j["caps"].as_array())
+              if (cap.as_str() == "relay1") fast_hub_ = true;
+        }
+        if (on_event) on_event(j);
       }
     }
     if (!conn_.on_writable()) return drop_or_retry();
@@ -183,6 +244,17 @@ class BusClient {
  private:
   void send_control(const Json& j) {
     if (conn_.valid()) conn_.send_line(j.dump());
+  }
+
+  void send_hello() {
+    Json hello;
+    hello.set("op", "hello").set("peer_id", peer_id_);
+    if (fastframe_enabled()) {
+      Json caps;
+      caps.push_back(Json("relay1"));
+      hello.set("caps", caps);
+    }
+    conn_.send_line(hello.dump());
   }
 
   void maybe_publish_beacon() {
@@ -201,6 +273,7 @@ class BusClient {
     if (!reconnect_) return false;
     const int err = errno;  // capture BEFORE close() can overwrite it
     conn_.close_fd();
+    fast_hub_ = false;  // renegotiate with whatever hub comes back
     backoff_ms_ = 250;
     next_attempt_ms_ = mono_ms() + backoff_ms_;
     fprintf(stderr,
@@ -233,9 +306,8 @@ class BusClient {
     set_nonblocking(fd);
     conn_ = LineConn(fd);
     backoff_ms_ = 0;
-    Json hello;
-    hello.set("op", "hello").set("peer_id", peer_id_);
-    conn_.send_line(hello.dump());
+    fast_hub_ = false;
+    send_hello();
     for (const auto& t : topics_) {
       Json j;
       j.set("op", "sub").set("topic", t);
@@ -251,6 +323,7 @@ class BusClient {
   std::string peer_id_;
   std::string host_;
   uint16_t port_ = 0;
+  bool fast_hub_ = false;
   bool reconnect_ = false;
   std::function<void()> on_reconnect_;
   std::set<std::string> topics_;

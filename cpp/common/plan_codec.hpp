@@ -365,5 +365,80 @@ class PackedFleetEncoder {
   bool force_snapshot_ = true;
 };
 
+// ---------- pos1 — packed position/heartbeat beacon (ISSUE 4) ----------
+//
+// Byte-identical mirror of plan_codec.py encode_pos1/decode_pos1 (see its
+// docstring for the layout).  One beacon replaces the per-tick JSON
+// position + position_update pair; peer identity rides the bus frame's
+// `from` field.  Wire shape: {"type":"pos1","data":"<base64>"} on a
+// region topic (common/region.hpp) or the flat legacy topic.
+
+constexpr uint32_t kPos1Magic = 0x31534F50;  // b"POS1"
+constexpr uint8_t kPos1Version = 1;
+constexpr uint8_t kPos1FlagNarrow = 1;
+constexpr uint8_t kPos1FlagTask = 2;
+
+struct Pos1 {
+  int32_t pos = 0;
+  int32_t goal = 0;
+  bool has_task = false;
+  int64_t task_id = 0;
+};
+
+inline std::string encode_pos1(int32_t pos, int32_t goal,
+                               bool has_task = false, int64_t task_id = 0) {
+  const bool narrow = pos >= 0 && pos < 65536 && goal >= 0 && goal < 65536;
+  std::string out;
+  out.reserve(24);
+  detail::put_u32(out, kPos1Magic);
+  out += static_cast<char>(kPos1Version);
+  out += static_cast<char>((narrow ? kPos1FlagNarrow : 0) |
+                           (has_task ? kPos1FlagTask : 0));
+  detail::put_u16(out, 0);  // reserved
+  if (narrow) {
+    detail::put_u16(out, static_cast<uint16_t>(pos));
+    detail::put_u16(out, static_cast<uint16_t>(goal));
+  } else {
+    detail::put_u32(out, static_cast<uint32_t>(pos));
+    detail::put_u32(out, static_cast<uint32_t>(goal));
+  }
+  if (has_task) detail::put_i64(out, task_id);
+  return out;
+}
+
+inline std::optional<Pos1> decode_pos1(const std::string& buf) {
+  if (buf.size() < 8) return std::nullopt;
+  const uint8_t* b = reinterpret_cast<const uint8_t*>(buf.data());
+  if (detail::get_u32(b) != kPos1Magic) return std::nullopt;
+  if (b[4] != kPos1Version) return std::nullopt;
+  const uint8_t flags = b[5];
+  const bool narrow = (flags & kPos1FlagNarrow) != 0;
+  Pos1 p;
+  p.has_task = (flags & kPos1FlagTask) != 0;
+  const size_t need = 8 + (narrow ? 4 : 8) + (p.has_task ? 8 : 0);
+  if (buf.size() != need) return std::nullopt;
+  if (narrow) {
+    p.pos = static_cast<int32_t>(b[8] | (b[9] << 8));
+    p.goal = static_cast<int32_t>(b[10] | (b[11] << 8));
+  } else {
+    p.pos = static_cast<int32_t>(detail::get_u32(b + 8));
+    p.goal = static_cast<int32_t>(detail::get_u32(b + 12));
+  }
+  if (p.has_task) p.task_id = detail::get_i64(b + need - 8);
+  return p;
+}
+
+inline std::string encode_pos1_b64(int32_t pos, int32_t goal,
+                                   bool has_task = false,
+                                   int64_t task_id = 0) {
+  return b64_encode(encode_pos1(pos, goal, has_task, task_id));
+}
+
+inline std::optional<Pos1> decode_pos1_b64(const std::string& data) {
+  auto raw = b64_decode(data);
+  if (!raw) return std::nullopt;
+  return decode_pos1(*raw);
+}
+
 }  // namespace codec
 }  // namespace mapd

@@ -1,0 +1,59 @@
+// Region-sharded position-gossip topic math — native mirror of
+// p2p_distributed_tswap_tpu/runtime/region.py (ISSUE 4 tentpole; the
+// geographic topic partitioning the reference's scalability post-mortem
+// proposed but never built, DECENTRALIZED_ISSUES.md:62-96).
+//
+// The grid is partitioned into square regions of `region_cells` per edge;
+// agents publish position beacons on topic "mapd.pos.<rx>.<ry>" and
+// subscribe the (2k+1)^2 region neighborhood with k = ceil(radius /
+// region_cells), re-subscribing on border crossings.  Coverage guarantee
+// (property-tested in tests/test_region_bus.py): any two cells within
+// Manhattan `radius` of each other land in regions at most k apart per
+// axis, so the publisher's topic is always inside the subscriber's set.
+// Managers subscribe the wildcard "mapd.pos.*" (busd prefix match).
+#pragma once
+
+#include <algorithm>
+#include <set>
+#include <string>
+
+#include "grid.hpp"
+
+namespace mapd {
+
+constexpr const char* kPosTopicPrefix = "mapd.pos.";
+constexpr const char* kPosTopicWildcard = "mapd.pos.*";
+constexpr int kDefaultRegionCells = 32;
+
+class RegionMap {
+ public:
+  explicit RegionMap(int cells) : cells_(cells < 1 ? 1 : cells) {}
+
+  int cells() const { return cells_; }
+
+  std::string topic_for(const Grid& grid, Cell c) const {
+    return std::string(kPosTopicPrefix) +
+           std::to_string(grid.x_of(c) / cells_) + "." +
+           std::to_string(grid.y_of(c) / cells_);
+  }
+
+  std::set<std::string> neighborhood(const Grid& grid, Cell c,
+                                     int radius) const {
+    const int k = radius <= cells_ ? 1 : (radius + cells_ - 1) / cells_;
+    const int rx = grid.x_of(c) / cells_, ry = grid.y_of(c) / cells_;
+    const int nrx = (grid.width + cells_ - 1) / cells_;
+    const int nry = (grid.height + cells_ - 1) / cells_;
+    std::set<std::string> out;
+    for (int gy = std::max(0, ry - k); gy <= std::min(nry - 1, ry + k); ++gy)
+      for (int gx = std::max(0, rx - k); gx <= std::min(nrx - 1, rx + k);
+           ++gx)
+        out.insert(std::string(kPosTopicPrefix) + std::to_string(gx) + "." +
+                   std::to_string(gy));
+    return out;
+  }
+
+ private:
+  int cells_;
+};
+
+}  // namespace mapd

@@ -42,6 +42,7 @@
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
 #include "../common/plan_codec.hpp"
+#include "../common/region.hpp"
 #include "../common/trace.hpp"
 #include "../common/tswap.hpp"
 
@@ -106,6 +107,12 @@ int main(int argc, char** argv) {
   // its task (delivery lost in a bus outage) — re-send the same task
   const int64_t task_resend_ms =
       knobs.get_int("--task-resend-ms", "MAPD_TASK_RESEND_MS", 5000);
+  // region-sharded heartbeats (ISSUE 4): agents beacon packed pos1 on
+  // mapd.pos.<rx>.<ry>; the manager subscribes the wildcard so agent
+  // heartbeats stop fanning out to every other agent.  JG_REGION_GOSSIP=0
+  // falls back to flat position_update.
+  const bool region_gossip =
+      knobs.get_int("--region-gossip", "JG_REGION_GOSSIP", 1) != 0;
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -132,6 +139,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
+  if (region_gossip) bus.subscribe(kPosTopicWildcard);
   if (solver == "tpu") bus.subscribe("solver");
   // survive a bus restart (reconnect + resubscribe inside BusClient);
   // agents re-announce themselves on their own reconnect, so tracking
@@ -663,10 +671,30 @@ int main(int argc, char** argv) {
         [&](const BusClient::Msg& m) {
           const Json& d = m.data;
           const std::string& type = d["type"].as_str();
-          if (type == "position_update") {
-            const std::string& peer = d["peer_id"].as_str();
+          if (type == "position_update" || type == "pos1") {
+            // one heartbeat ingestion for both wires: flat JSON
+            // position_update and the packed pos1 region beacon (which is
+            // addressed by the bus frame's own `from`)
+            std::string peer;
+            std::optional<Cell> p;
+            bool has_busy = false;
+            long long busy_tid = 0;
+            if (type == "pos1") {
+              auto p1 = codec::decode_pos1_b64(d["data"].as_str());
+              if (!p1) return;
+              peer = m.from;
+              if (p1->pos >= 0 &&
+                  p1->pos < static_cast<Cell>(grid.free.size()))
+                p = p1->pos;
+              has_busy = p1->has_task;
+              busy_tid = p1->task_id;
+            } else {
+              peer = d["peer_id"].as_str();
+              p = parse_point(d["position"]);
+              has_busy = d.has("busy_task");
+              busy_tid = d["busy_task"].as_int();
+            }
             if (clean && known_left.count(peer)) return;
-            auto p = parse_point(d["position"]);
             if (!p) return;
             auto it = agents.find(peer);
             if (it == agents.end()) {
@@ -691,9 +719,8 @@ int main(int argc, char** argv) {
               // refuses this duplicate by task id).
               bool stale_assignment =
                   a.task
-                  && (!d.has("busy_task")
-                      || d["busy_task"].as_int()
-                             != (*a.task)["task_id"].as_int());
+                  && (!has_busy
+                      || busy_tid != (*a.task)["task_id"].as_int());
               if (stale_assignment
                   && mono_ms() - a.dispatched_ms > task_resend_ms) {
                 log_info("↻ %s reports idle but task %lld is in flight; "

@@ -36,6 +36,8 @@
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
+#include "../common/plan_codec.hpp"
+#include "../common/region.hpp"
 
 using namespace mapd;
 
@@ -77,6 +79,12 @@ int main(int argc, char** argv) {
   // task (delivery lost in a bus outage) — re-send the same task
   const int64_t task_resend_ms =
       knobs.get_int("--task-resend-ms", "MAPD_TASK_RESEND_MS", 5000);
+  // region-sharded position gossip (ISSUE 4): agents beacon packed pos1
+  // on mapd.pos.<rx>.<ry>; the manager needs the GLOBAL view, so it
+  // subscribes the wildcard (busd prefix matching) instead of N² flat
+  // heartbeats.  JG_REGION_GOSSIP=0 falls back to flat position_update.
+  const bool region_gossip =
+      knobs.get_int("--region-gossip", "JG_REGION_GOSSIP", 1) != 0;
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -99,9 +107,22 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
+  if (region_gossip) {
+    bus.subscribe(kPosTopicWildcard);
+    bus.subscribe("mapd.path");  // interest-scoped path_metric stream
+  }
   // survive a bus restart (reconnect + resubscribe inside BusClient);
-  // agents re-announce position+goal on their own reconnect
-  bus.set_reconnect([]() {});
+  // agents re-announce position+goal on their own reconnect.  ADVICE r5:
+  // no liveness evidence can arrive while the hub is down, so the stale
+  // sweeps must not age anything out during an outage — and after the
+  // reconnect they hold one claim-freshness window so the agents'
+  // post-outage heartbeats land BEFORE the deliberate-duplicate
+  // re-dispatch fires (sweep_hold_until, checked in the cleanup pass).
+  int64_t sweep_hold_until = 0;
+  const int64_t claim_fresh_ms = 2500;
+  bus.set_reconnect([&sweep_hold_until, claim_fresh_ms]() {
+    sweep_hold_until = mono_ms() + claim_fresh_ms;
+  });
   bus.enable_metrics_beacon("manager_decentralized");
   log_info("🧠 decentralized manager %s up (grid %dx%d)\n", my_id.c_str(),
            grid.width, grid.height);
@@ -138,7 +159,6 @@ int main(int argc, char** argv) {
   // the recorded holder's own claim has gone stale (>= 2 heartbeat
   // periods): a genuinely exchanged-away holder stops claiming within one.
   std::map<long long, std::pair<std::string, int64_t>> holder_claim;
-  const int64_t claim_fresh_ms = 2500;
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
@@ -284,6 +304,99 @@ int main(int argc, char** argv) {
       return true;
   };
 
+  // Heartbeat ingestion, shared by the JSON position_update wire and the
+  // packed pos1 region beacon (peer identity rides the bus frame's own
+  // `from` on the packed wire): tracking + the idle-but-marked-busy
+  // reconciliation + the busy-claim ledger.
+  auto handle_heartbeat = [&](const std::string& peer,
+                              std::optional<Cell> cell, bool has_busy,
+                              long long busy_tid) {
+    if (cell) peer_positions[peer] = *cell;
+    subscribed_peers.insert(peer);
+    peer_last_seen[peer] = mono_ms();
+    // idle-but-marked-busy reconciliation: the heartbeat carries a
+    // busy_task field while the agent holds a task.  A peer still
+    // reporting idle well past dispatch never received its Task
+    // (publish into a bus outage is dropped) — re-send the SAME
+    // task.  An agent whose done was lost instead is healed by its
+    // own retransmit (and refuses this duplicate by task id).
+    auto busy = peer_busy.find(peer);
+    if (busy != peer_busy.end() && !has_busy) {
+      const long long btid = busy->second["task_id"].as_int();
+      if (completed_ids.count(btid)) {
+        // someone ELSE completed this peer's task (peer-side
+        // exchange): never re-send a finished task — free the
+        // peer for fresh work instead
+        peer_busy.erase(busy);
+        busy_since.erase(peer);
+        if (subscribed_peers.count(peer)) send_task_to(peer);
+      } else {
+        int64_t now = mono_ms();
+        auto since = busy_since.find(peer);
+        if (since != busy_since.end()
+            && now - since->second > task_resend_ms) {
+          log_info("↻ %s reports idle but task %lld is in flight; "
+                   "re-sending\n", peer.c_str(), btid);
+          bus.publish("mapd", busy->second);
+          since->second = now;
+        }
+      }
+    } else if (has_busy) {
+      // the heartbeat claims a task: refresh the ledger, and on
+      // an id MISMATCH believe the agent — tasks move between
+      // peers in exchanges the manager never arbitrates
+      const long long ctid = busy_tid;
+      auto inf = inflight.find(ctid);
+      if (inf != inflight.end()) {
+        last_claimed[ctid] = mono_ms();
+        // a queued requeue copy is now moot: its holder is alive
+        // (same race the done handler cancels for completions)
+        for (auto q = requeue.begin(); q != requeue.end(); ++q)
+          if ((*q)["task_id"].as_int() == ctid) {
+            log_info("♻️  task %lld re-claimed by %s; queued "
+                     "duplicate cancelled\n", ctid, peer.c_str());
+            requeue.erase(q);
+            break;
+          }
+        if (busy == peer_busy.end()
+            || busy->second["task_id"].as_int() != ctid) {
+          // freshness guard (see holder_claim above): ignore a
+          // claim that would evict a holder whose own claim is
+          // fresher than the heartbeat cadence — ends the
+          // peer_busy ping-pong between duplicate holders
+          auto hc = holder_claim.find(ctid);
+          if (hc != holder_claim.end() && hc->second.first != peer
+              && mono_ms() - hc->second.second < claim_fresh_ms) {
+            metrics_count("manager.duplicate_claims_ignored");
+            log_debug("… ignoring %s's claim on task %lld (%s "
+                      "claimed it %lld ms ago)\n", peer.c_str(),
+                      ctid, hc->second.first.c_str(),
+                      static_cast<long long>(
+                          mono_ms() - hc->second.second));
+            return;
+          }
+          log_info("🔁 %s now carries task %lld (peer-side "
+                   "exchange); bookkeeping follows\n",
+                   peer.c_str(), ctid);
+          // the previous holder's entry is stale: drop it so the
+          // idle-resend cannot hand the task back out twice
+          for (auto b = peer_busy.begin(); b != peer_busy.end();)
+            if (b->first != peer
+                && b->second["task_id"].as_int() == ctid) {
+              busy_since.erase(b->first);
+              b = peer_busy.erase(b);
+            } else {
+              ++b;
+            }
+          peer_busy[peer] = inf->second;
+          peer_busy[peer].set("peer_id", peer);
+          busy_since[peer] = mono_ms();
+        }
+        holder_claim[ctid] = {peer, mono_ms()};
+      }
+    }
+  };
+
   bus.query_peers("mapd");
   int64_t last_cleanup = mono_ms();
   std::string stdin_buf;
@@ -320,96 +433,25 @@ int main(int argc, char** argv) {
           const std::string& type = d["type"].as_str();
           if (type == "position_update") {
             const std::string& peer = d["peer_id"].as_str();
+            std::optional<Cell> cell;
             const auto& p = d["position"].as_array();
             if (p.size() == 2) {
               int x = static_cast<int>(p[0].as_int());
               int y = static_cast<int>(p[1].as_int());
-              if (grid.in_bounds(x, y))
-                peer_positions[peer] = grid.cell(x, y);
+              if (grid.in_bounds(x, y)) cell = grid.cell(x, y);
             }
-            subscribed_peers.insert(peer);
-            peer_last_seen[peer] = mono_ms();
-            // idle-but-marked-busy reconciliation: the heartbeat carries a
-            // busy_task field while the agent holds a task.  A peer still
-            // reporting idle well past dispatch never received its Task
-            // (publish into a bus outage is dropped) — re-send the SAME
-            // task.  An agent whose done was lost instead is healed by its
-            // own retransmit (and refuses this duplicate by task id).
-            auto busy = peer_busy.find(peer);
-            if (busy != peer_busy.end() && !d.has("busy_task")) {
-              const long long btid = busy->second["task_id"].as_int();
-              if (completed_ids.count(btid)) {
-                // someone ELSE completed this peer's task (peer-side
-                // exchange): never re-send a finished task — free the
-                // peer for fresh work instead
-                peer_busy.erase(busy);
-                busy_since.erase(peer);
-                if (subscribed_peers.count(peer)) send_task_to(peer);
-              } else {
-                int64_t now = mono_ms();
-                auto since = busy_since.find(peer);
-                if (since != busy_since.end()
-                    && now - since->second > task_resend_ms) {
-                  log_info("↻ %s reports idle but task %lld is in flight; "
-                           "re-sending\n", peer.c_str(), btid);
-                  bus.publish("mapd", busy->second);
-                  since->second = now;
-                }
-              }
-            } else if (d.has("busy_task")) {
-              // the heartbeat claims a task: refresh the ledger, and on
-              // an id MISMATCH believe the agent — tasks move between
-              // peers in exchanges the manager never arbitrates
-              const long long ctid = d["busy_task"].as_int();
-              auto inf = inflight.find(ctid);
-              if (inf != inflight.end()) {
-                last_claimed[ctid] = mono_ms();
-                // a queued requeue copy is now moot: its holder is alive
-                // (same race the done handler cancels for completions)
-                for (auto q = requeue.begin(); q != requeue.end(); ++q)
-                  if ((*q)["task_id"].as_int() == ctid) {
-                    log_info("♻️  task %lld re-claimed by %s; queued "
-                             "duplicate cancelled\n", ctid, peer.c_str());
-                    requeue.erase(q);
-                    break;
-                  }
-                if (busy == peer_busy.end()
-                    || busy->second["task_id"].as_int() != ctid) {
-                  // freshness guard (see holder_claim above): ignore a
-                  // claim that would evict a holder whose own claim is
-                  // fresher than the heartbeat cadence — ends the
-                  // peer_busy ping-pong between duplicate holders
-                  auto hc = holder_claim.find(ctid);
-                  if (hc != holder_claim.end() && hc->second.first != peer
-                      && mono_ms() - hc->second.second < claim_fresh_ms) {
-                    metrics_count("manager.duplicate_claims_ignored");
-                    log_debug("… ignoring %s's claim on task %lld (%s "
-                              "claimed it %lld ms ago)\n", peer.c_str(),
-                              ctid, hc->second.first.c_str(),
-                              static_cast<long long>(
-                                  mono_ms() - hc->second.second));
-                    return;
-                  }
-                  log_info("🔁 %s now carries task %lld (peer-side "
-                           "exchange); bookkeeping follows\n",
-                           peer.c_str(), ctid);
-                  // the previous holder's entry is stale: drop it so the
-                  // idle-resend cannot hand the task back out twice
-                  for (auto b = peer_busy.begin(); b != peer_busy.end();)
-                    if (b->first != peer
-                        && b->second["task_id"].as_int() == ctid) {
-                      busy_since.erase(b->first);
-                      b = peer_busy.erase(b);
-                    } else {
-                      ++b;
-                    }
-                  peer_busy[peer] = inf->second;
-                  peer_busy[peer].set("peer_id", peer);
-                  busy_since[peer] = mono_ms();
-                }
-                holder_claim[ctid] = {peer, mono_ms()};
-              }
-            }
+            handle_heartbeat(peer, cell, d.has("busy_task"),
+                             d["busy_task"].as_int());
+          } else if (type == "pos1") {
+            // packed region beacon (wildcard subscription): the same
+            // heartbeat, ~4x fewer wire bytes, addressed by bus `from`
+            auto p1 = codec::decode_pos1_b64(d["data"].as_str());
+            if (!p1) return;
+            std::optional<Cell> cell;
+            if (p1->pos >= 0 &&
+                p1->pos < static_cast<Cell>(grid.free.size()))
+              cell = p1->pos;
+            handle_heartbeat(m.from, cell, p1->has_task, p1->task_id);
           } else if (type == "occupied_request") {
             // manager answers with ALL known positions (ref :441-468)
             Json occ;
@@ -547,12 +589,22 @@ int main(int argc, char** argv) {
     int64_t now = mono_ms();
     if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;
+      // ADVICE r5: both liveness sweeps below act on the ABSENCE of
+      // heartbeats — evidence that cannot arrive while the bus is down.
+      // Hold them during an outage (fd < 0) and for one claim-freshness
+      // window after the reconnect, so post-outage heartbeats/claims
+      // land before the silence/unclaimed re-queues fire duplicates.
+      const bool sweeps_armed = bus.fd() >= 0 && now >= sweep_hold_until;
+      if (!sweeps_armed)
+        log_debug("🧹 liveness sweeps held (%s)\n",
+                  bus.fd() < 0 ? "bus outage" : "post-reconnect drain");
       // Mute-but-connected peers (no peer_left ever fires): drop ALL
       // tracking — an idle frozen peer would otherwise haunt every
       // occupied_response with a phantom position — and re-queue the
       // tasks of busy ones, mirroring the centralized manager's stale
       // age-out (the reference loses the task in every such case).
-      for (auto it = peer_last_seen.begin(); it != peer_last_seen.end();) {
+      for (auto it = peer_last_seen.begin();
+           sweeps_armed && it != peer_last_seen.end();) {
         if (now - it->second <= agent_stale_ms) {
           ++it;
           continue;
@@ -581,7 +633,10 @@ int main(int argc, char** argv) {
       // dispatched task that no heartbeat has claimed for agent_stale_ms
       // has no live holder — e.g. its holder handed it over in an
       // exchange whose swap_response died with the bus.  Re-queue it.
-      for (auto inf = inflight.begin(); inf != inflight.end();) {
+      // Held with the silence sweep while sweeps_armed is false (outage /
+      // post-reconnect drain) — see above.
+      for (auto inf = inflight.begin();
+           sweeps_armed && inf != inflight.end();) {
         const long long tid = inf->first;
         if (completed_ids.count(tid)) {
           last_claimed.erase(tid);

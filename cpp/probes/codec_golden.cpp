@@ -11,6 +11,11 @@
 //   --decode   stdin: one base64 packet per line
 //              stdout: canonical JSON of the decoded packet per line
 //              ("null" for undecodable input)
+//   --pos1-encode  stdin: one JSON per line {"pos":P,"goal":G,"task":T?}
+//                  stdout: one base64 pos1 beacon per line
+//   --pos1-decode  stdin: one base64 pos1 beacon per line
+//                  stdout: {"pos":P,"goal":G,"task":T|null} per line
+//                  ("null" for undecodable input)
 
 #include <cstdio>
 #include <iostream>
@@ -30,8 +35,11 @@ static Json i32_array(const std::vector<int32_t>& v) {
 
 int main(int argc, char** argv) {
   const std::string mode = argc > 1 ? argv[1] : "";
-  if (mode != "--encode" && mode != "--decode") {
-    fprintf(stderr, "usage: codec_golden --encode|--decode < lines\n");
+  if (mode != "--encode" && mode != "--decode" && mode != "--pos1-encode" &&
+      mode != "--pos1-decode") {
+    fprintf(stderr,
+            "usage: codec_golden --encode|--decode|--pos1-encode|"
+            "--pos1-decode < lines\n");
     return 2;
   }
   codec::PackedFleetEncoder enc;
@@ -39,6 +47,34 @@ int main(int argc, char** argv) {
   std::string line;
   while (std::getline(std::cin, line)) {
     if (line.empty()) continue;
+    if (mode == "--pos1-encode") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad pos1 script line\n");
+        return 1;
+      }
+      const Json& j = *parsed;
+      printf("%s\n",
+             codec::encode_pos1_b64(
+                 static_cast<int32_t>(j["pos"].as_int()),
+                 static_cast<int32_t>(j["goal"].as_int()), j.has("task"),
+                 j["task"].as_int())
+                 .c_str());
+      continue;
+    }
+    if (mode == "--pos1-decode") {
+      auto p = codec::decode_pos1_b64(line);
+      if (!p) {
+        printf("null\n");
+        continue;
+      }
+      Json out;
+      out.set("pos", static_cast<int64_t>(p->pos))
+          .set("goal", static_cast<int64_t>(p->goal))
+          .set("task", p->has_task ? Json(p->task_id) : Json());
+      printf("%s\n", out.dump().c_str());
+      continue;
+    }
     if (mode == "--decode") {
       auto pkt = codec::decode_b64(line);
       if (!pkt) {

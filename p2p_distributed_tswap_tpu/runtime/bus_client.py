@@ -4,6 +4,18 @@ Speaks the same line-framed JSON protocol as the C++ BusClient
 (cpp/common/bus.hpp); used by the solver daemon, the process-spawn test
 runner, and integration tests.
 
+Relay fast framing (ISSUE 4, caps-negotiated): the client advertises
+``caps:["relay1"]`` in hello; once the hub's welcome echoes the cap,
+publishes switch to topic-prefix lines the hub relays without JSON
+parsing (``P<topic> <payload>``), and deliveries may arrive as
+``M<topic> <from> <payload>`` — :meth:`recv` normalizes those to the
+same ``{"op":"msg","topic","from","data"}`` dict, so consumers are
+agnostic.  ``JG_BUS_FASTFRAME=0`` (or ``fastframe=False``) pins the
+client to the legacy JSON wire; against an old hub (welcome without
+caps) it stays legacy automatically.  A topic ending in ``.*``
+subscribes by prefix (busd wildcard matching — managers use
+``mapd.pos.*`` for the region-sharded position gossip).
+
 Like the C++ client, it can survive a bus restart: with ``reconnect=True``
 a dropped connection is retried with exponential backoff (0.25 s .. 4 s);
 on success the client re-sends hello, re-subscribes every topic, and calls
@@ -25,6 +37,7 @@ rolled-up view; the ``mapd.metrics`` beacon ships the raw counters.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import time
 from typing import Callable, Iterator, Optional
@@ -38,7 +51,8 @@ class BusClient:
                  peer_id: Optional[str] = None, timeout: float = 5.0,
                  reconnect: bool = False,
                  on_reconnect: Optional[Callable[[], None]] = None,
-                 registry: Optional[_reg.Registry] = None):
+                 registry: Optional[_reg.Registry] = None,
+                 fastframe: Optional[bool] = None):
         self.peer_id = peer_id or f"py-{int(time.time() * 1000) % 10 ** 10}"
         self._host, self._port, self._timeout = host, port, timeout
         self._reconnect = reconnect
@@ -46,6 +60,13 @@ class BusClient:
         self._topics: set[str] = set()
         self._backoff = 0.0
         self._next_attempt = 0.0
+        # relay fast framing: advertised in hello, armed by the hub's
+        # welcome (see module docstring); None = the JG_BUS_FASTFRAME env
+        self._fastframe = (os.environ.get("JG_BUS_FASTFRAME", "1")
+                           not in ("0", "false", "")
+                           if fastframe is None else fastframe)
+        self.hub_caps: Optional[list] = None  # from the last welcome
+        self._fast_hub = False
         self.sock: Optional[socket.socket] = None
         # network accounting sink: the process registry unless a test
         # injects its own (obs/registry.py is the single source of truth)
@@ -59,7 +80,11 @@ class BusClient:
         self.sock.settimeout(self._timeout)
         self._buf = b""
         self._backoff = 0.0
-        self._send_raw({"op": "hello", "peer_id": self.peer_id})
+        self._fast_hub = False  # renegotiated by the hub's welcome
+        hello = {"op": "hello", "peer_id": self.peer_id}
+        if self._fastframe:
+            hello["caps"] = ["relay1"]
+        self._send_raw(hello)
         for t in sorted(self._topics):
             self._send_raw({"op": "sub", "topic": t})
 
@@ -72,6 +97,7 @@ class BusClient:
             except OSError:
                 pass
             self.sock = None
+        self._fast_hub = False  # renegotiate with whatever hub comes back
         if not self._reconnect:
             raise ConnectionError("bus closed")
         self._backoff = min(self._backoff * 2, 4.0) if self._backoff else 0.25
@@ -103,6 +129,11 @@ class BusClient:
     def connected(self) -> bool:
         return self.sock is not None
 
+    @property
+    def fast_hub(self) -> bool:
+        """True once the hub's welcome negotiated the relay1 framing."""
+        return self._fast_hub
+
     # -- protocol ---------------------------------------------------------
     def _send_raw(self, obj: dict) -> None:
         assert self.sock is not None
@@ -122,8 +153,16 @@ class BusClient:
         self._topics.add(topic)
         self._send({"op": "sub", "topic": topic})
 
+    def unsubscribe(self, topic: str) -> None:
+        self._topics.discard(topic)
+        self._send({"op": "unsub", "topic": topic})
+
     def publish(self, topic: str, data: dict) -> None:
-        line = json.dumps({"op": "pub", "topic": topic, "data": data})
+        if self._fast_hub and " " not in topic:
+            # fast framing: the hub relays on a topic peek, no JSON parse
+            line = f"P{topic} " + json.dumps(data)
+        else:
+            line = json.dumps({"op": "pub", "topic": topic, "data": data})
         if self.sock is None:
             self._try_reconnect()
         if self.sock is None:
@@ -163,6 +202,22 @@ class BusClient:
             if nl >= 0:
                 line = self._buf[:nl]
                 self._buf = self._buf[nl + 1:]
+                if line[:1] == b"M":
+                    # fast relay frame: `M<topic> <from> <payload-json>` —
+                    # normalized to the legacy msg-dict shape for callers
+                    head, _, payload = line.partition(b" ")
+                    sender, _, payload = payload.partition(b" ")
+                    try:
+                        data = json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue  # garbage payload: ignore like any frame
+                    topic = head[1:].decode(errors="replace")
+                    self.registry.count("bus.msgs_received", topic=topic)
+                    self.registry.count("bus.bytes_received", len(line) + 1,
+                                        topic=topic)
+                    return {"op": "msg", "topic": topic,
+                            "from": sender.decode(errors="replace"),
+                            "data": data}
                 try:
                     frame = json.loads(line)
                 except json.JSONDecodeError:
@@ -173,6 +228,12 @@ class BusClient:
                     self.registry.count("bus.msgs_received", topic=topic)
                     self.registry.count("bus.bytes_received", len(line) + 1,
                                         topic=topic)
+                elif frame.get("op") == "welcome":
+                    # caps negotiation: switch publishes to fast framing
+                    # only when the hub advertises it (old hub -> legacy)
+                    self.hub_caps = frame.get("caps") or []
+                    self._fast_hub = (self._fastframe
+                                      and "relay1" in self.hub_caps)
                 return frame
             try:
                 self.sock.settimeout(

@@ -40,6 +40,27 @@ def ensure_built() -> Path:
     return BUILD_DIR
 
 
+def build_single_tu(binary_name: str, source_rel: str) -> Optional[Path]:
+    """Build one single-translation-unit runtime binary with a bare g++
+    (every cpp/ binary is one TU, so no cmake/ninja needed) — the shared
+    helper behind the codec-golden / busd test-and-smoke builders.
+    Returns the binary path, or None when it neither exists nor can be
+    built (no C++ toolchain)."""
+    import shutil
+
+    binary = BUILD_DIR / binary_name
+    if binary.exists():
+        return binary
+    gxx = shutil.which("g++")
+    if gxx is None:
+        return None
+    BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    subprocess.run([gxx, "-O2", "-std=c++17", "-Icpp", source_rel,
+                    "-o", str(binary)], cwd=str(REPO_ROOT), check=True,
+                   capture_output=True)
+    return binary
+
+
 class Fleet:
     """A managed fleet of runtime processes (killed on close/GC)."""
 

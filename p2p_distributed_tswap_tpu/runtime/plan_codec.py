@@ -331,3 +331,82 @@ def encode_response(seq: int, idx: Sequence[int], next_pos: Sequence[int],
     already skips)."""
     return Packet(kind=KIND_RESPONSE, seq=seq, base_seq=0, idx=_i32(idx),
                   pos=_i32(next_pos), goal=_i32(goal))
+
+
+# ---------------------------------------------------------------------------
+# pos1 — packed position/heartbeat beacon (ISSUE 4, packed1 family).
+#
+# One beacon replaces the per-tick JSON `position` + `position_update` pair
+# of the decentralized agent (and the centralized agent's heartbeat): pos
+# cell, goal cell, and the optional busy-task id.  Peer identity rides the
+# bus frame's own `from` field, so the packet carries no name.  Wire shape:
+#     {"type": "pos1", "data": "<base64>"}
+# published on a region topic `mapd.pos.<rx>.<ry>` (runtime/region.py) or,
+# with region gossip off, on the flat legacy topic.
+#
+# Layout (little-endian, 8-byte header):
+#     u32 magic   "POS1" (0x31534F50)
+#     u8  version 1
+#     u8  flags   bit 0: narrow — cells are u16 (any grid up to 256x256)
+#                 bit 1: a busy-task id follows the cells
+#     u16 reserved (0)
+#     pos, goal   u16 each when narrow, else i32
+#     i64 task_id (only when flags bit 1)
+#
+# The C++ mirror (cpp/common/plan_codec.hpp encode_pos1/decode_pos1) is
+# byte-identical; tests/test_region_bus.py locks golden bytes across both.
+# ---------------------------------------------------------------------------
+
+POS1_MAGIC = 0x31534F50  # b"POS1" little-endian
+POS1_VERSION = 1
+POS1_FLAG_NARROW = 1
+POS1_FLAG_TASK = 2
+_POS1_HEAD = struct.Struct("<IBBH")
+
+
+def encode_pos1(pos: int, goal: int, task_id: Optional[int] = None) -> bytes:
+    pos, goal = int(pos), int(goal)
+    narrow = 0 <= pos < 65536 and 0 <= goal < 65536
+    flags = (POS1_FLAG_NARROW if narrow else 0) | \
+        (POS1_FLAG_TASK if task_id is not None else 0)
+    out = _POS1_HEAD.pack(POS1_MAGIC, POS1_VERSION, flags, 0)
+    out += struct.pack("<HH" if narrow else "<ii", pos, goal)
+    if task_id is not None:
+        out += struct.pack("<q", int(task_id))
+    return out
+
+
+def decode_pos1(buf: bytes) -> Tuple[int, int, Optional[int]]:
+    """``(pos, goal, task_id-or-None)``; raises :class:`CodecError` on a
+    malformed packet (short/overlong, bad magic/version)."""
+    if len(buf) < _POS1_HEAD.size:
+        raise CodecError("short pos1 packet")
+    magic, version, flags, _ = _POS1_HEAD.unpack_from(buf, 0)
+    if magic != POS1_MAGIC:
+        raise CodecError(f"bad pos1 magic 0x{magic:08x}")
+    if version != POS1_VERSION:
+        raise CodecError(f"unsupported pos1 version {version}")
+    narrow = bool(flags & POS1_FLAG_NARROW)
+    has_task = bool(flags & POS1_FLAG_TASK)
+    need = _POS1_HEAD.size + (4 if narrow else 8) + (8 if has_task else 0)
+    if len(buf) != need:
+        raise CodecError(f"pos1 length {len(buf)} != expected {need}")
+    pos, goal = struct.unpack_from("<HH" if narrow else "<ii", buf,
+                                   _POS1_HEAD.size)
+    task_id = None
+    if has_task:
+        (task_id,) = struct.unpack_from("<q", buf, need - 8)
+    return int(pos), int(goal), task_id
+
+
+def encode_pos1_b64(pos: int, goal: int,
+                    task_id: Optional[int] = None) -> str:
+    return base64.b64encode(encode_pos1(pos, goal, task_id)).decode()
+
+
+def decode_pos1_b64(data: str) -> Tuple[int, int, Optional[int]]:
+    try:
+        raw = base64.b64decode(data, validate=True)
+    except Exception as e:
+        raise CodecError(f"bad pos1 base64 framing: {e}") from None
+    return decode_pos1(raw)

@@ -1,0 +1,52 @@
+"""Region-sharded position-gossip topic math (ISSUE 4 tentpole).
+
+The reference's scalability post-mortem proposes — but never builds —
+geographic topic partitioning (DECENTRALIZED_ISSUES.md:62-96) to break the
+O(N²) position broadcast.  This module is the Python half of that design
+(native mirror: ``cpp/common/region.hpp``, kept rule-identical):
+
+- the grid is partitioned into square regions of ``JG_REGION_CELLS``
+  cells per edge (default 32);
+- an agent publishes its position beacon on topic
+  ``mapd.pos.<rx>.<ry>`` for the region containing its cell;
+- a consumer interested in everything within Manhattan radius ``r`` of a
+  cell subscribes to the ``(2k+1) x (2k+1)`` region neighborhood with
+  ``k = ceil(r / region_cells)`` (clamped to the grid), re-subscribing
+  when it crosses a region border.
+
+Coverage guarantee (property-tested in tests/test_region_bus.py): for any
+two cells within Manhattan distance ``r`` of each other, the publisher's
+region topic is inside the subscriber's neighborhood — per-axis distance
+``<= r`` implies region-index distance ``<= ceil(r / cells) = k``.
+
+Managers (and other global consumers) subscribe the wildcard
+``mapd.pos.*`` — busd matches topics ending in ``.*`` by prefix.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+POS_TOPIC_PREFIX = "mapd.pos."
+POS_TOPIC_WILDCARD = "mapd.pos.*"
+DEFAULT_REGION_CELLS = 32
+
+
+def topic_for(x: int, y: int, cells: int) -> str:
+    """Region topic of grid cell ``(x, y)``."""
+    return f"{POS_TOPIC_PREFIX}{x // cells}.{y // cells}"
+
+
+def neighborhood_topics(x: int, y: int, radius: int, cells: int,
+                        width: int, height: int) -> List[str]:
+    """Region topics covering everything within Manhattan ``radius`` of
+    ``(x, y)``, clamped to the grid; sorted for determinism."""
+    k = max(1, -(-radius // cells))  # ceil div, never less than 3x3
+    rx, ry = x // cells, y // cells
+    nrx = (width + cells - 1) // cells
+    nry = (height + cells - 1) // cells
+    out = []
+    for gy in range(max(0, ry - k), min(nry - 1, ry + k) + 1):
+        for gx in range(max(0, rx - k), min(nrx - 1, rx + k) + 1):
+            out.append(f"{POS_TOPIC_PREFIX}{gx}.{gy}")
+    return out

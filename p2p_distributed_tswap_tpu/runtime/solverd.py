@@ -1038,6 +1038,7 @@ def main(argv=None) -> int:
     # overlap the device execution; the output fetch happens when the next
     # request arrives or a short poll timeout fires.
     pending: Optional[PendingTick] = None
+    caps_logged = False
     while True:
         # short poll while a step is in flight; medium poll while queued
         # field sweeps wait for an idle window (they must run BETWEEN
@@ -1045,6 +1046,13 @@ def main(argv=None) -> int:
         frame = bus.recv(timeout=0.002 if pending is not None
                          else (0.02 if service.field_queue else 1.0))
         beacon.maybe_beat()  # ~2 s cadence riding the recv timeout
+        if not caps_logged and bus.hub_caps is not None:
+            # relay-framing negotiation outcome (hub welcome), once —
+            # operators can see at a glance whether responses ride the
+            # hub's parse-free fast path or the legacy JSON relay
+            caps_logged = True
+            print(f"🚌 bus caps {bus.hub_caps}: relay fast framing "
+                  f"{'on' if bus.fast_hub else 'off'}", flush=True)
         if stats_requested["flag"]:
             stats_requested["flag"] = False
             dump_stats()

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""busd relay micro-smoke (scripts/ci.sh, ISSUE 4): N-client fanout
+sanity under the fast framing.
+
+Builds ``mapd_bus`` with a bare g++ if absent (single translation unit —
+no cmake needed; SKIPs with a warning when no toolchain exists), then:
+
+- 6 subscribers (half fast-framed, half legacy JSON) on one topic plus a
+  ``mapd.pos.*`` wildcard watcher;
+- a fast publisher sends 200 sequenced frames on the topic and 50 pos1
+  beacons across several region topics;
+- every subscriber must receive every sequenced frame in order, the
+  wildcard watcher every region beacon, and the hub's own metrics beacon
+  must report the fan-out.
+
+Exit 0 on success; ~5 s end to end.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pc  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu  # noqa: E402
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> int:
+    binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
+    if binary is None:
+        print("bus smoke: SKIPPED (no g++/binary)", file=sys.stderr)
+        return 0
+    port = free_port()
+    bus = subprocess.Popen([str(binary), str(port)],
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+    subs = []
+    try:
+        time.sleep(0.3)
+        for k in range(6):
+            c = BusClient(port=port, peer_id=f"sub{k}", fastframe=k % 2 == 0)
+            c.subscribe("smoke")
+            subs.append(c)
+        wild = BusClient(port=port, peer_id="wild")
+        wild.subscribe("mapd.pos.*")
+        pub = BusClient(port=port, peer_id="pub")
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and pub.hub_caps is None:
+            pub.recv(timeout=0.2)
+        assert pub.fast_hub, "hub did not negotiate the relay1 fast framing"
+        time.sleep(0.3)
+
+        n_seq, n_pos = 200, 50
+        for k in range(n_seq):
+            pub.publish("smoke", {"seq": k})
+        for k in range(n_pos):
+            pub.publish(f"mapd.pos.{k % 5}.{k % 3}",
+                        {"type": "pos1",
+                         "data": pc.encode_pos1_b64(k, k + 1, k * 7)})
+
+        for c in subs:
+            got = []
+            t_end = time.monotonic() + 10
+            while time.monotonic() < t_end and len(got) < n_seq:
+                f = c.recv(timeout=0.5)
+                if f and f.get("op") == "msg" and f["topic"] == "smoke":
+                    got.append(f["data"]["seq"])
+            assert got == list(range(n_seq)), (
+                f"{c.peer_id}: fanout lost/reordered frames "
+                f"({len(got)}/{n_seq})")
+        beacons = []
+        t_end = time.monotonic() + 10
+        while time.monotonic() < t_end and len(beacons) < n_pos:
+            f = wild.recv(timeout=0.5)
+            if f and f.get("op") == "msg" \
+                    and f["topic"].startswith("mapd.pos."):
+                p, g, t = pc.decode_pos1_b64(f["data"]["data"])
+                beacons.append((p, g, t))
+        assert len(beacons) == n_pos, (
+            f"wildcard watcher saw {len(beacons)}/{n_pos} region beacons")
+        assert beacons[7] == (7, 8, 49), beacons[7]
+
+        # the hub's own beacon reports the fan-out it relayed
+        watch = BusClient(port=port, peer_id="watch")
+        watch.subscribe("mapd.metrics")
+        counters = None
+        t_end = time.monotonic() + 6
+        while time.monotonic() < t_end and counters is None:
+            f = watch.recv(timeout=0.5)
+            if (f and f.get("op") == "msg"
+                    and (f.get("data") or {}).get("proc") == "busd"):
+                counters = (f["data"]["metrics"] or {}).get("counters") or {}
+        assert counters and \
+            counters.get('bus.fanout_msgs{topic="smoke"}', 0) \
+            == n_seq * len(subs), counters
+        assert counters.get("bus.relay_fast_frames", 0) >= n_seq, counters
+        watch.close()
+        for c in subs + [wild, pub]:
+            c.close()
+        print(f"bus smoke OK: {n_seq} frames x {len(subs)} subscribers "
+              f"(fast+legacy), {n_pos} wildcard region beacons, hub "
+              f"counters consistent")
+        return 0
+    finally:
+        bus.terminate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

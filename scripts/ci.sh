@@ -20,9 +20,12 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu \
     analysis \
     analysis/fleet_top.py \
+    analysis/bus_scaling.py \
     p2p_distributed_tswap_tpu/obs/registry.py \
     p2p_distributed_tswap_tpu/obs/beacon.py \
     p2p_distributed_tswap_tpu/obs/fleet_aggregator.py \
+    p2p_distributed_tswap_tpu/runtime/region.py \
+    scripts/bus_smoke.py \
     bench.py
 echo "syntax OK"
 
@@ -33,8 +36,13 @@ fi
 echo "== codec fuzz gate =="
 # random fleets through both plan codecs (ISSUE 3 satellite): py/cpp
 # packed encoders must be byte-identical and resident packed planning
-# must equal stateless JSON planning
+# must equal stateless JSON planning; plus pos1 beacon fuzz (ISSUE 4)
 JAX_PLATFORMS=cpu python scripts/codec_fuzz.py
+
+echo "== busd relay micro-smoke =="
+# N-client fanout sanity under the fast relay framing (ISSUE 4): fast +
+# legacy subscribers, wildcard region watcher, hub fanout counters
+JAX_PLATFORMS=cpu python scripts/bus_smoke.py
 
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log

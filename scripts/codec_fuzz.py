@@ -1,14 +1,18 @@
 """Codec fuzz gate (scripts/ci.sh): random fleet evolutions through BOTH
 plan codecs must yield identical decoded plans.
 
-Three properties per seed:
+Four properties per seed:
 1. wire fuzz — random fleet scripts (joins/leaves/moves/goal churn)
    through PackedFleetEncoder -> bytes -> PackedStateDecoder reconstruct
    the exact fleet state every tick;
 2. golden fuzz — the native encoder (cpp/build/mapd_codec_golden, built
    on demand with bare g++) emits byte-identical packets for the same
    scripts (skipped with a warning when no C++ toolchain exists);
-3. plan fuzz — a TickRunner fed packed deltas (device-resident state)
+3. pos1 fuzz — random position beacons round-trip through the py pos1
+   codec, the native encoder is byte-identical, the native decoder
+   round-trips py bytes, and truncated/corrupted packets are rejected on
+   both sides (ISSUE 4);
+4. plan fuzz — a TickRunner fed packed deltas (device-resident state)
    returns the same moves as one fed legacy JSON full-fleet requests.
 
 Runs in ~30 s on the CPU backend; scripts/ci.sh invokes it before the
@@ -18,7 +22,6 @@ tier-1 suite.
 from __future__ import annotations
 
 import argparse
-import shutil
 import subprocess
 import sys
 from pathlib import Path
@@ -74,17 +77,64 @@ def wire_fuzz(seed: int, ticks: int, snapshot_every: int) -> list:
     return lines
 
 
+def _golden_binary():
+    from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu
+
+    return build_single_tu("mapd_codec_golden",
+                           "cpp/probes/codec_golden.cpp")
+
+
+def pos1_fuzz(seed: int, count: int = 200) -> bool:
+    """Random pos1 beacons: py round-trip, py<->cpp byte identity, and
+    malformed-packet rejection.  Returns False when the golden binary is
+    unavailable (pure-python checks still ran)."""
+    rng = np.random.default_rng(seed)
+    cases = []
+    for _ in range(count):
+        hi = 1 << 20 if rng.random() < 0.4 else 65536
+        pos, goal = int(rng.integers(hi)), int(rng.integers(hi))
+        task = int(rng.integers(1 << 40)) if rng.random() < 0.5 else None
+        cases.append((pos, goal, task))
+        blob = pc.encode_pos1(pos, goal, task)
+        assert pc.decode_pos1(blob) == (pos, goal, task), \
+            f"pos1 seed {seed}: py round-trip diverged"
+        # truncation and magic corruption must raise, never mis-decode
+        for bad in (blob[:-1], b"\xff" + blob[1:], blob + b"\x00"):
+            try:
+                pc.decode_pos1(bad)
+            except pc.CodecError:
+                continue
+            raise AssertionError(f"pos1 seed {seed}: bad packet accepted")
+    binary = _golden_binary()
+    if binary is None:
+        return False
+    py_lines = [pc.encode_pos1_b64(p, g, t) for p, g, t in cases]
+    feed = "\n".join(
+        '{"pos":%d,"goal":%d%s}' % (p, g,
+                                    ',"task":%d' % t if t is not None
+                                    else "")
+        for p, g, t in cases) + "\n"
+    out = subprocess.run([str(binary), "--pos1-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert out.stdout.split() == py_lines, \
+        f"pos1 seed {seed}: cpp encoder bytes diverged"
+    out = subprocess.run([str(binary), "--pos1-decode"],
+                         input="\n".join(py_lines) + "\n",
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    import json as _json
+    for (p, g, t), line in zip(cases, out.stdout.splitlines()):
+        d = _json.loads(line)
+        assert (d["pos"], d["goal"], d["task"]) == (p, g, t), \
+            f"pos1 seed {seed}: cpp decoder diverged"
+    return True
+
+
 def golden_fuzz(lines_by_seed: dict) -> bool:
-    binary = ROOT / "cpp" / "build" / "mapd_codec_golden"
-    if not binary.exists():
-        gxx = shutil.which("g++")
-        if gxx is None:
-            return False
-        binary.parent.mkdir(parents=True, exist_ok=True)
-        subprocess.run([gxx, "-O2", "-std=c++17", "-Icpp",
-                        str(ROOT / "cpp" / "probes" / "codec_golden.cpp"),
-                        "-o", str(binary)], cwd=str(ROOT), check=True,
-                       capture_output=True)
+    binary = _golden_binary()
+    if binary is None:
+        return False
     for seed, (snapshot_every, lines) in lines_by_seed.items():
         feed = "\n".join(
             '{"seq":%d,"snapshot_every":%d,"fleet":[%s]}' % (
@@ -165,6 +215,13 @@ def main() -> int:
         print("golden fuzz: cpp encoder byte-identical")
     else:
         print("golden fuzz: SKIPPED (no g++/binary)", file=sys.stderr)
+    pos1_native = all([pos1_fuzz(seed) for seed in range(args.seeds)])
+    if pos1_native:
+        print(f"pos1 fuzz: {args.seeds} seeds round-trip, cpp "
+              "byte-identical, malformed rejected")
+    else:
+        print("pos1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
+              file=sys.stderr)
     if not args.skip_plans:
         for seed in range(2):
             plan_fuzz(seed, ticks=6)

@@ -1,0 +1,399 @@
+"""Region-sharded gossip + busd fast path (ISSUE 4): pos1 codec golden +
+property tests, region-topic coverage math, relay fast framing, wildcard
+subscriptions, slow-consumer backpressure, and resubscribe-on-crossing
+correctness.
+
+The busd-backed tests compile ``cpp/busd/main.cpp`` with a bare ``g++``
+when no prebuilt ``mapd_bus`` exists (it is a single translation unit,
+like the codec golden probe), so they run without cmake/ninja.
+"""
+
+import json
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+from p2p_distributed_tswap_tpu.runtime import region
+from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def busd_binary() -> Path:
+    binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    return binary
+
+
+def golden_binary() -> Path:
+    binary = build_single_tu("mapd_codec_golden",
+                             "cpp/probes/codec_golden.cpp")
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    return binary
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# pos1 codec
+# ---------------------------------------------------------------------------
+
+def test_pos1_round_trip_property():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        wide = rng.random() < 0.3
+        hi = 1 << 20 if wide else 65536
+        pos, goal = int(rng.integers(hi)), int(rng.integers(hi))
+        task = int(rng.integers(1 << 40)) if rng.random() < 0.5 else None
+        blob = pc.encode_pos1(pos, goal, task)
+        assert pc.decode_pos1(blob) == (pos, goal, task)
+        assert pc.decode_pos1_b64(pc.encode_pos1_b64(pos, goal, task)) \
+            == (pos, goal, task)
+        # narrow packets are less than half the width of wide ones
+        if not wide and pos < 65536 and goal < 65536:
+            assert len(blob) == 12 + (8 if task is not None else 0)
+
+
+def test_pos1_rejects_garbage():
+    with pytest.raises(pc.CodecError):
+        pc.decode_pos1(b"short")
+    with pytest.raises(pc.CodecError):
+        pc.decode_pos1_b64("!!!not-base64!!!")
+    good = pc.encode_pos1(3, 9, 7)
+    with pytest.raises(pc.CodecError):
+        pc.decode_pos1(good + b"x")  # trailing bytes
+    with pytest.raises(pc.CodecError):
+        pc.decode_pos1(b"\x00" * len(good))  # bad magic
+    bad_version = bytearray(good)
+    bad_version[4] = 9
+    with pytest.raises(pc.CodecError):
+        pc.decode_pos1(bytes(bad_version))
+
+
+def test_pos1_golden_bytes_match_cpp():
+    binary = golden_binary()
+    rng = np.random.default_rng(3)
+    cases = []
+    for _ in range(64):
+        hi = 1 << 20 if rng.random() < 0.4 else 65536
+        pos, goal = int(rng.integers(hi)), int(rng.integers(hi))
+        task = int(rng.integers(1 << 40)) if rng.random() < 0.5 else None
+        cases.append((pos, goal, task))
+    feed = "\n".join(
+        json.dumps({"pos": p, "goal": g,
+                    **({"task": t} if t is not None else {})})
+        for p, g, t in cases) + "\n"
+    out = subprocess.run([str(binary), "--pos1-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=60)
+    cpp_lines = out.stdout.split()
+    py_lines = [pc.encode_pos1_b64(p, g, t) for p, g, t in cases]
+    assert cpp_lines == py_lines, "py and cpp pos1 encoders diverged"
+    # and the C++ decoder round-trips the Python bytes
+    out = subprocess.run([str(binary), "--pos1-decode"],
+                         input="\n".join(py_lines) + "\nAAAA\n",
+                         capture_output=True, text=True, check=True,
+                         timeout=60)
+    decoded = out.stdout.splitlines()
+    assert decoded[-1] == "null"  # garbage -> explicit null
+    for (p, g, t), line in zip(cases, decoded):
+        d = json.loads(line)
+        assert (d["pos"], d["goal"], d["task"]) == (p, g, t)
+
+
+# ---------------------------------------------------------------------------
+# region topic math
+# ---------------------------------------------------------------------------
+
+def test_region_neighborhood_covers_radius():
+    """The coverage guarantee region gossip rests on: any publisher within
+    Manhattan `radius` of a subscriber publishes on a topic inside the
+    subscriber's neighborhood — for random grids, region sizes, radii."""
+    rng = np.random.default_rng(7)
+    for _ in range(300):
+        w = int(rng.integers(8, 300))
+        h = int(rng.integers(8, 300))
+        cells = int(rng.integers(4, 64))
+        radius = int(rng.integers(1, 40))
+        sx, sy = int(rng.integers(w)), int(rng.integers(h))
+        # a random publisher within the radius
+        dx = int(rng.integers(-radius, radius + 1))
+        rem = radius - abs(dx)
+        dy = int(rng.integers(-rem, rem + 1))
+        px = min(max(sx + dx, 0), w - 1)
+        py = min(max(sy + dy, 0), h - 1)
+        topics = region.neighborhood_topics(sx, sy, radius, cells, w, h)
+        assert region.topic_for(px, py, cells) in topics, (
+            (w, h, cells, radius), (sx, sy), (px, py))
+
+
+def test_region_neighborhood_is_local():
+    # 32-cell regions on a 1024 grid: the 3x3 neighborhood of a radius-15
+    # view is 9 topics out of 1024 — the O(local density) fanout claim
+    topics = region.neighborhood_topics(512, 512, 15, 32, 1024, 1024)
+    assert len(topics) == 9
+    assert region.topic_for(512, 512, 32) in topics
+    # clamped at the corner: no out-of-grid region indices
+    corner = region.neighborhood_topics(0, 0, 15, 32, 1024, 1024)
+    assert len(corner) == 4
+    assert all(t.startswith(region.POS_TOPIC_PREFIX) for t in corner)
+    for t in corner:
+        rx, ry = map(int, t[len(region.POS_TOPIC_PREFIX):].split("."))
+        assert 0 <= rx <= 1 and 0 <= ry <= 1
+
+
+# ---------------------------------------------------------------------------
+# busd relay fast path
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def busd(tmp_path):
+    """A busd on a free port with small queue limits + send buffers, its
+    log captured; yields (port, log_path)."""
+    binary = busd_binary()
+    port = _free_port()
+    log = open(tmp_path / "bus.log", "w")
+    proc = subprocess.Popen(
+        [str(binary), str(port), "--queue-soft-kb", "64",
+         "--queue-hard-kb", "256", "--sndbuf-kb", "8",
+         "--log-level", "debug"],
+        stdout=log, stderr=subprocess.STDOUT)
+    time.sleep(0.3)
+    try:
+        yield port, tmp_path / "bus.log"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        log.close()
+
+
+def _client(port, peer_id, fastframe=True):
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+    return BusClient(port=port, peer_id=peer_id, fastframe=fastframe)
+
+
+def _drain_welcome(*clients):
+    for c in clients:
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and c.hub_caps is None:
+            c.recv(timeout=0.2)
+
+
+def test_fast_and_legacy_clients_interop(busd):
+    port, _ = busd
+    fast = _client(port, "fastie")
+    legacy = _client(port, "oldie", fastframe=False)
+    for c in (fast, legacy):
+        c.subscribe("t")
+    _drain_welcome(fast, legacy)
+    assert fast.fast_hub and not legacy.fast_hub
+    fast.publish("t", {"k": 1})  # P-frame -> legacy JSON rendering
+    got = None
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and got is None:
+        f = legacy.recv(timeout=0.5)
+        if f and f.get("op") == "msg":
+            got = f
+    assert got == {"op": "msg", "topic": "t", "from": "fastie",
+                   "data": {"k": 1}}
+    legacy.publish("t", {"k": 2})  # JSON pub -> M-frame rendering
+    got = None
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and got is None:
+        f = fast.recv(timeout=0.5)
+        if f and f.get("op") == "msg" and (f.get("data") or {}).get("k") == 2:
+            got = f
+    assert got["from"] == "oldie" and got["topic"] == "t"
+    fast.close()
+    legacy.close()
+
+
+def test_wildcard_prefix_subscription(busd):
+    port, _ = busd
+    mgr = _client(port, "mgr")
+    pub = _client(port, "pub")
+    mgr.subscribe("mapd.pos.*")
+    _drain_welcome(mgr, pub)
+    time.sleep(0.2)
+    for topic in ("mapd.pos.0.0", "mapd.pos.31.17"):
+        pub.publish(topic, {"type": "pos1", "data": pc.encode_pos1_b64(1, 2)})
+    got = set()
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and len(got) < 2:
+        f = mgr.recv(timeout=0.5)
+        if f and f.get("op") == "msg":
+            got.add(f["topic"])
+    assert got == {"mapd.pos.0.0", "mapd.pos.31.17"}
+    # exact + wildcard on the SAME client must not deliver duplicates
+    mgr.subscribe("mapd.pos.0.0")
+    time.sleep(0.2)
+    pub.publish("mapd.pos.0.0", {"n": 1})
+    seen = 0
+    deadline = time.monotonic() + 1.5
+    while time.monotonic() < deadline:
+        f = mgr.recv(timeout=0.3)
+        if f and f.get("op") == "msg" and (f.get("data") or {}).get("n") == 1:
+            seen += 1
+    assert seen == 1, f"duplicate delivery through exact+wildcard: {seen}"
+    mgr.close()
+    pub.close()
+
+
+def _raw_slow_subscriber(port, topics):
+    """A protocol-speaking socket that subscribes and then never reads —
+    the stalled consumer (tiny receive buffer so backpressure builds
+    fast)."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    s.connect(("127.0.0.1", port))
+    payload = json.dumps({"op": "hello", "peer_id": "sloth"}) + "\n"
+    for t in topics:
+        payload += json.dumps({"op": "sub", "topic": t}) + "\n"
+    s.sendall(payload.encode())
+    return s
+
+
+def _busd_counters(port, wait_s=6.0):
+    """Read the hub's own metrics beacon (topic mapd.metrics)."""
+    watch = _client(port, "watch")
+    watch.subscribe("mapd.metrics")
+    deadline = time.monotonic() + wait_s
+    counters = None
+    while time.monotonic() < deadline:
+        f = watch.recv(timeout=0.5)
+        if (f and f.get("op") == "msg"
+                and (f.get("data") or {}).get("proc") == "busd"):
+            counters = (f["data"].get("metrics") or {}).get("counters") or {}
+            break
+    watch.close()
+    return counters
+
+
+def test_slow_consumer_drops_beacons_healthy_unaffected(busd):
+    """A stalled subscriber on a beacon topic loses its oldest queued
+    beacons (counted) instead of stalling the hub; a healthy subscriber
+    of the same topic receives the stream to the end."""
+    port, _ = busd
+    slow = _raw_slow_subscriber(port, ["mapd.pos.0.0"])
+    healthy = _client(port, "healthy")
+    healthy.subscribe("mapd.pos.0.0")
+    pub = _client(port, "pub")
+    _drain_welcome(healthy, pub)
+    time.sleep(0.3)
+    pad = "x" * 400
+    n_msgs = 2000  # ~1 MB through an 8 KB sndbuf + 64 KB soft queue
+    for k in range(n_msgs):
+        pub.publish("mapd.pos.0.0", {"type": "pos1", "seq": k, "pad": pad})
+    last_seen = -1
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and last_seen < n_msgs - 1:
+        f = healthy.recv(timeout=1.0)
+        if f and f.get("op") == "msg":
+            last_seen = f["data"]["seq"]
+    assert last_seen == n_msgs - 1, (
+        f"healthy subscriber stalled behind the slow one (saw {last_seen})")
+    counters = _busd_counters(port)
+    assert counters is not None, "no busd metrics beacon"
+    assert counters.get("bus.slow_consumer_drops", 0) > 0, counters
+    slow.close()
+    healthy.close()
+    pub.close()
+
+
+def test_slow_consumer_evicted_past_hard_limit(busd):
+    """Non-droppable traffic to a stalled consumer grows its queue past
+    the hard limit: the client is evicted (peer_left) instead of
+    anchoring unbounded memory; the flood publisher is unaffected."""
+    port, log_path = busd
+    slow = _raw_slow_subscriber(port, ["tasks.flood"])
+    observer = _client(port, "observer")
+    observer.subscribe("other")
+    pub = _client(port, "pub")
+    _drain_welcome(observer, pub)
+    time.sleep(0.3)
+    pad = "y" * 400
+    for k in range(2000):  # ~1 MB >> 8 KB sndbuf + 256 KB hard limit
+        pub.publish("tasks.flood", {"k": k, "pad": pad})
+    # eviction emits peer_left for the slow client
+    left = None
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and left is None:
+        f = observer.recv(timeout=0.5)
+        if f and f.get("op") == "peer_left" and f.get("peer_id") == "sloth":
+            left = f
+    assert left is not None, "slow consumer was not evicted"
+    counters = _busd_counters(port)
+    assert counters is not None and \
+        counters.get("bus.slow_consumer_evictions", 0) >= 1, counters
+    slow.close()
+    observer.close()
+    pub.close()
+
+
+def test_region_crossing_resubscribe_no_missed_beacons(busd):
+    """A walker crossing a region border (resubscribing per the region
+    helper, exactly like the C++ agent) must receive EVERY beacon a
+    border neighbor publishes — the overlap of consecutive neighborhoods
+    keeps the neighbor's topic subscribed throughout the crossing."""
+    port, _ = busd
+    cells, radius, side = 8, 4, 64
+    neighbor_xy = (7, 8)  # region (0, 1), right at the x-border
+    walker = _client(port, "walker")
+    publisher = _client(port, "neighbor")
+    _drain_welcome(walker, publisher)
+
+    def subs_for(x, y):
+        return set(region.neighborhood_topics(x, y, radius, cells,
+                                              side, side))
+
+    # walk straight through the border between region x=0 and x=1, close
+    # enough that the neighbor stays within the radius the whole time
+    path = [(x, 8) for x in range(4, 12)]
+    cur = subs_for(*path[0])
+    for t in sorted(cur):
+        walker.subscribe(t)
+    time.sleep(0.3)
+    seq = 0
+    received = []
+
+    def pump_walker(budget):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            f = walker.recv(timeout=0.05)
+            if f and f.get("op") == "msg" \
+                    and (f.get("data") or {}).get("type") == "pos1":
+                received.append(f["data"]["seq"])
+
+    for (x, y) in path:
+        want = subs_for(x, y)
+        for t in sorted(want - cur):
+            walker.subscribe(t)
+        for t in sorted(cur - want):
+            walker.unsubscribe(t)
+        cur = want
+        # neighbor beacons twice per walker step, straddling the resub
+        for _ in range(2):
+            publisher.publish(
+                region.topic_for(*neighbor_xy, cells),
+                {"type": "pos1", "seq": seq,
+                 "data": pc.encode_pos1_b64(neighbor_xy[1] * side
+                                            + neighbor_xy[0], 0)})
+            seq += 1
+            pump_walker(0.08)
+    pump_walker(1.0)
+    assert received == list(range(seq)), (
+        f"missed neighbor beacons across the border: got {received}")
+    walker.close()
+    publisher.close()

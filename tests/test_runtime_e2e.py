@@ -954,6 +954,249 @@ def test_legacy_goal_swap_cannot_strand_agent(built, tiny_map, tmp_path):
             "agent froze at the foreign goal:\n" + agent_log[-2000:])
 
 
+def test_late_swap_response_cannot_revive_completed_task(built, tiny_map,
+                                                         tmp_path):
+    """ADVICE r5 race: the agent offers its task in a swap_request, then
+    completes it locally before the response arrives (the blocker moved
+    away).  The LATE swap_response still matches the outstanding exchange
+    by request_id — without clearing pending_swap at completion the agent
+    would adopt the response's task: its own finished task offered back
+    (re-executing it), or a foreign task clobbering the fresh assignment
+    the manager's done-refill just made.  The agent must ignore it."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    # flat JSON wire so the scripted peer sees positions at tick rate; a
+    # generous swap timeout keeps the exchange outstanding across the
+    # complete-then-respond window without racing the 2 s default
+    with Fleet("decentralized", num_agents=1, port=port, map_file=tiny_map,
+               log_dir=str(log_dir),
+               env={"JG_REGION_GOSSIP": "0",
+                    "MAPD_SWAP_TIMEOUT_MS": "6000"}) as fleet:
+        time.sleep(3.5)
+        peer = BusClient(port=port, peer_id="slow-responder")
+        peer.subscribe("mapd")
+        fleet.command("tasks 1")
+
+        def next_hop(pos, goal):
+            # reference neighbor order, first strict improvement — the
+            # same hop the agent's BFS descent picks on an empty map
+            x, y = pos
+            gx, gy = goal
+            d0 = abs(x - gx) + abs(y - gy)
+            for dx, dy in ((0, 1), (1, 0), (0, -1), (-1, 0)):
+                nx, ny = x + dx, y + dy
+                if 0 <= nx < 12 and 0 <= ny < 12 \
+                        and abs(nx - gx) + abs(ny - gy) < d0:
+                    return [nx, ny]
+            return None
+
+        swap_req = None      # the request we deliberately answer LATE
+        swap_req_at = 0.0
+        offered_task = None
+        task = None          # the bare Task the manager dispatched
+        done_seen = False
+        parked_for = None    # task id we parked for (re-arm per refill)
+        deadline = time.monotonic() + 75
+        while time.monotonic() < deadline:
+            f = peer.recv(timeout=1.0)
+            if not f or f.get("op") != "msg":
+                continue
+            d = f.get("data") or {}
+            typ = d.get("type")
+            if typ is None and "pickup" in d and "delivery" in d:
+                task = d  # incl. the refill after a missed window
+            elif typ == "position" and d.get("peer_id") != "slow-responder":
+                pos, goal = d.get("pos"), d.get("goal")
+                if swap_req is None and task is not None \
+                        and parked_for != task["task_id"] \
+                        and pos and goal == task["delivery"] \
+                        and 3 <= (abs(pos[0] - goal[0])
+                                  + abs(pos[1] - goal[1])) <= 5:
+                    # 3-5 hops from the DELIVERY: park TWO hops ahead of
+                    # the agent (its beacon precedes its move within the
+                    # same tick, so parking on the immediate next hop
+                    # lands a tick late and it walks through).  Two hops
+                    # ahead, the claim is in its nearby cache before the
+                    # decision that would enter the cell: Rule 3 fires a
+                    # swap_request offering its task, and completion
+                    # follows a few moves after we step aside — inside
+                    # the swap-timeout window.
+                    hop1 = next_hop(pos, goal)
+                    hop2 = next_hop(hop1, goal) if hop1 else None
+                    if hop2:
+                        parked_for = task["task_id"]
+                        peer.publish("mapd", {
+                            "type": "position",
+                            "peer_id": "slow-responder",
+                            "pos": hop2, "goal": hop2})
+            elif typ == "swap_request" \
+                    and d.get("to_peer") == "slow-responder":
+                swap_req = d
+                swap_req_at = time.monotonic()
+                offered_task = d.get("task")
+                # "move away" so the agent can proceed and complete; do
+                # NOT answer yet — that is the race
+                peer.publish("mapd", {
+                    "type": "position", "peer_id": "slow-responder",
+                    "pos": [11, 0], "goal": [11, 0]})
+            elif d.get("status") == "done" and swap_req is not None:
+                if offered_task \
+                        and d.get("task_id") == offered_task.get("task_id"):
+                    if time.monotonic() - swap_req_at < 4.0:
+                        done_seen = True
+                        break
+                    # the arm was slow enough that the swap timeout may
+                    # already have cleared the exchange on its own —
+                    # that wouldn't exercise the completion-clears-offer
+                    # path.  Re-arm on the next task cycle instead.
+                    swap_req = offered_task = None
+        assert swap_req is not None, "agent never sent a swap_request"
+        assert done_seen, "agent did not complete the offered task"
+        # the late response: offer the agent's own completed task back,
+        # echoing the request_id (the exchange it still has outstanding
+        # unless completion cleared it)
+        time.sleep(0.6)  # let the done_ack land (unacked_done cleared)
+        peer.publish("mapd", {
+            "type": "swap_response",
+            "request_id": swap_req["request_id"],
+            "from_peer": "slow-responder",
+            "to_peer": swap_req["from_peer"],
+            "task": offered_task,
+            "phase": "delivery"})
+        time.sleep(2.5)
+        peer.close()
+        fleet.quit()
+        agent_log = "".join(f.read_text(errors="ignore")
+                            for f in sorted(log_dir.glob("agent_*.log")))
+        tid = offered_task.get("task_id")
+        assert f"adopted task {tid}" not in agent_log, (
+            "late swap_response revived the completed task:\n"
+            + agent_log[-2500:])
+        # exactly one completion of that task id (no re-execution)
+        assert agent_log.count(f"Task {tid} DONE") == 1, agent_log[-2500:]
+
+
+def test_region_gossip_flat_json_peer_interop(built, tiny_map, tmp_path):
+    """Caps negotiation e2e (ISSUE 4): with region gossip ON (default), a
+    flat-topic JSON peer that never speaks pos1 must still interoperate —
+    it discovers the agent via the slow JSON beacon, and once it
+    announces itself with a capsless JSON position the agent echoes JSON
+    positions at full tick rate (and sees the peer in its own nearby
+    cache, observable as a swap_request when the peer parks in its
+    way)."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    with Fleet("decentralized", num_agents=1, port=port, map_file=tiny_map,
+               log_dir=str(log_dir)) as fleet:
+        time.sleep(3.5)
+        legacy = BusClient(port=port, peer_id="flat-peer", fastframe=False)
+        legacy.subscribe("mapd")
+        fleet.command("tasks 1")
+
+        # 1. discovery: the slow JSON beacon reaches a flat-topic peer
+        discovered = False
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not discovered:
+            f = legacy.recv(timeout=1.0)
+            if f and f.get("op") == "msg" \
+                    and (f.get("data") or {}).get("type") == "position":
+                discovered = True
+        assert discovered, "no JSON discovery beacon on the flat topic"
+
+        # 2. capsless JSON position -> full-rate echo
+        legacy.publish("mapd", {"type": "position", "peer_id": "flat-peer",
+                                "pos": [0, 0], "goal": [0, 0]})
+        n = 0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 3.0:
+            f = legacy.recv(timeout=0.5)
+            if f and f.get("op") == "msg" \
+                    and (f.get("data") or {}).get("type") == "position":
+                n += 1
+        assert n >= 4, (
+            f"only {n} JSON positions in 3 s after legacy evidence — "
+            "full-rate echo did not engage (500 ms tick should give ~6)")
+        legacy.close()
+        fleet.quit()
+
+
+def test_manager_liveness_sweeps_held_through_outage(built, tiny_map,
+                                                     tmp_path):
+    """ADVICE r5: heartbeats cannot arrive while the bus is down, so a
+    bus outage longer than agent_stale_ms must NOT make the manager
+    re-queue live peers' tasks — the sweeps hold during the outage and
+    drain one claim cycle after the reconnect, letting post-outage
+    heartbeat claims land before the deliberate-duplicate re-dispatch."""
+    from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    cfg = RuntimeConfig(agent_stale_ms=3000, cleanup_interval_ms=1000)
+    new_bus = None
+    with Fleet("decentralized", num_agents=0, port=port, map_file=tiny_map,
+               log_dir=str(log_dir), config=cfg) as fleet:
+        try:
+            p1 = BusClient(port=port, peer_id="py-live-1", reconnect=True)
+            p1.subscribe("mapd")
+            time.sleep(1.0)
+            fleet.command("tasks 1")
+            task = None
+            deadline = time.monotonic() + 10
+            last_beat = 0.0
+
+            def beat():
+                msg = {"type": "position_update", "peer_id": "py-live-1",
+                       "position": [1, 1]}
+                if task is not None:
+                    msg["busy_task"] = task["task_id"]
+                p1.publish("mapd", msg)
+
+            while time.monotonic() < deadline and task is None:
+                if time.monotonic() - last_beat >= 0.4:
+                    last_beat = time.monotonic()
+                    beat()
+                f = p1.recv(timeout=0.2)
+                if f and f.get("op") == "msg":
+                    d = f.get("data") or {}
+                    if "pickup" in d and d.get("peer_id") == "py-live-1":
+                        task = d
+            assert task is not None, "task never dispatched"
+            for _ in range(3):  # a few busy claims land pre-outage
+                beat()
+                time.sleep(0.4)
+
+            fleet.procs[0].kill()  # bus outage, LONGER than agent_stale_ms
+            time.sleep(4.5)
+            new_bus = subprocess.Popen(
+                [str(BUILD_DIR / "mapd_bus"), str(port)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            # keep claiming through the reconnect window
+            t_end = time.monotonic() + 8
+            while time.monotonic() < t_end:
+                beat()
+                time.sleep(0.4)
+                p1.recv(timeout=0.05)
+            log = (log_dir / "manager.log").read_text(errors="ignore")
+            p1.close()
+            fleet.quit()
+            assert "bus: reconnected" in log, log[-2000:]
+            assert "unclaimed by any peer" not in log, (
+                "sweep re-queued a live peer's task through the outage:\n"
+                + log[-3000:])
+            assert "silent for" not in log, (
+                "silence sweep dropped a live peer through the outage:\n"
+                + log[-3000:])
+        finally:
+            if new_bus is not None:
+                new_bus.kill()
+
+
 @pytest.mark.parametrize("mode", ["decentralized", "centralized"])
 def test_fleet_survives_bus_restart(built, tiny_map, tmp_path, mode):
     """Kill busd mid-run and restart it on the same port: every role must
