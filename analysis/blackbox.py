@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Print the fleet's interleaved last seconds from flight-recorder dumps.
+
+Every process keeps an always-on ring of its newest structured events
+(obs/flightrec.py ≡ cpp/common/flightrec.hpp) and dumps it to
+``<proc>-<pid>.flight.jsonl`` on crash, exit, SIGUSR2, or a bus
+``flight_dump`` request.  This tool merges every dump in a directory into
+one wall-clock-ordered view of the moments before an incident — the
+aviation black-box readout for a fleet outage (ISSUE 5).
+
+Usage:
+  python analysis/blackbox.py --dir <fleet log dir> [--last 30] [--json]
+  python analysis/blackbox.py --dir results/trace --grep task.dispatch
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_dumps(directory: Path) -> tuple:
+    """(meta-records, merged time-ordered events)."""
+    metas, events = [], []
+    for path in sorted(directory.glob("*.flight.jsonl")):
+        for line in path.read_text(errors="ignore").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("meta") == "flight":
+                rec["file"] = path.name
+                metas.append(rec)
+            elif "ts_ms" in rec:
+                events.append(rec)
+    events.sort(key=lambda e: e.get("ts_ms", 0))
+    return metas, events
+
+
+def render_event(ev: dict, t_end_ms: int) -> str:
+    rel = (ev.get("ts_ms", 0) - t_end_ms) / 1000.0
+    who = f"{ev.get('proc', '?')}/{ev.get('pid', '?')}"
+    detail = " ".join(
+        f"{k}={ev[k]}" for k in ("task_id", "trace_id", "hop", "peer",
+                                 "wire_ms", "seq", "error")
+        if k in ev)
+    return f"  {rel:+9.3f}s  {who:<28} {ev.get('event', '?'):<22} {detail}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="results/trace",
+                    help="directory holding *.flight.jsonl dumps "
+                         "(JG_FLIGHT_DIR / a fleet log dir)")
+    ap.add_argument("--last", type=float, default=30.0,
+                    help="window before the newest event, seconds")
+    ap.add_argument("--grep", default="",
+                    help="substring filter on the event name")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    directory = Path(args.dir)
+    metas, events = load_dumps(directory)
+    if args.grep:
+        events = [e for e in events if args.grep in str(e.get("event", ""))]
+    t_end = max((e.get("ts_ms", 0) for e in events), default=0)
+    window = [e for e in events
+              if e.get("ts_ms", 0) >= t_end - args.last * 1000.0]
+    if args.as_json:
+        print(json.dumps({"dir": str(directory), "dumps": metas,
+                          "t_end_ms": t_end, "window_s": args.last,
+                          "events": window}))
+        return 0 if metas else 1
+    if not metas:
+        print(f"no *.flight.jsonl dumps in {directory} — trigger one with "
+              f"SIGUSR2, a bus flight_dump message, or a process exit")
+        return 1
+    print(f"black box: {len(metas)} ring dump(s) in {directory}")
+    for m in metas:
+        print(f"  {m['file']}: {m.get('proc')}/{m.get('pid')} "
+              f"reason={m.get('reason')} events={m.get('events')}")
+    print(f"last {args.last:g}s before t_end "
+          f"({len(window)}/{len(events)} events):")
+    for ev in window:
+        print(render_event(ev, t_end))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,327 @@
+#!/usr/bin/env python
+"""Reconstruct per-task causal timelines from merged lifecycle-event logs.
+
+The fleet's processes emit structured lifecycle events (obs/events.py,
+cpp/common/events.hpp) into ``<proc>-<pid>.events.jsonl`` files, each event
+stamped with the task's trace context (trace_id / hop / sender wall clock).
+This tool merges every process's log, groups events by trace_id, orders each
+task's hops, and attributes its end-to-end latency to phases:
+
+  queueing   task.queue    -> task.dispatch   (manager-side wait)
+  wire       task.dispatch -> task.claim      (dispatch one-way)
+  planning   task.claim    -> task.exec       (first obeyed instruction —
+                                               centralized only; 0 when the
+                                               agent plans locally)
+  to_pickup  claim/exec    -> task.pickup
+  to_deliver task.pickup   -> task.delivery
+  done_wire  task.delivery -> task.done       (done one-way)
+  ack        task.done     -> task.done_ack   (ack round trip)
+
+Phases are CONSECUTIVE segment diffs, so they telescope: their sum equals
+end-to-end (done_ack - dispatch) exactly, modulo clock-skew clamps (negative
+segments clamp to 0 and are reported as ``skew_ms`` — the same discipline as
+the PR-1 task-metric clamps).  Swap negotiation (decentralized task
+exchanges) overlaps the travel legs, so it is reported as an overlay
+(``swap_ms``: sum of swap_req -> swap_resp/adopt intervals), not a summand.
+
+A timeline is COMPLETE (gap-free) when every required hop is present:
+dispatch, claim, pickup, delivery, done, done_ack.  Coverage = complete /
+done-ACKED traces (a task finishing right at fleet shutdown can have its
+ack truncated — a run boundary, not a propagation gap); the e2e gate
+asserts >= 0.95.  Orphan events —
+a trace with POST-DISPATCH lifecycle events but no dispatch root —
+indicate a broken propagation path and are listed.  Queued-but-not-yet-
+dispatched tasks (manager-side events only) are a healthy backlog and are
+counted separately as ``pending``.
+
+Usage:
+  python analysis/task_timeline.py --dir results/trace --once --json
+  python analysis/task_timeline.py --dir <fleet log dir>    # live watch
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+REQUIRED = ("task.dispatch", "task.claim", "task.pickup", "task.delivery",
+            "task.done", "task.done_ack")
+# The reference's done detection is purely positional (pos == delivery),
+# so a task whose delivery cell is crossed BEFORE its pickup completes
+# without a pickup phase ever happening — a missing task.pickup alongside
+# a full delivery->done->ack tail is that legitimate early-done shape,
+# not a propagation gap.
+
+# phase boundaries: consecutive anchors; a missing optional anchor folds
+# its segment into the next one
+ANCHORS = ("task.queue", "task.dispatch", "task.claim", "task.exec",
+           "task.pickup", "task.delivery", "task.done", "task.done_ack")
+PHASE_OF_SEGMENT = {
+    ("task.queue", "task.dispatch"): "queueing",
+    ("task.dispatch", "task.claim"): "wire",
+    ("task.claim", "task.exec"): "planning",
+    ("task.exec", "task.pickup"): "to_pickup",
+    ("task.claim", "task.pickup"): "to_pickup",  # no exec: local planner
+    ("task.pickup", "task.delivery"): "to_delivery",
+    ("task.exec", "task.delivery"): "to_delivery",   # early-done shapes
+    ("task.claim", "task.delivery"): "to_delivery",
+    ("task.delivery", "task.done"): "done_wire",
+    ("task.done", "task.done_ack"): "ack",
+}
+
+PHASES = ("queueing", "wire", "planning", "to_pickup", "to_delivery",
+          "done_wire", "ack")
+
+
+def load_events(directory: Path) -> list:
+    events = []
+    for path in sorted(directory.glob("*.events.jsonl")):
+        for line in path.read_text(errors="ignore").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn line from a live writer
+            if isinstance(ev, dict) and "event" in ev:
+                events.append(ev)
+    return events
+
+
+def group_tasks(events: list) -> dict:
+    """trace_id -> time-ordered task lifecycle events (plan.* and
+    bus.* events are a different subsystem's traffic)."""
+    by_trace: dict = {}
+    for ev in events:
+        tid = ev.get("trace_id")
+        if tid is None or not str(ev.get("event", "")).startswith("task."):
+            continue
+        by_trace.setdefault(int(tid), []).append(ev)
+    for evs in by_trace.values():
+        evs.sort(key=lambda e: (e.get("ts_ms", 0), e.get("hop", 0)))
+    return by_trace
+
+
+def reconstruct(evs: list) -> dict:
+    """One trace's timeline record (see module docstring for semantics)."""
+    present = {}
+    for ev in evs:
+        name = ev["event"]
+        if name not in present:  # first occurrence anchors the phase
+            present[name] = ev
+    missing = [r for r in REQUIRED if r not in present]
+    early_done = missing == ["task.pickup"]  # see REQUIRED comment
+    if early_done:
+        missing = []
+    # hop monotonicity along the time-ordered chain (max-merge semantics:
+    # equal hops repeat on heartbeats/duplicates, decreases are violations)
+    hop_violations = 0
+    last_hop = -1
+    for ev in evs:
+        h = ev.get("hop")
+        if h is None:
+            continue
+        if h < last_hop:
+            hop_violations += 1
+        last_hop = max(last_hop, h)
+    rec = {
+        "trace_id": evs[0].get("trace_id"),
+        "task_id": next((e.get("task_id") for e in evs
+                         if e.get("task_id") is not None), None),
+        "events": len(evs),
+        "events_seen": sorted({e["event"] for e in evs}),
+        "first_ts_ms": evs[0].get("ts_ms"),
+        "missing": missing,
+        "complete": not missing,
+        "early_done": early_done,
+        "hop_violations": hop_violations,
+        "procs": sorted({e.get("proc", "?") for e in evs}),
+    }
+    if missing:
+        return rec
+    # consecutive anchor segments -> phases (telescoping sum)
+    anchors = [(a, present[a]["ts_ms"]) for a in ANCHORS if a in present]
+    phases = {p: 0.0 for p in PHASES}
+    skew_ms = 0.0
+    for (a_name, a_ts), (b_name, b_ts) in zip(anchors, anchors[1:]):
+        seg = b_ts - a_ts
+        if seg < 0:
+            skew_ms += -seg
+            seg = 0
+        # only queue/exec are optional, so every consecutive anchor pair
+        # is enumerated in the map; "to_pickup" is an unreachable default
+        phase = PHASE_OF_SEGMENT.get((a_name, b_name), "to_pickup")
+        phases[phase] += seg
+    end_to_end = present["task.done_ack"]["ts_ms"] \
+        - present["task.dispatch"]["ts_ms"]
+    # swap overlay: each swap_req pairs with the next swap_resp/adopt
+    swap_ms = 0.0
+    swaps = 0
+    open_req = None
+    for ev in evs:
+        if ev["event"] == "task.swap_req":
+            open_req = ev["ts_ms"]
+        elif ev["event"] in ("task.swap_resp", "task.adopt") \
+                and open_req is not None:
+            swap_ms += max(0, ev["ts_ms"] - open_req)
+            swaps += 1
+            open_req = None
+    rec.update({
+        "phases_ms": {k: round(v, 3) for k, v in phases.items()},
+        "end_to_end_ms": round(float(max(0, end_to_end)), 3),
+        "queue_to_ack_ms": round(float(
+            present["task.done_ack"]["ts_ms"]
+            - present.get("task.queue", present["task.dispatch"])["ts_ms"]),
+            3),
+        "skew_ms": round(skew_ms, 3),
+        "swap_ms": round(swap_ms, 3),
+        "swaps": swaps,
+        "wire_oneway_ms": {
+            name.split(".", 1)[1]: present[name]["wire_ms"]
+            for name in ("task.claim", "task.done", "task.done_ack")
+            if "wire_ms" in present[name]},
+    })
+    return rec
+
+
+def percentile(values: list, q: float) -> float:
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+    return float(vs[idx])
+
+
+def summarize(directory: Path) -> dict:
+    events = load_events(directory)
+    tasks = group_tasks(events)
+    records = [reconstruct(evs) for evs in tasks.values()]
+    records.sort(key=lambda r: r.get("first_ts_ms") or 0)
+    done_traces = [r for r in records
+                   if r["complete"] or "task.done" not in r["missing"]]
+    # coverage denominator: tasks whose lifecycle FINISHED (done-acked).
+    # A task completing right at fleet shutdown can have its ack (and the
+    # ack's event) truncated — that is a run boundary, not a propagation
+    # gap, and must not dilute the coverage gate.
+    acked = [r for r in records
+             if r["complete"] or "task.done_ack" not in r["missing"]]
+    complete = [r for r in records if r["complete"]]
+    # pending: manager-side-only traces (queued/requeued, never dispatched
+    # yet) — a healthy backlog, NOT a propagation failure.  An orphan has
+    # post-dispatch lifecycle events but no dispatch root.
+    manager_only = {"task.queue", "task.requeue"}
+    pending = [r for r in records
+               if "task.dispatch" in r["missing"]
+               and not (set(r["events_seen"]) - manager_only)]
+    pending_ids = {id(r) for r in pending}
+    orphans = [r for r in records
+               if "task.dispatch" in r["missing"] and r["events"] > 0
+               and id(r) not in pending_ids]
+    summary: dict = {
+        "dir": str(directory),
+        "event_files": len(list(directory.glob("*.events.jsonl"))),
+        "events": len(events),
+        "traces": len(records),
+        "tasks_done": len(done_traces),
+        "tasks_acked": len(acked),
+        "tasks_complete": len(complete),
+        "coverage": round(len(complete) / len(acked), 4)
+        if acked else None,
+        "pending": len(pending),
+        "orphans": len(orphans),
+        "orphan_trace_ids": [r["trace_id"] for r in orphans][:20],
+        "hop_violations": sum(r["hop_violations"] for r in records),
+    }
+    if complete:
+        summary["fleet_phases_ms"] = {
+            p: {"p50": round(percentile(
+                    [r["phases_ms"][p] for r in complete], 0.50), 1),
+                "p95": round(percentile(
+                    [r["phases_ms"][p] for r in complete], 0.95), 1),
+                "p99": round(percentile(
+                    [r["phases_ms"][p] for r in complete], 0.99), 1)}
+            for p in PHASES}
+        e2e = [r["end_to_end_ms"] for r in complete]
+        summary["end_to_end_ms"] = {
+            "p50": round(percentile(e2e, 0.50), 1),
+            "p95": round(percentile(e2e, 0.95), 1),
+            "p99": round(percentile(e2e, 0.99), 1)}
+        summary["swap_ms_total"] = round(
+            sum(r["swap_ms"] for r in complete), 1)
+    summary["tasks"] = records
+    return summary
+
+
+def render(summary: dict) -> str:
+    out = []
+    cov = summary["coverage"]
+    out.append(f"task timelines from {summary['dir']} "
+               f"({summary['event_files']} event files, "
+               f"{summary['events']} events)")
+    out.append(f"  traces {summary['traces']}  done {summary['tasks_done']}"
+               f"  acked {summary['tasks_acked']}"
+               f"  complete {summary['tasks_complete']}"
+               f"  coverage {'-' if cov is None else f'{cov:.1%}'}"
+               f"  pending {summary['pending']}"
+               f"  orphans {summary['orphans']}"
+               f"  hop-violations {summary['hop_violations']}")
+    if "fleet_phases_ms" in summary:
+        out.append(f"  end-to-end ms  p50 {summary['end_to_end_ms']['p50']}"
+                   f"  p95 {summary['end_to_end_ms']['p95']}"
+                   f"  p99 {summary['end_to_end_ms']['p99']}")
+        out.append("  phase          p50        p95        p99  (ms)")
+        for p in PHASES:
+            s = summary["fleet_phases_ms"][p]
+            out.append(f"  {p:<12} {s['p50']:>8} {s['p95']:>10}"
+                       f" {s['p99']:>10}")
+    for r in summary["tasks"][:40]:
+        if r["complete"]:
+            ph = " ".join(f"{k}={v:.0f}" for k, v in r["phases_ms"].items()
+                          if v)
+            out.append(f"  task {r['task_id']}: {r['end_to_end_ms']:.0f} ms"
+                       f"  [{ph}]"
+                       + (f"  swap={r['swap_ms']:.0f}x{r['swaps']}"
+                          if r["swaps"] else "")
+                       + (f"  skew={r['skew_ms']:.0f}"
+                          if r["skew_ms"] else ""))
+        else:
+            out.append(f"  task {r['task_id']} trace {r['trace_id']}: "
+                       f"INCOMPLETE missing={','.join(r['missing'])} "
+                       f"({r['events']} events from "
+                       f"{'/'.join(r['procs'])})")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="results/trace",
+                    help="directory holding *.events.jsonl (JG_TRACE_DIR "
+                         "or a fleet log dir)")
+    ap.add_argument("--once", action="store_true",
+                    help="one shot (default: refresh every --interval)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    directory = Path(args.dir)
+    while True:
+        summary = summarize(directory)
+        if args.as_json:
+            print(json.dumps(summary))
+        else:
+            print(render(summary), flush=True)
+        if args.once:
+            # exit status doubles as the CI smoke gate: 0 iff at least
+            # one fully-attributed task reconstructed
+            return 0 if summary["tasks_complete"] >= 1 else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

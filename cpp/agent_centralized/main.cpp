@@ -20,6 +20,7 @@
 #include <string>
 
 #include "../common/bus.hpp"
+#include "../common/events.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
@@ -69,6 +70,10 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
+  // lifecycle events + flight recorder (ISSUE 5); trace-context
+  // propagation gated by JG_TRACE_CTX
+  events_init("agent_centralized");
+  const bool tctx = trace_ctx_enabled();
 
   Grid grid = Grid::default_grid();
   if (!map_file.empty()) {
@@ -92,6 +97,16 @@ int main(int argc, char** argv) {
 
   Cell my_pos = grid.random_free_cell(rng);
   std::optional<Json> my_task;
+  // trace context of the held task (rode in on the Task message); every
+  // SEND that references the task advances the hop, heartbeats repeat
+  // the current hop (a claim is not causal progress)
+  std::optional<codec::TraceCtx> my_tc;
+  bool exec_emitted = false;  // first obeyed move_instruction per task
+  auto my_tc_next = [&]() {
+    my_tc->hop += 1;
+    my_tc->send_ms = unix_ms();
+    return *my_tc;
+  };
 
   // Done retransmit-until-ack (lost-done desync fix): a done published
   // into a bus outage is silently dropped (bus.hpp: lossy medium), which
@@ -102,6 +117,7 @@ int main(int argc, char** argv) {
   Json unacked_done_metric;
   long long unacked_done_id = -1;
   int64_t done_last_sent_ms = 0;
+  std::optional<codec::TraceCtx> unacked_tc;  // refreshed per retransmit
 
   auto point_json = [&](Cell c) {
     Json p;
@@ -124,10 +140,17 @@ int main(int argc, char** argv) {
       // packed heartbeat on the region topic (goal = pos: the centralized
       // agent has no local goal; the manager steers it by instruction)
       Json b;
+      codec::TraceCtx hb_tc;
+      bool with_tc = tctx && my_task.has_value() && my_tc.has_value();
+      if (with_tc) {
+        hb_tc = *my_tc;  // current hop, fresh stamp: a repeated claim
+        hb_tc.send_ms = unix_ms();
+      }
       b.set("type", "pos1")
           .set("data", codec::encode_pos1_b64(
                            my_pos, my_pos, my_task.has_value(),
-                           my_task ? (*my_task)["task_id"].as_int() : 0));
+                           my_task ? (*my_task)["task_id"].as_int() : 0,
+                           with_tc ? &hb_tc : nullptr));
       bus.publish(regions.topic_for(grid, my_pos), b);
       // a slow JSON heartbeat rides along so a flat-wire manager (the
       // kill switch set on its side, or a reference-wire build) still
@@ -143,7 +166,14 @@ int main(int argc, char** argv) {
         .set("position", point_json(my_pos));
     // busy/idle status rides the heartbeat so the manager can detect a
     // Task whose delivery was lost in an outage (idle-but-marked-busy)
-    if (my_task) upd.set("busy_task", (*my_task)["task_id"]);
+    if (my_task) {
+      upd.set("busy_task", (*my_task)["task_id"]);
+      if (tctx && my_tc) {
+        codec::TraceCtx t = *my_tc;
+        t.send_ms = unix_ms();
+        upd.set("tc", tc_json(t));
+      }
+    }
     bus.publish("mapd", upd);
   };
 
@@ -167,6 +197,11 @@ int main(int argc, char** argv) {
       Json metric = task_metric("task_metric_completed");
       Json done;
       done.set("status", "done").set("task_id", (*my_task)["task_id"]);
+      if (tctx && my_tc) {
+        event_emit("task.delivery", &*my_tc,
+                   (*my_task)["task_id"].as_int(), my_id);
+        done.set("tc", tc_json(my_tc_next()));
+      }
       bus.publish("mapd", done);
       log_info("✅ Task %lld DONE\n",
                static_cast<long long>((*my_task)["task_id"].as_int()));
@@ -174,9 +209,20 @@ int main(int argc, char** argv) {
       unacked_done = done;
       unacked_done_metric = metric;
       unacked_done_id = (*my_task)["task_id"].as_int();
+      unacked_tc = my_tc;
       done_last_sent_ms = mono_ms();
       my_task.reset();
+      my_tc.reset();
     }
+  };
+
+  // retransmitted dones carry a FRESH context stamp (hop advances too:
+  // each retransmit is a new wire crossing)
+  auto refresh_unacked_tc = [&]() {
+    if (!(tctx && unacked_tc && unacked_done)) return;
+    unacked_tc->hop += 1;
+    unacked_tc->send_ms = unix_ms();
+    unacked_done->set("tc", tc_json(*unacked_tc));
   };
 
   log_info("🤖 centralized agent %s at (%d, %d)\n", my_id.c_str(),
@@ -202,6 +248,19 @@ int main(int argc, char** argv) {
       if (type == "move_instruction") {
         if (d["peer_id"].as_str() != my_id) return;
         if (auto np = parse_point(d["next_pos"])) {
+          if (auto t = tc_parse(d)) {
+            if (my_tc && t->trace_id == my_tc->trace_id) {
+              if (t->hop > my_tc->hop) my_tc->hop = t->hop;  // max-merge
+              if (!exec_emitted && my_task) {
+                // first obeyed instruction: the execution leg has begun
+                // (claim -> exec is the planning wait)
+                exec_emitted = true;
+                event_emit("task.exec", &*t,
+                           (*my_task)["task_id"].as_int(), my_id,
+                           t->send_ms);
+              }
+            }
+          }
           my_pos = *np;  // obey and re-broadcast immediately (ref :312-330)
           broadcast_position();
           last_broadcast = mono_ms();
@@ -210,9 +269,15 @@ int main(int argc, char** argv) {
       } else if (type == "done_ack") {
         if (d["peer_id"].as_str() == my_id
             && d["task_id"].as_int() == unacked_done_id) {
+          if (auto t = tc_parse(d))
+            event_emit("task.done_ack", &*t, unacked_done_id, my_id,
+                       t->send_ms);
           unacked_done.reset();
+          unacked_tc.reset();
           unacked_done_id = -1;
         }
+      } else if (type == "flight_dump") {
+        bus.publish("mapd", flight_dump_answer("agent_centralized", my_id));
       } else if (type == "task_withdrawn") {
         // a TSWAP goal exchange moved this task to another agent; drop
         // the stale copy so positional completion can't double-fire
@@ -220,7 +285,11 @@ int main(int argc, char** argv) {
             && (*my_task)["task_id"].as_int() == d["task_id"].as_int()) {
           log_info("🔁 task %lld withdrawn (exchanged away)\n",
                    d["task_id"].as_int());
+          if (auto t = tc_parse(d))
+            event_emit("task.withdrawn", &*t, d["task_id"].as_int(),
+                       my_id, t->send_ms);
           my_task.reset();
+          my_tc.reset();
         }
       } else if (type.empty() && d.has("pickup") && d.has("delivery")) {
         if (d["peer_id"].as_str() != my_id) return;
@@ -228,6 +297,7 @@ int main(int argc, char** argv) {
         if (unacked_done && tid == unacked_done_id) {
           // the manager re-sent a task we already completed (its done was
           // lost): refuse the duplicate and heal by retransmitting now
+          refresh_unacked_tc();
           bus.publish("mapd", unacked_done_metric);
           bus.publish("mapd", *unacked_done);
           done_last_sent_ms = mono_ms();
@@ -236,6 +306,10 @@ int main(int argc, char** argv) {
         if (my_task && (*my_task)["task_id"].as_int() == tid)
           return;  // duplicate delivery of the task we are working on
         my_task = d;
+        my_tc = tc_parse(d);
+        exec_emitted = false;
+        if (my_tc)
+          event_emit("task.claim", &*my_tc, tid, my_id, my_tc->send_ms);
         task_metric("task_metric_received");
         task_metric("task_metric_started");
         log_info("📦 [TASK RECEIVED] Task ID: %lld\n",
@@ -257,6 +331,7 @@ int main(int argc, char** argv) {
     if (unacked_done && now - done_last_sent_ms >= done_retry_ms) {
       log_info("🔁 retransmitting done for task %lld (no ack yet)\n",
                unacked_done_id);
+      refresh_unacked_tc();
       bus.publish("mapd", unacked_done_metric);
       bus.publish("mapd", *unacked_done);
       done_last_sent_ms = now;

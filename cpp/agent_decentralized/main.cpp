@@ -26,6 +26,7 @@
 #include <vector>
 
 #include "../common/bus.hpp"
+#include "../common/events.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
@@ -131,6 +132,10 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
+  // lifecycle events + flight recorder (ISSUE 5); trace-context
+  // propagation gated by JG_TRACE_CTX
+  events_init("agent_decentralized");
+  const bool tctx = trace_ctx_enabled();
 
   Grid grid = Grid::default_grid();
   if (!args.map_file.empty()) {
@@ -196,6 +201,16 @@ int main(int argc, char** argv) {
   enum class TaskState { Idle, MovingToPickup, MovingToDelivery };
   TaskState task_state = TaskState::Idle;
   std::optional<Json> my_task;  // bare Task JSON (pickup/delivery/peer_id/task_id)
+  // trace context of the held task: rides every send that references it
+  // (swap offers, done) with the hop advanced, and repeats its current
+  // hop on claim heartbeats.  An adopted task brings ITS context along,
+  // so the trace follows the task across holders.
+  std::optional<codec::TraceCtx> my_tc;
+  auto my_tc_next = [&]() {
+    my_tc->hop += 1;
+    my_tc->send_ms = unix_ms();
+    return *my_tc;
+  };
   auto task_cell = [&](const char* field) -> std::optional<Cell> {
     if (!my_task) return std::nullopt;
     return parse_point(grid, (*my_task)[field]);
@@ -230,6 +245,13 @@ int main(int argc, char** argv) {
   Json unacked_done_metric;
   long long unacked_done_id = -1;
   int64_t done_last_sent_ms = 0;
+  std::optional<codec::TraceCtx> unacked_tc;  // refreshed per retransmit
+  auto refresh_unacked_tc = [&]() {
+    if (!(tctx && unacked_tc && unacked_done)) return;
+    unacked_tc->hop += 1;
+    unacked_tc->send_ms = unix_ms();
+    unacked_done->set("tc", tc_json(*unacked_tc));
+  };
 
   // ---- region-sharded position gossip state ----
   std::set<std::string> region_subs;  // current neighborhood topics
@@ -283,7 +305,14 @@ int main(int argc, char** argv) {
         .set("position", point_json(grid, my_pos));
     // busy/idle status rides the heartbeat so the manager can detect a
     // Task whose delivery was lost in an outage (idle-but-marked-busy)
-    if (my_task) upd.set("busy_task", (*my_task)["task_id"]);
+    if (my_task) {
+      upd.set("busy_task", (*my_task)["task_id"]);
+      if (tctx && my_tc) {
+        codec::TraceCtx t = *my_tc;
+        t.send_ms = unix_ms();
+        upd.set("tc", tc_json(t));
+      }
+    }
     bus.publish("mapd", upd);
   };
 
@@ -297,10 +326,17 @@ int main(int argc, char** argv) {
     // peers in the region neighborhood feed their nearby cache from it,
     // the manager (wildcard-subscribed) feeds tracking + busy claims
     Json b;
+    codec::TraceCtx hb_tc;
+    bool with_tc = tctx && my_task.has_value() && my_tc.has_value();
+    if (with_tc) {
+      hb_tc = *my_tc;  // current hop, fresh stamp: a repeated claim
+      hb_tc.send_ms = unix_ms();
+    }
     b.set("type", "pos1")
         .set("data", codec::encode_pos1_b64(
                          my_pos, my_goal, my_task.has_value(),
-                         my_task ? (*my_task)["task_id"].as_int() : 0));
+                         my_task ? (*my_task)["task_id"].as_int() : 0,
+                         with_tc ? &hb_tc : nullptr));
     bus.publish(regions.topic_for(grid, my_pos), b);
     const int64_t now = mono_ms();
     if (now < legacy_until
@@ -343,6 +379,9 @@ int main(int argc, char** argv) {
         if (auto d = task_cell("delivery")) {
           my_goal = *d;
           task_state = TaskState::MovingToDelivery;
+          if (tctx && my_tc)
+            event_emit("task.pickup", &*my_tc,
+                       (*my_task)["task_id"].as_int(), my_id);
           log_info("📦 Reached PICKUP, heading to DELIVERY (%d, %d)\n",
                    grid.x_of(*d), grid.y_of(*d));
           publish_position();
@@ -354,6 +393,11 @@ int main(int argc, char** argv) {
         Json metric = publish_task_metric("task_metric_completed");
         Json done;
         done.set("status", "done").set("task_id", (*my_task)["task_id"]);
+        if (tctx && my_tc) {
+          event_emit("task.delivery", &*my_tc,
+                     (*my_task)["task_id"].as_int(), my_id);
+          done.set("tc", tc_json(my_tc_next()));
+        }
         bus.publish("mapd", done);
         log_info("✅ Task %lld DONE\n",
                  static_cast<long long>((*my_task)["task_id"].as_int()));
@@ -361,8 +405,10 @@ int main(int argc, char** argv) {
         unacked_done = done;
         unacked_done_metric = metric;
         unacked_done_id = (*my_task)["task_id"].as_int();
+        unacked_tc = my_tc;
         done_last_sent_ms = mono_ms();
         my_task.reset();
+        my_tc.reset();
         task_state = TaskState::Idle;
         // ADVICE r5: an outstanding exchange offered THIS task — now that
         // it completed locally the offer is moot.  Clearing it makes the
@@ -378,8 +424,15 @@ int main(int argc, char** argv) {
   // continues to the exact cell the old holder was heading to (what a
   // goal swap means under TSWAP), and positional arrive_check keeps
   // working because the task rides along with the goal.
-  auto adopt_task = [&](const Json& task, const std::string& phase) {
+  auto adopt_task = [&](const Json& task, const std::string& phase,
+                        const std::optional<codec::TraceCtx>& in_tc) {
     my_task = task;
+    // the trace follows the task to its new holder: the swap message's
+    // context wins, the Task's embedded dispatch context is the fallback
+    my_tc = in_tc ? in_tc : tc_parse(task);
+    if (my_tc)
+      event_emit("task.adopt", &*my_tc, task["task_id"].as_int(), my_id,
+                 in_tc ? in_tc->send_ms : -1);
     task_state = phase == "delivery" ? TaskState::MovingToDelivery
                                      : TaskState::MovingToPickup;
     auto c = task_cell(task_state == TaskState::MovingToDelivery
@@ -408,6 +461,11 @@ int main(int argc, char** argv) {
         .set("to_peer", peer)
         .set("task", *my_task)
         .set("phase", current_phase());
+    if (tctx && my_tc) {
+      req.set("tc", tc_json(my_tc_next()));
+      event_emit("task.swap_req", &*my_tc,
+                 (*my_task)["task_id"].as_int(), peer);
+    }
     bus.publish("mapd", req);
     pending_swap = PendingSwap{req_id, peer, now};
   };
@@ -524,6 +582,11 @@ int main(int argc, char** argv) {
         // (it was parked in the requester's way; now it has somewhere to
         // go) and replies taskless so the requester parks instead.
         if (d["to_peer"].as_str() != my_id) return;
+        auto req_tc = tc_parse(d);
+        if (req_tc)
+          event_emit("task.swap_recv", &*req_tc,
+                     d.has("task") ? d["task"]["task_id"].as_int() : -1,
+                     m.from, req_tc->send_ms);
         Json resp;
         resp.set("type", "swap_response")
             .set("request_id", d["request_id"])
@@ -560,15 +623,19 @@ int main(int argc, char** argv) {
             my_task && d.has("task")
             && (*my_task)["task_id"].as_int()
                    == d["task"]["task_id"].as_int();
-        if (my_task && !retransmit)
+        if (my_task && !retransmit) {
           resp.set("task", *my_task).set("phase", current_phase());
+          // the response hands MY task over: its context rides along
+          if (tctx && my_tc) resp.set("tc", tc_json(my_tc_next()));
+        }
         bus.publish("mapd", resp);
         if (retransmit) return;  // we already hold their copy: stand down
         if (d.has("task")) {
-          adopt_task(d["task"], d["phase"].as_str());
+          adopt_task(d["task"], d["phase"].as_str(), req_tc);
         } else if (my_task) {
           // gave mine away and got nothing back: park idle
           my_task.reset();
+          my_tc.reset();
           task_state = TaskState::Idle;
           my_goal = my_pos;
         }
@@ -589,6 +656,11 @@ int main(int argc, char** argv) {
           return;
         pending_swap.reset();
         if (d["declined"].as_bool()) return;  // busy peer: retry next tick
+        auto resp_tc = tc_parse(d);
+        if (resp_tc)
+          event_emit("task.swap_resp", &*resp_tc,
+                     d.has("task") ? d["task"]["task_id"].as_int() : -1,
+                     m.from, resp_tc->send_ms);
         if (d.has("task") && unacked_done
             && d["task"]["task_id"].as_int() == unacked_done_id) {
           // offered back a task we already completed: refuse it, heal by
@@ -596,28 +668,37 @@ int main(int argc, char** argv) {
           // sent (a response carrying a task means the exchange
           // committed on its side), so we park idle rather than keep a
           // double-held copy.
+          refresh_unacked_tc();
           bus.publish("mapd", unacked_done_metric);
           bus.publish("mapd", *unacked_done);
           done_last_sent_ms = mono_ms();
           my_task.reset();
+          my_tc.reset();
           task_state = TaskState::Idle;
           my_goal = my_pos;
           return;
         }
         if (d.has("task")) {
-          adopt_task(d["task"], d["phase"].as_str());
+          adopt_task(d["task"], d["phase"].as_str(), resp_tc);
         } else {
           // idle (or already-holding) responder absorbed the task
           my_task.reset();
+          my_tc.reset();
           task_state = TaskState::Idle;
           my_goal = my_pos;
         }
       } else if (type == "done_ack") {
         if (d["peer_id"].as_str() == my_id
             && d["task_id"].as_int() == unacked_done_id) {
+          if (auto t = tc_parse(d))
+            event_emit("task.done_ack", &*t, unacked_done_id, my_id,
+                       t->send_ms);
           unacked_done.reset();
+          unacked_tc.reset();
           unacked_done_id = -1;
         }
+      } else if (type == "flight_dump") {
+        bus.publish("mapd", flight_dump_answer("agent_decentralized", my_id));
       } else if (type.empty() && d.has("pickup") && d.has("delivery")) {
         // bare Task JSON addressed by embedded peer_id (ref :1149-1216)
         if (d["peer_id"].as_str() != my_id) return;
@@ -625,6 +706,7 @@ int main(int argc, char** argv) {
         if (unacked_done && tid == unacked_done_id) {
           // the manager re-sent a task we already completed (its done was
           // lost): refuse the duplicate and heal by retransmitting now
+          refresh_unacked_tc();
           bus.publish("mapd", unacked_done_metric);
           bus.publish("mapd", *unacked_done);
           done_last_sent_ms = mono_ms();
@@ -633,6 +715,9 @@ int main(int argc, char** argv) {
         if (my_task && (*my_task)["task_id"].as_int() == tid)
           return;  // duplicate delivery of the task we are working on
         my_task = d;
+        my_tc = tc_parse(d);
+        if (my_tc)
+          event_emit("task.claim", &*my_tc, tid, my_id, my_tc->send_ms);
         publish_task_metric("task_metric_received");
         if (auto p = task_cell("pickup")) {
           log_info("📦 [TASK RECEIVED] Task ID: %lld -> pickup (%d, %d)\n",
@@ -685,6 +770,7 @@ int main(int argc, char** argv) {
     if (unacked_done && now - done_last_sent_ms >= args.done_retry_ms) {
       log_info("🔁 retransmitting done for task %lld (no ack yet)\n",
                unacked_done_id);
+      refresh_unacked_tc();
       bus.publish("mapd", unacked_done_metric);
       bus.publish("mapd", *unacked_done);
       done_last_sent_ms = now;

@@ -46,6 +46,7 @@
 #include <vector>
 
 #include "../common/bus.hpp"  // unix_ms/mono_ms helpers
+#include "../common/events.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
@@ -125,6 +126,10 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
+  // flight recorder (ISSUE 5): the hub's black box records membership
+  // churn and slow-consumer actions — the fleet-side context for any
+  // incident blackbox.py reconstructs
+  events_init("busd");
 
   int listen_fd = tcp_listen(port, bind_addr);
   if (listen_fd < 0) {
@@ -168,6 +173,7 @@ int main(int argc, char** argv) {
     }
     if (c.out_bytes > queue_hard) {
       metrics_count("bus.slow_consumer_evictions");
+      event_emit("bus.slow_consumer_evict", nullptr, -1, c.peer_id);
       log_warn("🐌 evicting slow consumer fd=%d peer=%s (%zu bytes "
                "queued > %zu hard limit)\n", fd, c.peer_id.c_str(),
                c.out_bytes, queue_hard);
@@ -379,6 +385,7 @@ int main(int argc, char** argv) {
         const std::string& op = j["op"].as_str();
         if (op == "hello") {
           c.peer_id = j["peer_id"].as_str();
+          event_emit("bus.peer_joined", nullptr, -1, c.peer_id);
           for (const auto& cap : j["caps"].as_array())
             if (cap.as_str() == "relay1") c.fast = true;
           Json caps;
@@ -468,6 +475,7 @@ int main(int argc, char** argv) {
       auto it = clients.find(fd);
       if (it == clients.end()) continue;
       std::string peer = it->second->peer_id;
+      if (!peer.empty()) event_emit("bus.peer_left", nullptr, -1, peer);
       drop_subs(fd, *it->second);
       it->second->conn.close_fd();
       clients.erase(it);

@@ -1,0 +1,279 @@
+// Structured task-lifecycle events with cross-process causal context —
+// native mirror of p2p_distributed_tswap_tpu/obs/events.py (one schema,
+// one timeline tool: analysis/task_timeline.py merges every process's
+// .events.jsonl into per-task causal timelines).
+//
+// Each emitted event carries the trace context that rode the triggering
+// message (trace_id rooted at task creation, monotone hop counter, the
+// sender's wall-clock send_ms) and fans out to:
+//   1. the flight-recorder ring (common/flightrec.hpp) — ALWAYS on;
+//   2. hop_latency_ms{edge=...} registry histograms (clock-skew clamped,
+//      raw negatives counted as hop.clock_skew_events) whenever a
+//      send_ms rode in;
+//   3. with JG_TRACE=1 and the trace_id sampled in (JG_TRACE_SAMPLE,
+//      deterministic mod-997 residue — identical to the Python side so a
+//      task's whole multi-process timeline samples atomically): a
+//      write-through line in $JG_TRACE_DIR/<proc>-<pid>.events.jsonl and
+//      a Perfetto flow event in the span tracer.
+//
+// Wire helpers: tc_json / tc_parse move the JSON "tc":[id,hop,send_ms]
+// field; the packed codecs carry codec::TraceCtx natively (trace1).
+// JG_TRACE_CTX=0 is the kill switch: no context on the wire, no events.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+
+#include "flightrec.hpp"
+#include "json.hpp"
+#include "metrics.hpp"
+#include "plan_codec.hpp"
+#include "trace.hpp"
+
+namespace mapd {
+
+constexpr int kSampleMod = 997;  // prime, mirrored by obs/events.py
+constexpr double kHopClampMaxMs = 60000.0;
+
+inline bool trace_ctx_enabled() {
+  const char* v = getenv("JG_TRACE_CTX");
+  return !v || (*v && strcmp(v, "0") && strcmp(v, "false"));
+}
+
+inline double trace_sample_rate() {
+  const char* v = getenv("JG_TRACE_SAMPLE");
+  if (!v || !*v) return 1.0;
+  char* end = nullptr;
+  double r = strtod(v, &end);
+  return end == v ? 1.0 : r;
+}
+
+inline bool trace_sampled(int64_t trace_id) {
+  double rate = trace_sample_rate();
+  if (rate >= 1.0) return true;
+  if (rate <= 0.0) return false;
+  int64_t res = trace_id % kSampleMod;
+  if (res < 0) res += kSampleMod;
+  return res < static_cast<int64_t>(rate * kSampleMod);
+}
+
+inline int64_t events_now_ms() {
+  using namespace std::chrono;
+  return duration_cast<milliseconds>(system_clock::now().time_since_epoch())
+      .count();
+}
+
+// "tc":[trace_id, hop, send_ms] — stamped at build time (send side)
+inline Json tc_json(int64_t trace_id, uint32_t hop) {
+  Json a;
+  a.push_back(Json(trace_id));
+  a.push_back(Json(static_cast<int64_t>(hop)));
+  a.push_back(Json(events_now_ms()));
+  return a;
+}
+
+inline Json tc_json(const codec::TraceCtx& t) {
+  Json a;
+  a.push_back(Json(t.trace_id));
+  a.push_back(Json(static_cast<int64_t>(t.hop)));
+  a.push_back(Json(t.send_ms));
+  return a;
+}
+
+inline std::optional<codec::TraceCtx> tc_parse(const Json& d) {
+  if (!d.has("tc")) return std::nullopt;
+  const auto& arr = d["tc"].as_array();
+  if (arr.size() != 3) return std::nullopt;
+  codec::TraceCtx t;
+  t.trace_id = arr[0].as_int();
+  t.hop = static_cast<uint32_t>(arr[1].as_int());
+  t.send_ms = arr[2].as_int();
+  return t;
+}
+
+// Clock-skew-clamped one-way latency, recorded per edge (same clamp
+// discipline as the PR-1 task-metric derivations).
+inline double hop_latency_ms(int64_t send_ms, const std::string& edge) {
+  double raw = static_cast<double>(events_now_ms() - send_ms);
+  if (raw < 0) metrics_count("hop.clock_skew_events");
+  double lat = raw < 0 ? 0.0 : (raw > kHopClampMaxMs ? kHopClampMaxMs : raw);
+  if (!edge.empty())
+    metrics_observe("hop_latency_ms", lat, "edge=\"" + edge + "\"");
+  return lat;
+}
+
+class EventLog {
+ public:
+  static EventLog& instance() {
+    static EventLog e;
+    return e;
+  }
+
+  void init(const char* proc) { proc_ = proc; }
+
+  // One lifecycle event.  tc: the context that rode (or will ride) the
+  // wire, nullptr when none.  task_id < 0 / empty peer / send_ms < 0 are
+  // "absent".  send_ms is the TRIGGERING message's sender stamp —
+  // present exactly when this event is the receive side of a wire hop.
+  // JG_TRACE_CTX=0 kills the whole context subsystem: trace-correlated
+  // events (tc != nullptr) are suppressed on BOTH send and receive sides;
+  // context-free events (bus membership, crashes) still hit the ring.
+  void emit(const char* event, const codec::TraceCtx* tc,
+            long long task_id = -1, const std::string& peer = "",
+            int64_t send_ms = -1) {
+    if (tc && !trace_ctx_enabled()) return;
+    const int64_t ts = events_now_ms();
+    std::string line;
+    line.reserve(192);
+    line += "{\"ts_ms\":" + std::to_string(ts);
+    line += ",\"proc\":\"" + proc_ + "\"";
+    line += ",\"pid\":" + std::to_string(getpid());
+    line += ",\"event\":\"";
+    line += event;
+    line += "\"";
+    if (tc) {
+      line += ",\"trace_id\":" + std::to_string(tc->trace_id);
+      line += ",\"hop\":" + std::to_string(tc->hop);
+    }
+    if (task_id >= 0) line += ",\"task_id\":" + std::to_string(task_id);
+    if (!peer.empty()) {
+      line += ",\"peer\":\"";
+      for (char c : peer)
+        if (c != '"' && c != '\\' && static_cast<unsigned char>(c) >= 0x20)
+          line += c;
+      line += "\"";
+    }
+    double wire = -1.0;
+    if (send_ms >= 0) {
+      wire = hop_latency_ms(send_ms, event);
+      line += ",\"send_ms\":" + std::to_string(send_ms);
+      char buf[32];
+      snprintf(buf, sizeof(buf), ",\"wire_ms\":%.3f", wire);
+      line += buf;
+    }
+    line += "}";
+    flight_record(line);
+    metrics_count("events.emitted", 1,
+                  "event=\"" + std::string(event) + "\"");
+    if (!tc || !trace_enabled() || !trace_sampled(tc->trace_id)) return;
+    write_line(line);
+    // Perfetto flow: constant name/cat, id = trace_id (see obs/events.py)
+    char phase = 't';
+    const size_t n = strlen(event);
+    if (!strcmp(event, "task.dispatch") && tc->hop <= 1)
+      phase = 's';
+    else if (n >= 8 && !strcmp(event + n - 8, "done_ack"))
+      phase = 'f';
+    Tracer::instance().flow("task", tc->trace_id, phase,
+                            "\"step\":\"" + std::string(event) + "\"");
+  }
+
+  std::string events_path() const {
+    const char* dir = getenv("JG_TRACE_DIR");
+    std::string d = dir && *dir ? dir : "results/trace";
+    return d + "/" + proc_ + "-" + std::to_string(getpid()) +
+           ".events.jsonl";
+  }
+
+  ~EventLog() {
+    if (f_) fclose(f_);
+  }
+
+ private:
+  EventLog() = default;
+
+  void write_line(const std::string& line) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!f_) {
+      std::string path = events_path();
+      size_t slash = path.rfind('/');
+      if (slash != std::string::npos) {
+        std::string dir = path.substr(0, slash);
+        std::string cur;
+        for (size_t i = 0; i < dir.size(); ++i) {
+          cur += dir[i];
+          if (dir[i] == '/' || i + 1 == dir.size())
+            mkdir(cur.c_str(), 0755);  // EEXIST is fine
+        }
+      }
+      f_ = fopen(path.c_str(), "a");
+      if (!f_) return;
+    }
+    fprintf(f_, "%s\n", line.c_str());
+    fflush(f_);  // write-through: timelines must be live, rates are tiny
+  }
+
+  std::string proc_ = "cpp";
+  FILE* f_ = nullptr;
+  std::mutex mu_;
+};
+
+// Call once at process entry: names the event log AND installs the
+// flight-recorder dump triggers (they always travel together).
+inline void events_init(const char* proc) {
+  EventLog::instance().init(proc);
+  flightrec_install(proc);
+}
+
+// Per-task wire-hop ledger (one per manager): every SEND of a
+// task-lifecycle message advances the task's hop, incoming contexts
+// fast-forward it (max-merge), so hops stay monotone along the causal
+// chain even when the agent advanced it.  The map is BOUNDED by evicting
+// the oldest ids (they ascend, so begin() is the oldest, long-done task)
+// — entries are NOT erased at completion, because late duplicate dones
+// must keep advancing the same counter.
+class TaskHopLedger {
+ public:
+  explicit TaskHopLedger(int64_t epoch) : epoch_(epoch) {}
+
+  // context for the NEXT send referencing this task (hop advances)
+  codec::TraceCtx next(long long tid) {
+    while (hops_.size() > 8192 && hops_.begin()->first != tid)
+      hops_.erase(hops_.begin());
+    uint32_t& h = hops_[tid];
+    return codec::TraceCtx{epoch_ | tid, ++h, events_now_ms()};
+  }
+
+  // context at the CURRENT hop (local milestone events, not sends)
+  codec::TraceCtx current(long long tid) {
+    return codec::TraceCtx{epoch_ | tid, hops_[tid], events_now_ms()};
+  }
+
+  void seen(long long tid, const codec::TraceCtx& t) {
+    uint32_t& h = hops_[tid];
+    if (t.hop > h) h = t.hop;
+  }
+
+ private:
+  int64_t epoch_;
+  std::map<long long, uint32_t> hops_;
+};
+
+// The bus "flight_dump" answer every process publishes (ISSUE 5): dump
+// the ring, report the path — one schema, built in one place.
+inline Json flight_dump_answer(const char* proc,
+                               const std::string& peer_id) {
+  std::string path = FlightRec::instance().default_path();
+  bool ok = FlightRec::instance().dump("bus_request", path);
+  Json resp;
+  resp.set("type", "flight_dump_response")
+      .set("proc", proc)
+      .set("peer_id", peer_id)
+      .set("path", ok ? Json(path) : Json())
+      .set("events", static_cast<int64_t>(FlightRec::instance().size()));
+  return resp;
+}
+
+inline void event_emit(const char* event, const codec::TraceCtx* tc,
+                       long long task_id = -1, const std::string& peer = "",
+                       int64_t send_ms = -1) {
+  EventLog::instance().emit(event, tc, task_id, peer, send_ms);
+}
+
+}  // namespace mapd

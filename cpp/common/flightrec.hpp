@@ -1,0 +1,136 @@
+// Flight recorder — native mirror of p2p_distributed_tswap_tpu/obs/
+// flightrec.py: an ALWAYS-ON bounded ring of the newest structured
+// lifecycle events (pre-rendered JSON lines, so a dump is pure write()),
+// the fleet's black box for crashes/wedges/e2e failures.
+//
+// Dump triggers, same contract as the Python side:
+//   - SIGUSR2 (flightrec_install; SIGUSR1 stays the stats dump);
+//   - fatal signals (SIGSEGV/SIGABRT/SIGBUS/SIGFPE): best-effort dump,
+//     then the default action re-raised so the exit status stays honest;
+//   - process exit (static destructor, like the tracer's flush);
+//   - a bus "flight_dump" request (each main's handler calls dump()).
+//
+// Dumps land in $JG_FLIGHT_DIR (the fleet runner points this at its
+// per-run log dir) else $JG_TRACE_DIR else results/trace, as
+// <proc>-<pid>.flight.jsonl — meta line first, then events oldest-first.
+#pragma once
+
+#include <signal.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+namespace mapd {
+
+constexpr size_t kFlightCapacity = 4096;
+
+class FlightRec {
+ public:
+  static FlightRec& instance() {
+    static FlightRec r;
+    return r;
+  }
+
+  void init(const char* proc) { proc_ = proc; }
+  const std::string& proc() const { return proc_; }
+
+  // line: one rendered JSON object, no trailing newline (events.hpp
+  // renders; the ring stores strings so a crash dump never allocates)
+  void record(std::string line) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ring_.size() >= kFlightCapacity) ring_.pop_front();
+    ring_.push_back(std::move(line));
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return ring_.size();
+  }
+
+  std::string default_path() const {
+    const char* dir = getenv("JG_FLIGHT_DIR");
+    if (!dir || !*dir) dir = getenv("JG_TRACE_DIR");
+    std::string d = dir && *dir ? dir : "results/trace";
+    return d + "/" + proc_ + "-" + std::to_string(getpid()) +
+           ".flight.jsonl";
+  }
+
+  // Safe from fatal-signal handlers: try_lock only (a thread crashed
+  // mid-record must not deadlock the dump; reading the deque unlocked in
+  // that one doomed-process case is an accepted best-effort risk).
+  bool dump(const char* reason, const std::string& path_override = "") {
+    const bool locked = mu_.try_lock();
+    std::string path = path_override.empty() ? default_path() : path_override;
+    size_t slash = path.rfind('/');
+    if (slash != std::string::npos) mkdirs(path.substr(0, slash));
+    FILE* f = fopen(path.c_str(), "w");
+    bool ok = false;
+    if (f) {
+      fprintf(f,
+              "{\"meta\":\"flight\",\"proc\":\"%s\",\"pid\":%d,"
+              "\"reason\":\"%s\",\"events\":%zu}\n",
+              proc_.c_str(), getpid(), reason, ring_.size());
+      for (const auto& line : ring_) fprintf(f, "%s\n", line.c_str());
+      fclose(f);
+      ok = true;
+    }
+    if (locked) mu_.unlock();
+    return ok;
+  }
+
+  ~FlightRec() { dump("exit"); }
+
+ private:
+  FlightRec() = default;
+
+  static void mkdirs(const std::string& dir) {
+    std::string cur;
+    for (size_t i = 0; i < dir.size(); ++i) {
+      cur += dir[i];
+      if (dir[i] == '/' || i + 1 == dir.size())
+        mkdir(cur.c_str(), 0755);  // EEXIST is fine
+    }
+  }
+
+  std::string proc_ = "cpp";
+  std::deque<std::string> ring_;
+  std::mutex mu_;
+};
+
+namespace flight_detail {
+inline void fatal_handler(int sig) {
+  FlightRec::instance().dump("fatal_signal");
+  signal(sig, SIG_DFL);
+  raise(sig);
+}
+inline void usr2_handler(int) { FlightRec::instance().dump("sigusr2"); }
+}  // namespace flight_detail
+
+// Call once at process entry (after the role name is known).  Arms
+// SIGUSR2 + fatal-signal dumps; the exit dump rides the static
+// destructor either way.
+inline void flightrec_install(const char* proc) {
+  FlightRec::instance().init(proc);
+  signal(SIGUSR2, flight_detail::usr2_handler);
+  signal(SIGSEGV, flight_detail::fatal_handler);
+  signal(SIGABRT, flight_detail::fatal_handler);
+  signal(SIGBUS, flight_detail::fatal_handler);
+  signal(SIGFPE, flight_detail::fatal_handler);
+}
+
+inline void flight_record(std::string line) {
+  FlightRec::instance().record(std::move(line));
+}
+
+inline bool flight_dump(const char* reason = "manual") {
+  return FlightRec::instance().dump(reason);
+}
+
+}  // namespace mapd

@@ -38,12 +38,24 @@ constexpr uint8_t kResponse = 3;
 constexpr const char* kCodecName = "packed1";
 constexpr int kSnapshotEvery = 64;
 
+// Compact per-message causal context (ISSUE 5 "trace1"): trace_id is
+// rooted where the traced object was created, hop counts wire crossings
+// monotonically, send_ms is the sender's unix wall clock at publish time.
+// Mirrors plan_codec.py TraceCtx; 20 bytes on the wire (i64, i64, u32).
+struct TraceCtx {
+  int64_t trace_id = 0;
+  uint32_t hop = 0;
+  int64_t send_ms = 0;
+};
+
 struct Packet {
   uint8_t kind = 0;
   int64_t seq = 0;
   int64_t base_seq = 0;
   std::vector<int32_t> idx, pos, goal, removed, named_idx;
   std::vector<std::string> names;
+  bool has_trace = false;
+  TraceCtx trace;
 };
 
 // ---------- base64 (standard alphabet, '=' padding) ----------
@@ -148,6 +160,24 @@ inline int64_t get_i64(const uint8_t* p) {
 // flags bit 0: narrow — arrays are u16, not i32 (auto-chosen when every
 // value < 65536: any grid up to 256x256, fleets up to 64k lanes)
 constexpr uint8_t kFlagNarrow = 1;
+// flags bit 1: a 20-byte trace-context block follows the header (trace1)
+constexpr uint8_t kFlagTrace = 2;
+constexpr size_t kTraceExtLen = 20;  // i64 trace_id, i64 send_ms, u32 hop
+
+namespace detail {
+inline void put_trace(std::string& b, const TraceCtx& t) {
+  put_i64(b, t.trace_id);
+  put_i64(b, t.send_ms);
+  put_u32(b, t.hop);
+}
+inline TraceCtx get_trace(const uint8_t* p) {
+  TraceCtx t;
+  t.trace_id = get_i64(p);
+  t.send_ms = get_i64(p + 8);
+  t.hop = get_u32(p + 16);
+  return t;
+}
+}  // namespace detail
 
 inline std::string encode(const Packet& p) {
   std::string blob;
@@ -167,13 +197,15 @@ inline std::string encode(const Packet& p) {
   detail::put_u32(out, kMagic);
   detail::put_u16(out, kVersion);
   out += static_cast<char>(p.kind);
-  out += static_cast<char>(narrow ? kFlagNarrow : 0);
+  out += static_cast<char>((narrow ? kFlagNarrow : 0) |
+                           (p.has_trace ? kFlagTrace : 0));
   detail::put_i64(out, p.seq);
   detail::put_i64(out, p.base_seq);
   detail::put_u32(out, static_cast<uint32_t>(p.idx.size()));
   detail::put_u32(out, static_cast<uint32_t>(p.removed.size()));
   detail::put_u32(out, static_cast<uint32_t>(p.named_idx.size()));
   detail::put_u32(out, static_cast<uint32_t>(blob.size()));
+  if (p.has_trace) detail::put_trace(out, p.trace);
   auto put = [&](const std::vector<int32_t>& v) {
     if (narrow)
       for (int32_t x : v) detail::put_u16(out, static_cast<uint16_t>(x));
@@ -198,17 +230,20 @@ inline std::optional<Packet> decode(const std::string& buf) {
   Packet p;
   p.kind = b[6];
   const bool narrow = (b[7] & kFlagNarrow) != 0;
+  p.has_trace = (b[7] & kFlagTrace) != 0;
   const size_t width = narrow ? 2 : 4;
+  const size_t trace_len = p.has_trace ? kTraceExtLen : 0;
   p.seq = detail::get_i64(b + 8);
   p.base_seq = detail::get_i64(b + 16);
   uint32_t n_entries = detail::get_u32(b + 24);
   uint32_t n_removed = detail::get_u32(b + 28);
   uint32_t n_named = detail::get_u32(b + 32);
   uint32_t names_len = detail::get_u32(b + 36);
-  uint64_t need = 40 +
+  uint64_t need = 40 + trace_len +
       width * (3ull * n_entries + n_removed + n_named) + names_len;
   if (buf.size() != need) return std::nullopt;
-  const uint8_t* q = b + 40;
+  if (p.has_trace) p.trace = detail::get_trace(b + 40);
+  const uint8_t* q = b + 40 + trace_len;
   auto take = [&](std::vector<int32_t>& v, uint32_t n) {
     v.resize(n);
     for (uint32_t k = 0; k < n; ++k, q += width)
@@ -377,23 +412,28 @@ constexpr uint32_t kPos1Magic = 0x31534F50;  // b"POS1"
 constexpr uint8_t kPos1Version = 1;
 constexpr uint8_t kPos1FlagNarrow = 1;
 constexpr uint8_t kPos1FlagTask = 2;
+constexpr uint8_t kPos1FlagTrace = 4;  // trailing 20-byte trace1 block
 
 struct Pos1 {
   int32_t pos = 0;
   int32_t goal = 0;
   bool has_task = false;
   int64_t task_id = 0;
+  bool has_trace = false;
+  TraceCtx trace;
 };
 
 inline std::string encode_pos1(int32_t pos, int32_t goal,
-                               bool has_task = false, int64_t task_id = 0) {
+                               bool has_task = false, int64_t task_id = 0,
+                               const TraceCtx* trace = nullptr) {
   const bool narrow = pos >= 0 && pos < 65536 && goal >= 0 && goal < 65536;
   std::string out;
-  out.reserve(24);
+  out.reserve(44);
   detail::put_u32(out, kPos1Magic);
   out += static_cast<char>(kPos1Version);
   out += static_cast<char>((narrow ? kPos1FlagNarrow : 0) |
-                           (has_task ? kPos1FlagTask : 0));
+                           (has_task ? kPos1FlagTask : 0) |
+                           (trace ? kPos1FlagTrace : 0));
   detail::put_u16(out, 0);  // reserved
   if (narrow) {
     detail::put_u16(out, static_cast<uint16_t>(pos));
@@ -403,6 +443,7 @@ inline std::string encode_pos1(int32_t pos, int32_t goal,
     detail::put_u32(out, static_cast<uint32_t>(goal));
   }
   if (has_task) detail::put_i64(out, task_id);
+  if (trace) detail::put_trace(out, *trace);
   return out;
 }
 
@@ -415,7 +456,9 @@ inline std::optional<Pos1> decode_pos1(const std::string& buf) {
   const bool narrow = (flags & kPos1FlagNarrow) != 0;
   Pos1 p;
   p.has_task = (flags & kPos1FlagTask) != 0;
-  const size_t need = 8 + (narrow ? 4 : 8) + (p.has_task ? 8 : 0);
+  p.has_trace = (flags & kPos1FlagTrace) != 0;
+  const size_t need = 8 + (narrow ? 4 : 8) + (p.has_task ? 8 : 0) +
+                      (p.has_trace ? kTraceExtLen : 0);
   if (buf.size() != need) return std::nullopt;
   if (narrow) {
     p.pos = static_cast<int32_t>(b[8] | (b[9] << 8));
@@ -424,14 +467,20 @@ inline std::optional<Pos1> decode_pos1(const std::string& buf) {
     p.pos = static_cast<int32_t>(detail::get_u32(b + 8));
     p.goal = static_cast<int32_t>(detail::get_u32(b + 12));
   }
-  if (p.has_task) p.task_id = detail::get_i64(b + need - 8);
+  size_t off = 8 + (narrow ? 4u : 8u);
+  if (p.has_task) {
+    p.task_id = detail::get_i64(b + off);
+    off += 8;
+  }
+  if (p.has_trace) p.trace = detail::get_trace(b + off);
   return p;
 }
 
 inline std::string encode_pos1_b64(int32_t pos, int32_t goal,
                                    bool has_task = false,
-                                   int64_t task_id = 0) {
-  return b64_encode(encode_pos1(pos, goal, has_task, task_id));
+                                   int64_t task_id = 0,
+                                   const TraceCtx* trace = nullptr) {
+  return b64_encode(encode_pos1(pos, goal, has_task, task_id, trace));
 }
 
 inline std::optional<Pos1> decode_pos1_b64(const std::string& data) {

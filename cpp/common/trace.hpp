@@ -39,9 +39,10 @@ constexpr size_t TRACE_CAPACITY = 65536;
 
 struct TraceEvent {
   std::string name;
-  char ph = 'X';       // 'X' complete span, 'i' instant, 'C' counter
+  char ph = 'X';       // 'X' span, 'i' instant, 'C' counter, 's'/'t'/'f' flow
   int64_t ts_us = 0;   // wall-anchored microseconds
   int64_t dur_us = 0;  // 'X' only
+  int64_t flow_id = -1;  // 's'/'t'/'f' only: the Perfetto flow-link id
   std::string parent;  // enclosing span name, "" at top level
   std::string args_json;  // extra args as a JSON fragment ("\"k\":1"), or ""
 };
@@ -79,6 +80,22 @@ class Tracer {
     ev.name = name;
     ev.ph = 'i';
     ev.ts_us = now_us();
+    ev.args_json = args_json;
+    emit(std::move(ev));
+  }
+
+  // Chrome flow event ('s' start / 't' step / 'f' end): events sharing
+  // (cat, name, id) link into cross-process arrows on the merged
+  // timeline — the native side of obs/trace.py Tracer.flow (ISSUE 5).
+  void flow(const std::string& name, int64_t id, char phase,
+            const std::string& args_json = "") {
+    if (!enabled_) return;
+    if (phase != 's' && phase != 't' && phase != 'f') return;
+    TraceEvent ev;
+    ev.name = name;
+    ev.ph = phase;
+    ev.ts_us = now_us();
+    ev.flow_id = id & INT64_C(0x7FFFFFFFFFFFFFFF);  // Chrome ids: unsigned
     ev.args_json = args_json;
     emit(std::move(ev));
   }
@@ -139,6 +156,11 @@ class Tracer {
       if (ev.ph == 'X')
         fprintf(f, "\"dur\":%lld,", static_cast<long long>(ev.dur_us));
       if (ev.ph == 'i') fprintf(f, "\"s\":\"p\",");
+      if (ev.ph == 's' || ev.ph == 't' || ev.ph == 'f') {
+        fprintf(f, "\"cat\":\"task\",\"id\":%lld,",
+                static_cast<long long>(ev.flow_id));
+        if (ev.ph != 's') fprintf(f, "\"bp\":\"e\",");
+      }
       fprintf(f, "\"pid\":%d,\"tid\":1,\"args\":{", getpid());
       bool first = true;
       if (!ev.parent.empty()) {

@@ -37,6 +37,7 @@
 #include <vector>
 
 #include "../common/bus.hpp"
+#include "../common/events.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
@@ -119,6 +120,15 @@ int main(int argc, char** argv) {
   // span tracing (JG_TRACE=1 or --trace): same schema as the Python
   // tracer; analysis/trace_report.py merges this file with solverd's
   trace_init("manager_centralized", knobs.get_bool("--trace", nullptr));
+  // lifecycle events + flight recorder (ISSUE 5): always-on black box,
+  // trace-context propagation gated by JG_TRACE_CTX
+  events_init("manager_centralized");
+  const bool tctx = trace_ctx_enabled();
+  // trace_id = run-epoch | task_id: the epoch salt keeps ids unique
+  // across manager restarts sharing one log dir (task ids restart at 1).
+  // 20 epoch bits keep every id under 2^53 — the JSON wire carries
+  // numbers as doubles, and a larger id would round on the way through
+  const int64_t trace_epoch = (unix_ms() & 0xFFFFF) << 32;
 
   Grid grid = Grid::default_grid();
   if (!map_file.empty()) {
@@ -167,6 +177,9 @@ int main(int argc, char** argv) {
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
   int64_t plan_seq = 0;
+  // per-task wire-hop ledger (common/events.hpp: send advances, receive
+  // max-merges, bounded by oldest-id eviction)
+  TaskHopLedger hops(trace_epoch);
 
   auto free_cells = grid.free_cells();
   auto gen_point = [&]() { return free_cells[rng() % free_cells.size()]; };
@@ -203,6 +216,17 @@ int main(int argc, char** argv) {
   // in its idle window instead of stalling the tick it goes live.
   std::vector<int32_t> plan_hints;
 
+  // hop 0 = task creation: the trace root every later hop counts from
+  auto queue_task = [&]() {
+    Json t = make_task();
+    long long id = t["task_id"].as_int();
+    if (tctx) {
+      codec::TraceCtx t0{trace_epoch | id, 0, unix_ms()};
+      event_emit("task.queue", &t0, id);
+    }
+    pending_tasks.push_back(std::move(t));
+  };
+
   auto assign_task = [&](const std::string& peer, Json task) {
     task.set("peer_id", peer);
     uint64_t id = static_cast<uint64_t>(task["task_id"].as_int());
@@ -220,6 +244,12 @@ int main(int argc, char** argv) {
       if (auto dl = parse_point(task["delivery"]))
         if (plan_hints.size() < 4096)
           plan_hints.push_back(static_cast<int32_t>(*dl));
+    if (tctx) {
+      auto t = hops.next(static_cast<long long>(id));
+      task.set("tc", tc_json(t));
+      a.task = task;  // the stored copy carries the context for re-sends
+      event_emit("task.dispatch", &t, static_cast<long long>(id), peer);
+    }
     bus.publish("mapd", task);
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
              peer.c_str());
@@ -237,6 +267,10 @@ int main(int argc, char** argv) {
     log_info("♻️  %s %s, re-queueing task %lld\n", why, peer.c_str(), id);
     t.set("peer_id", Json());
     requeued_ids.insert(id);  // at-least-once: dedupe a late done (see below)
+    if (tctx) {
+      codec::TraceCtx t0 = hops.current(id);
+      event_emit("task.requeue", &t0, id, peer);
+    }
     pending_tasks.push_front(std::move(t));
   };
 
@@ -268,6 +302,13 @@ int main(int argc, char** argv) {
           .set("peer_id", ids[k])
           .set("next_pos", point_json(next[k]))
           .set("timestamp", unix_ms());
+      // the steered agent's task context rides its instructions, so the
+      // execution leg correlates on the receive side (task.exec)
+      if (tctx && it->second.task) {
+        long long tid = (*it->second.task)["task_id"].as_int();
+        auto t = hops.next(tid);
+        mi.set("tc", tc_json(t));
+      }
       bus.publish("mapd", mi);
       trace_count("manager.moves_emitted");
     }
@@ -330,6 +371,10 @@ int main(int argc, char** argv) {
       w.set("type", "task_withdrawn")
           .set("task_id", task["task_id"])
           .set("peer_id", peer);
+      if (tctx) {
+        auto t = hops.next(task["task_id"].as_int());
+        w.set("tc", tc_json(t));
+      }
       bus.publish("mapd", w);
       trace_count("manager.goal_exchanges");
       log_info("🔁 task %lld exchanged away from %s\n",
@@ -366,6 +411,12 @@ int main(int argc, char** argv) {
               a.phase == Phase::ToDelivery ? "delivery" : "pickup"]);
           if (cell) a.goal = *cell;
           a.dispatched_ms = mono_ms();
+          if (tctx) {
+            long long tid = (*a.task)["task_id"].as_int();
+            auto t = hops.next(tid);
+            a.task->set("tc", tc_json(t));
+            event_emit("task.exchange", &t, tid, ids[k]);
+          }
           bus.publish("mapd", *a.task);  // the re-assignment, on the wire
           log_info("🔁 task %lld exchanged to %s\n",
                    (*a.task)["task_id"].as_int(), ids[k].c_str());
@@ -388,6 +439,13 @@ int main(int argc, char** argv) {
           if (auto dl = parse_point((*a.task)["delivery"])) {
             a.goal = *dl;
             a.phase = Phase::ToDelivery;
+            if (tctx) {
+              // centralized mode: the MANAGER knows the pickup flip (the
+              // agent is a dumb body) — the pickup hop comes from here
+              long long tid = (*a.task)["task_id"].as_int();
+              codec::TraceCtx t0 = hops.current(tid);
+              event_emit("task.pickup", &t0, tid, peer);
+            }
             log_info("📍 %s reached pickup, now -> delivery\n", peer.c_str());
           }
         }
@@ -449,6 +507,14 @@ int main(int argc, char** argv) {
                            static_cast<int32_t>(a.goal));
       if (fleet.empty()) return;
       codec::Packet pkt = plan_enc.encode_tick(++plan_seq, fleet);
+      if (tctx) {
+        // plan-chain trace: its own id namespace (bit 31 salt) so plan
+        // frames never collide with task traces in the timeline
+        pkt.has_trace = true;
+        pkt.trace = codec::TraceCtx{
+            trace_epoch | 0x80000000LL | (plan_seq & 0x7FFFFFFF), 1,
+            unix_ms()};
+      }
       if (pkt.kind == codec::kSnapshot)
         metrics_count("manager.plan_snapshots");
       else
@@ -458,6 +524,8 @@ int main(int argc, char** argv) {
                       static_cast<double>(pkt.idx.size()));
       Json caps;
       caps.push_back(Json(codec::kCodecName));
+      // trace1 cap: this peer reads trace blocks on packed responses
+      if (tctx) caps.push_back(Json("trace1"));
       Json req;
       req.set("type", "plan_request")
           .set("seq", plan_seq)
@@ -488,6 +556,9 @@ int main(int argc, char** argv) {
     }
     if (arr.is_null()) return;
     req.set("type", "plan_request").set("seq", ++plan_seq).set("agents", arr);
+    if (tctx)
+      req.set("tc", tc_json(trace_epoch | 0x80000000LL |
+                                (plan_seq & 0x7FFFFFFF), 1));
     sent_goals = std::move(snap);
     plan_sent_ms = mono_ms();
     bus.publish("solver", req);
@@ -497,6 +568,11 @@ int main(int argc, char** argv) {
   bool failed_over = false;
 
   auto handle_plan_response = [&](const Json& d) {
+    // one-way solverd->manager latency (trace ctx echoed by the daemon;
+    // JSON wire carries "tc", the packed response its trace1 block)
+    if (auto t = tc_parse(d))
+      event_emit("plan.response", &*t, d["seq"].as_int(), "solverd",
+                 t->send_ms);
     if (d["seq"].as_int() != plan_seq) {
       trace_count("manager.stale_plan_responses");
       return;  // stale tick
@@ -533,6 +609,9 @@ int main(int argc, char** argv) {
         metrics_count("manager.bad_plan_packets");
         return;
       }
+      if (pkt->has_trace)
+        event_emit("plan.response", &pkt->trace, d["seq"].as_int(),
+                   "solverd", pkt->trace.send_ms);
       const Cell cells = static_cast<Cell>(grid.width * grid.height);
       for (size_t k = 0; k < pkt->idx.size(); ++k) {
         Cell np = static_cast<Cell>(pkt->pos[k]);
@@ -594,13 +673,13 @@ int main(int argc, char** argv) {
     in >> cmd;
     if (cmd == "quit" || cmd == "exit") return false;
     if (cmd == "task") {
-      pending_tasks.push_back(make_task());
+      queue_task();
       try_assign_pending();
     } else if (cmd == "tasks") {
       size_t n = 0;
       in >> n;
       if (!n) n = agents.size();
-      for (size_t k = 0; k < n; ++k) pending_tasks.push_back(make_task());
+      for (size_t k = 0; k < n; ++k) queue_task();
       try_assign_pending();
       log_info("📦 queued %zu tasks (%zu pending)\n", n, pending_tasks.size());
     } else if (cmd == "metrics") {
@@ -688,11 +767,18 @@ int main(int argc, char** argv) {
                 p = p1->pos;
               has_busy = p1->has_task;
               busy_tid = p1->task_id;
+              // busy-claim heartbeats carry their task's trace1 block:
+              // per-hop one-way latency only (no event — beacon rate)
+              if (tctx && p1->has_trace)
+                hop_latency_ms(p1->trace.send_ms, "task.claim_hb");
             } else {
               peer = d["peer_id"].as_str();
               p = parse_point(d["position"]);
               has_busy = d.has("busy_task");
               busy_tid = d["busy_task"].as_int();
+              if (tctx)
+                if (auto t = tc_parse(d))
+                  hop_latency_ms(t->send_ms, "task.claim_hb");
             }
             if (clean && known_left.count(peer)) return;
             if (!p) return;
@@ -727,6 +813,12 @@ int main(int argc, char** argv) {
                          "re-sending\n", peer.c_str(),
                          static_cast<long long>(
                              (*a.task)["task_id"].as_int()));
+                if (tctx) {
+                  long long tid = (*a.task)["task_id"].as_int();
+                  auto t = hops.next(tid);
+                  a.task->set("tc", tc_json(t));
+                  event_emit("task.resend", &t, tid, peer);
+                }
                 bus.publish("mapd", *a.task);
                 a.dispatched_ms = mono_ms();
               }
@@ -758,14 +850,28 @@ int main(int argc, char** argv) {
               if (auto t = itm->second.total_time())
                 metrics_observe("task.total_time_ms",
                                 static_cast<double>(*t));
+          } else if (type == "flight_dump") {
+            // black-box query: dump the ring and answer with the path
+            bus.publish("mapd",
+                        flight_dump_answer("manager_centralized", my_id));
           } else if (d["status"].as_str() == "done") {
             const std::string& peer = m.from;
             const long long tid = d["task_id"].as_int();
+            auto done_tc = tc_parse(d);
+            if (done_tc) {
+              hops.seen(tid, *done_tc);
+              event_emit("task.done", &*done_tc, tid, peer,
+                         done_tc->send_ms);
+            }
             // ack unconditionally: agents retransmit done until acked, and
             // a duplicate (its ack was lost) must still be acked
             Json ack;
             ack.set("type", "done_ack").set("peer_id", peer)
                 .set("task_id", Json(static_cast<int64_t>(tid)));
+            if (tctx && done_tc) {
+              auto t = hops.next(tid);
+              ack.set("tc", tc_json(t));
+            }
             bus.publish("mapd", ack);
             auto it = agents.find(peer);
             if (it != agents.end() && it->second.task

@@ -32,6 +32,7 @@
 #include <deque>
 
 #include "../common/bus.hpp"
+#include "../common/events.hpp"
 #include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
@@ -88,6 +89,13 @@ int main(int argc, char** argv) {
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
+  // lifecycle events + flight recorder (ISSUE 5); trace-context
+  // propagation gated by JG_TRACE_CTX
+  events_init("manager_decentralized");
+  const bool tctx = trace_ctx_enabled();
+  // trace_id = run-epoch | task_id (unique across manager restarts);
+  // 20 epoch bits keep ids under 2^53 (the JSON wire rounds past that)
+  const int64_t trace_epoch = (unix_ms() & 0xFFFFF) << 32;
 
   Grid grid = Grid::default_grid();
   if (!map_file.empty()) {
@@ -162,6 +170,9 @@ int main(int argc, char** argv) {
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   uint64_t next_task_id = 1;
+  // per-task wire-hop ledger (common/events.hpp: send advances, receive
+  // max-merges, bounded by oldest-id eviction)
+  TaskHopLedger hops(trace_epoch);
 
   auto free_cells = grid.free_cells();
   auto gen_point = [&]() { return free_cells[rng() % free_cells.size()]; };
@@ -169,6 +180,11 @@ int main(int argc, char** argv) {
   auto dispatch_task = [&](const std::string& peer, Json t) {
     uint64_t id = static_cast<uint64_t>(t["task_id"].as_int());
     t.set("peer_id", peer);
+    if (tctx) {
+      auto tcx = hops.next(static_cast<long long>(id));
+      t.set("tc", tc_json(tcx));  // stored copies carry it for re-sends
+      event_emit("task.dispatch", &tcx, static_cast<long long>(id), peer);
+    }
     TaskMetric m;
     m.task_id = id;
     m.peer_id = peer;
@@ -195,6 +211,13 @@ int main(int argc, char** argv) {
     dl.push_back(Json(grid.y_of(delivery)));
     t.set("pickup", pk).set("delivery", dl).set("peer_id", peer)
         .set("task_id", next_task_id++);
+    if (tctx) {
+      // hop 0 = creation: the trace root (dispatch is hop 1, a breath
+      // later — decentralized tasks are born assigned)
+      long long id = t["task_id"].as_int();
+      codec::TraceCtx t0{trace_epoch | id, 0, unix_ms()};
+      event_emit("task.queue", &t0, id, peer);
+    }
     dispatch_task(peer, std::move(t));
   };
 
@@ -310,7 +333,15 @@ int main(int argc, char** argv) {
   // reconciliation + the busy-claim ledger.
   auto handle_heartbeat = [&](const std::string& peer,
                               std::optional<Cell> cell, bool has_busy,
-                              long long busy_tid) {
+                              long long busy_tid,
+                              const std::optional<codec::TraceCtx>& hb_tc
+                              = std::nullopt) {
+    // busy-claim heartbeats carry their task's trace context: per-hop
+    // one-way latency (no event — beacon rate), hop max-merge
+    if (tctx && hb_tc) {
+      hop_latency_ms(hb_tc->send_ms, "task.claim_hb");
+      hops.seen(busy_tid, *hb_tc);
+    }
     if (cell) peer_positions[peer] = *cell;
     subscribed_peers.insert(peer);
     peer_last_seen[peer] = mono_ms();
@@ -337,6 +368,11 @@ int main(int argc, char** argv) {
             && now - since->second > task_resend_ms) {
           log_info("↻ %s reports idle but task %lld is in flight; "
                    "re-sending\n", peer.c_str(), btid);
+          if (tctx) {
+            auto t = hops.next(btid);
+            busy->second.set("tc", tc_json(t));
+            event_emit("task.resend", &t, btid, peer);
+          }
           bus.publish("mapd", busy->second);
           since->second = now;
         }
@@ -378,6 +414,10 @@ int main(int argc, char** argv) {
           log_info("🔁 %s now carries task %lld (peer-side "
                    "exchange); bookkeeping follows\n",
                    peer.c_str(), ctid);
+          if (tctx) {
+            codec::TraceCtx t0 = hb_tc ? *hb_tc : hops.current(ctid);
+            event_emit("task.exchange", &t0, ctid, peer);
+          }
           // the previous holder's entry is stale: drop it so the
           // idle-resend cannot hand the task back out twice
           for (auto b = peer_busy.begin(); b != peer_busy.end();)
@@ -441,7 +481,7 @@ int main(int argc, char** argv) {
               if (grid.in_bounds(x, y)) cell = grid.cell(x, y);
             }
             handle_heartbeat(peer, cell, d.has("busy_task"),
-                             d["busy_task"].as_int());
+                             d["busy_task"].as_int(), tc_parse(d));
           } else if (type == "pos1") {
             // packed region beacon (wildcard subscription): the same
             // heartbeat, ~4x fewer wire bytes, addressed by bus `from`
@@ -451,7 +491,10 @@ int main(int argc, char** argv) {
             if (p1->pos >= 0 &&
                 p1->pos < static_cast<Cell>(grid.free.size()))
               cell = p1->pos;
-            handle_heartbeat(m.from, cell, p1->has_task, p1->task_id);
+            handle_heartbeat(
+                m.from, cell, p1->has_task, p1->task_id,
+                p1->has_trace ? std::optional<codec::TraceCtx>(p1->trace)
+                              : std::nullopt);
           } else if (type == "occupied_request") {
             // manager answers with ALL known positions (ref :441-468)
             Json occ;
@@ -488,14 +531,28 @@ int main(int argc, char** argv) {
           } else if (type == "path_metric") {
             path_metrics.record_micros(d["duration_micros"].as_int(),
                                        d["timestamp_ms"].as_int());
+          } else if (type == "flight_dump") {
+            // black-box query: dump the ring and answer with the path
+            bus.publish(
+                "mapd", flight_dump_answer("manager_decentralized", my_id));
           } else if (d["status"].as_str() == "done") {
             const std::string& peer = m.from;
             const long long tid = d["task_id"].as_int();
+            auto done_tc = tc_parse(d);
+            if (done_tc) {
+              hops.seen(tid, *done_tc);
+              event_emit("task.done", &*done_tc, tid, peer,
+                         done_tc->send_ms);
+            }
             // ack unconditionally: agents retransmit done until acked, and
             // a duplicate (its ack was lost) must still be acked
             Json ack;
             ack.set("type", "done_ack").set("peer_id", peer)
                 .set("task_id", Json(static_cast<int64_t>(tid)));
+            if (tctx && done_tc) {
+              auto t = hops.next(tid);
+              ack.set("tc", tc_json(t));
+            }
             bus.publish("mapd", ack);
             if (completed_ids.count(tid)) {
               // retransmit of an already-processed done, or the second
@@ -573,6 +630,11 @@ int main(int argc, char** argv) {
                        "re-queueing\n", peer.c_str(),
                        static_cast<long long>(
                        busy->second["task_id"].as_int()));
+              if (tctx) {
+                long long tid = busy->second["task_id"].as_int();
+                codec::TraceCtx t0 = hops.current(tid);
+                event_emit("task.requeue", &t0, tid, peer);
+              }
               requeue.push_back(std::move(busy->second));
               peer_busy.erase(busy);
               busy_since.erase(peer);
@@ -617,6 +679,11 @@ int main(int argc, char** argv) {
                    static_cast<long long>(now - it->second),
                    static_cast<long long>(
                        busy->second["task_id"].as_int()));
+          if (tctx) {
+            long long tid = busy->second["task_id"].as_int();
+            codec::TraceCtx t0 = hops.current(tid);
+            event_emit("task.requeue", &t0, tid, peer);
+          }
           requeue.push_back(std::move(busy->second));
           peer_busy.erase(busy);
           busy_since.erase(peer);
@@ -653,6 +720,10 @@ int main(int argc, char** argv) {
           log_info("♻️  task %lld unclaimed by any peer for %lld ms, "
                    "re-queueing\n", tid,
                    static_cast<long long>(now - claimed_ms));
+          if (tctx) {
+            codec::TraceCtx t0 = hops.current(tid);
+            event_emit("task.requeue", &t0, tid);
+          }
           requeue.push_back(inf->second);
           for (auto b = peer_busy.begin(); b != peer_busy.end(); ++b)
             if (b->second["task_id"].as_int() == tid) {

@@ -33,6 +33,26 @@ static Json i32_array(const std::vector<int32_t>& v) {
   return a;
 }
 
+// trace1 context rides scripts/outputs as "trace":[id,hop,send_ms]
+static bool parse_trace(const Json& j, codec::TraceCtx* out) {
+  if (!j.has("trace")) return false;
+  const auto& arr = j["trace"].as_array();
+  if (arr.size() != 3) return false;
+  out->trace_id = arr[0].as_int();
+  out->hop = static_cast<uint32_t>(arr[1].as_int());
+  out->send_ms = arr[2].as_int();
+  return true;
+}
+
+static Json trace_json(bool has, const codec::TraceCtx& t) {
+  if (!has) return Json();
+  Json a;
+  a.push_back(Json(t.trace_id));
+  a.push_back(Json(static_cast<int64_t>(t.hop)));
+  a.push_back(Json(t.send_ms));
+  return a;
+}
+
 int main(int argc, char** argv) {
   const std::string mode = argc > 1 ? argv[1] : "";
   if (mode != "--encode" && mode != "--decode" && mode != "--pos1-encode" &&
@@ -54,11 +74,13 @@ int main(int argc, char** argv) {
         return 1;
       }
       const Json& j = *parsed;
+      codec::TraceCtx tc;
+      const bool has_tc = parse_trace(j, &tc);
       printf("%s\n",
              codec::encode_pos1_b64(
                  static_cast<int32_t>(j["pos"].as_int()),
                  static_cast<int32_t>(j["goal"].as_int()), j.has("task"),
-                 j["task"].as_int())
+                 j["task"].as_int(), has_tc ? &tc : nullptr)
                  .c_str());
       continue;
     }
@@ -71,7 +93,8 @@ int main(int argc, char** argv) {
       Json out;
       out.set("pos", static_cast<int64_t>(p->pos))
           .set("goal", static_cast<int64_t>(p->goal))
-          .set("task", p->has_task ? Json(p->task_id) : Json());
+          .set("task", p->has_task ? Json(p->task_id) : Json())
+          .set("trace", trace_json(p->has_trace, p->trace));
       printf("%s\n", out.dump().c_str());
       continue;
     }
@@ -93,7 +116,8 @@ int main(int argc, char** argv) {
           .set("goal", i32_array(pkt->goal))
           .set("removed", i32_array(pkt->removed))
           .set("named_idx", i32_array(pkt->named_idx))
-          .set("names", names);
+          .set("names", names)
+          .set("trace", trace_json(pkt->has_trace, pkt->trace));
       printf("%s\n", out.dump().c_str());
       continue;
     }
@@ -120,6 +144,11 @@ int main(int argc, char** argv) {
                          static_cast<int32_t>(t[2].as_int()));
     }
     codec::Packet pkt = enc.encode_tick(j["seq"].as_int(), fleet);
+    codec::TraceCtx tc;
+    if (parse_trace(j, &tc)) {
+      pkt.has_trace = true;
+      pkt.trace = tc;
+    }
     printf("%s\n", codec::encode_b64(pkt).c_str());
   }
   fflush(stdout);

@@ -1,7 +1,9 @@
 """Runtime observability: unified live-metrics registry (obs.registry),
 span tracer (obs.trace), per-tick heartbeat (obs.heartbeat), metrics
-beacons (obs.beacon), and manager-side fleet aggregation
-(obs.fleet_aggregator).
+beacons (obs.beacon), manager-side fleet aggregation
+(obs.fleet_aggregator), cross-process task-causality events (obs.events,
+trace-context propagation + Perfetto flows + hop-latency histograms), and
+the always-on flight-recorder black box (obs.flightrec).
 
 Counters/gauges/histograms are ALWAYS on (one dict op each) and flow into
 every read side — Prometheus ``/metrics`` (JG_METRICS_PORT), the periodic
@@ -12,6 +14,8 @@ beacon in cpp/common/metrics.hpp / bus.hpp; merged trace reports come from
 analysis/trace_report.py, the live fleet view from analysis/fleet_top.py.
 """
 
+from p2p_distributed_tswap_tpu.obs import events  # noqa: F401
+from p2p_distributed_tswap_tpu.obs import flightrec  # noqa: F401
 from p2p_distributed_tswap_tpu.obs import registry  # noqa: F401
 from p2p_distributed_tswap_tpu.obs import trace  # noqa: F401
 from p2p_distributed_tswap_tpu.obs.heartbeat import (  # noqa: F401

@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from p2p_distributed_tswap_tpu.obs import registry as _reg
 from p2p_distributed_tswap_tpu.obs.beacon import BEACON_INTERVAL_S
 from p2p_distributed_tswap_tpu.obs.registry import hist_quantile, parse_key
 
@@ -92,6 +93,9 @@ class FleetAggregator:
         self.stale_after_s = stale_after_s
         self._peers: Dict[str, _PeerState] = {}
         self.beacons_ingested = 0
+        # counter-reset evidence (process restarts observed via shrinking
+        # cumulative counters; see _rates)
+        self.counter_resets = 0
 
     def ingest(self, payload: dict, now_ms: Optional[int] = None) -> bool:
         """Feed one bus message's data dict; non-beacons are ignored
@@ -121,6 +125,16 @@ class FleetAggregator:
             d_sent = sent - counter_total(st.prev_metrics, "bus.bytes_sent")
             d_recv = recv - counter_total(st.prev_metrics,
                                           "bus.bytes_received")
+            if d_sent < 0 or d_recv < 0:
+                # COUNTER RESET: the peer restarted (same peer_id, fresh
+                # registry), so cumulative counters shrank and the naive
+                # delta would render a negative B/s in fleet_top.  Treat
+                # the new snapshot as a fresh baseline: the restart-side
+                # totals ARE the traffic since the reset (bounded by the
+                # beacon gap), never a negative rate.
+                self.counter_resets += 1
+                _reg.count("aggregator.counter_resets")
+                d_sent, d_recv = sent, recv
         else:  # single beacon so far: cumulative average over uptime
             # `or 0.0`: a foreign emitter can send "uptime_s": null, and
             # max(None, 1e-9) would crash every subsequent rollup
@@ -201,6 +215,7 @@ class FleetAggregator:
             "peers": peers,
             "fleet": {
                 "peers": len(peers),
+                "counter_resets": self.counter_resets,
                 "stale_peers": sum(1 for p in peers.values() if p["stale"]),
                 "bytes_sent": sum(p["bandwidth"]["bytes_sent"]
                                   for p in peers.values()),

@@ -165,6 +165,24 @@ class Tracer:
         with self._lock:
             self._events.append(ev)
 
+    def flow(self, name: str, flow_id: int, phase: str = "t",
+             **args) -> None:
+        """Chrome flow event ("s" start / "t" step / "f" end): events
+        sharing (cat, name, id) are linked into arrows across processes on
+        the merged Perfetto timeline — obs/events.py emits one per
+        sampled lifecycle hop so a task's journey renders as a chain
+        (ISSUE 5).  The id is masked to 63 bits: Chrome ids are unsigned."""
+        if not self.enabled or phase not in ("s", "t", "f"):
+            return
+        ev = {"name": name, "ph": phase, "cat": "task",
+              "id": int(flow_id) & ((1 << 63) - 1),
+              "ts": self._ts_us(time.perf_counter_ns()), "pid": self.pid,
+              "tid": threading.get_ident() % (1 << 31), "args": args}
+        if phase in ("t", "f"):
+            ev["bp"] = "e"  # bind to the enclosing slice when one exists
+        with self._lock:
+            self._events.append(ev)
+
     # -- counters / gauges (live metrics: ALWAYS on, see module doc) ------
     def count(self, name: str, n: int = 1) -> None:
         self.registry.count(name, n)
@@ -276,6 +294,10 @@ def complete(name: str, t0_ns: int, dur_ns: int, **args) -> None:
 
 def instant(name: str, **args) -> None:
     _tracer.instant(name, **args)
+
+
+def flow(name: str, flow_id: int, phase: str = "t", **args) -> None:
+    _tracer.flow(name, flow_id, phase, **args)
 
 
 def count(name: str, n: int = 1) -> None:

@@ -86,6 +86,10 @@ class Fleet:
         self.log_dir.mkdir(parents=True, exist_ok=True)
         self.exit_summary: List[Dict] = []
         penv = dict(os.environ)
+        # flight-recorder dumps (always-on black box, obs/flightrec.py)
+        # land next to the per-process logs unless the caller routed them
+        # elsewhere — so a fleet incident leaves logs AND rings together
+        penv.setdefault("JG_FLIGHT_DIR", str(self.log_dir))
         if config is not None:
             # one RuntimeConfig configures every binary in the fleet
             # (MAPD_* env knobs, cpp/common/knobs.hpp)

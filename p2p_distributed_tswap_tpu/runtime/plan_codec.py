@@ -30,12 +30,19 @@ Packet layout (all little-endian; header 40 bytes):
                    automatically when every value < 65536, i.e. any grid
                    up to 256x256 and fleets up to 64k lanes; halves the
                    wire cost of the common rungs)
+                   bit 1: trace — a 20-byte trace-context block follows
+                   the header (ISSUE 5 "trace1": i64 trace_id,
+                   i64 send_unix_ms, u32 hop), stamping the packet with
+                   the sender's causal context for cross-process
+                   correlation.  JG_TRACE_CTX=0 keeps the flag clear and
+                   the wire byte-identical to the pre-trace1 format.
     i64 seq
     i64 base_seq   delta: the seq this packet's diff is relative to
     u32 n_entries
     u32 n_removed
     u32 n_named
     u32 names_len
+    [i64 trace_id  i64 send_unix_ms  u32 hop]   only when flags bit 1
     i32 idx[n_entries]      roster lane per entry
     i32 pos[n_entries]      flat cell (request: pos; response: next_pos)
     i32 goal[n_entries]     flat cell
@@ -78,6 +85,27 @@ class CodecError(ValueError):
     """Malformed packet (bad magic/version/lengths)."""
 
 
+@dataclass
+class TraceCtx:
+    """Compact per-message causal context (ISSUE 5 "trace1"): trace_id is
+    rooted where the traced object was created (a task at dispatch, a plan
+    chain at its manager), hop counts wire crossings monotonically, and
+    send_ms is the SENDER's unix wall-clock at publish time — the receiver
+    derives a clock-skew-clamped one-way latency from it."""
+    trace_id: int
+    hop: int
+    send_ms: int
+
+    def next_hop(self, send_ms: Optional[int] = None) -> "TraceCtx":
+        import time as _t
+        return TraceCtx(self.trace_id, self.hop + 1,
+                        _t.time_ns() // 1_000_000 if send_ms is None
+                        else send_ms)
+
+
+_TRACE_EXT = struct.Struct("<qqI")  # trace_id, send_unix_ms, hop
+
+
 class SeqGapError(RuntimeError):
     """A delta arrived whose base_seq is not the decoder's last applied
     seq: some packet in the chain was lost.  Owner must request a
@@ -102,6 +130,7 @@ class Packet:
     named_idx: np.ndarray = field(
         default_factory=lambda: np.zeros(0, np.int32))
     names: List[str] = field(default_factory=list)
+    trace: Optional[TraceCtx] = None
 
 
 def _i32(a) -> np.ndarray:
@@ -109,6 +138,7 @@ def _i32(a) -> np.ndarray:
 
 
 FLAG_NARROW = 1  # u16 arrays (all values < 65536)
+FLAG_TRACE = 2   # 20-byte trace-context block follows the header
 
 
 def encode(pkt: Packet) -> bytes:
@@ -121,14 +151,18 @@ def encode(pkt: Packet) -> bytes:
     arrays = (idx, pos, goal, removed, named_idx)
     narrow = all(a.size == 0 or (a.min() >= 0 and a.max() < 65536)
                  for a in arrays)
-    flags = FLAG_NARROW if narrow else 0
+    flags = (FLAG_NARROW if narrow else 0) | \
+        (FLAG_TRACE if pkt.trace is not None else 0)
     if narrow:
         arrays = tuple(a.astype("<u2") for a in arrays)
     blob = "\n".join(pkt.names).encode() if pkt.names else b""
     head = _HEADER.pack(MAGIC, VERSION, pkt.kind, flags, pkt.seq,
                         pkt.base_seq, idx.size, removed.size,
                         named_idx.size, len(blob))
-    return b"".join((head,) + tuple(a.tobytes() for a in arrays) + (blob,))
+    trace = b"" if pkt.trace is None else _TRACE_EXT.pack(
+        pkt.trace.trace_id, pkt.trace.send_ms, pkt.trace.hop)
+    return b"".join((head, trace) + tuple(a.tobytes() for a in arrays)
+                    + (blob,))
 
 
 def decode(buf: bytes) -> Packet:
@@ -142,11 +176,16 @@ def decode(buf: bytes) -> Packet:
         raise CodecError(f"unsupported codec version {version}")
     width = 2 if flags & FLAG_NARROW else 4
     dtype = np.dtype("<u2") if width == 2 else np.dtype("<i4")
-    need = _HEADER.size + width * (3 * n_entries + n_removed + n_named) \
-        + names_len
+    trace_len = _TRACE_EXT.size if flags & FLAG_TRACE else 0
+    need = _HEADER.size + trace_len \
+        + width * (3 * n_entries + n_removed + n_named) + names_len
     if len(buf) != need:
         raise CodecError(f"packet length {len(buf)} != expected {need}")
-    off = _HEADER.size
+    trace = None
+    if trace_len:
+        tid, send_ms, hop = _TRACE_EXT.unpack_from(buf, _HEADER.size)
+        trace = TraceCtx(tid, hop, send_ms)
+    off = _HEADER.size + trace_len
 
     def take(n):
         nonlocal off
@@ -162,7 +201,7 @@ def decode(buf: bytes) -> Packet:
         raise CodecError("names blob count mismatch")
     return Packet(kind=kind, seq=seq, base_seq=base_seq, idx=idx, pos=pos,
                   goal=goal, removed=removed, named_idx=named_idx,
-                  names=names)
+                  names=names, trace=trace)
 
 
 def encode_b64(pkt: Packet) -> str:
@@ -349,9 +388,15 @@ def encode_response(seq: int, idx: Sequence[int], next_pos: Sequence[int],
 #     u8  version 1
 #     u8  flags   bit 0: narrow — cells are u16 (any grid up to 256x256)
 #                 bit 1: a busy-task id follows the cells
+#                 bit 2: a 20-byte trace-context block (trace1, ISSUE 5:
+#                        i64 trace_id, i64 send_unix_ms, u32 hop) trails
+#                        the packet — a busy agent's heartbeat carries its
+#                        task's causal context so claims correlate across
+#                        processes.  JG_TRACE_CTX=0 keeps the bit clear.
 #     u16 reserved (0)
 #     pos, goal   u16 each when narrow, else i32
 #     i64 task_id (only when flags bit 1)
+#     trace block (only when flags bit 2)
 #
 # The C++ mirror (cpp/common/plan_codec.hpp encode_pos1/decode_pos1) is
 # byte-identical; tests/test_region_bus.py locks golden bytes across both.
@@ -361,24 +406,32 @@ POS1_MAGIC = 0x31534F50  # b"POS1" little-endian
 POS1_VERSION = 1
 POS1_FLAG_NARROW = 1
 POS1_FLAG_TASK = 2
+POS1_FLAG_TRACE = 4
 _POS1_HEAD = struct.Struct("<IBBH")
 
 
-def encode_pos1(pos: int, goal: int, task_id: Optional[int] = None) -> bytes:
+def encode_pos1(pos: int, goal: int, task_id: Optional[int] = None,
+                trace: Optional[TraceCtx] = None) -> bytes:
     pos, goal = int(pos), int(goal)
     narrow = 0 <= pos < 65536 and 0 <= goal < 65536
     flags = (POS1_FLAG_NARROW if narrow else 0) | \
-        (POS1_FLAG_TASK if task_id is not None else 0)
+        (POS1_FLAG_TASK if task_id is not None else 0) | \
+        (POS1_FLAG_TRACE if trace is not None else 0)
     out = _POS1_HEAD.pack(POS1_MAGIC, POS1_VERSION, flags, 0)
     out += struct.pack("<HH" if narrow else "<ii", pos, goal)
     if task_id is not None:
         out += struct.pack("<q", int(task_id))
+    if trace is not None:
+        out += _TRACE_EXT.pack(trace.trace_id, trace.send_ms, trace.hop)
     return out
 
 
-def decode_pos1(buf: bytes) -> Tuple[int, int, Optional[int]]:
-    """``(pos, goal, task_id-or-None)``; raises :class:`CodecError` on a
-    malformed packet (short/overlong, bad magic/version)."""
+def decode_pos1_full(buf: bytes
+                     ) -> Tuple[int, int, Optional[int],
+                                Optional[TraceCtx]]:
+    """``(pos, goal, task_id-or-None, trace-or-None)``; raises
+    :class:`CodecError` on a malformed packet (short/overlong, bad
+    magic/version)."""
     if len(buf) < _POS1_HEAD.size:
         raise CodecError("short pos1 packet")
     magic, version, flags, _ = _POS1_HEAD.unpack_from(buf, 0)
@@ -388,25 +441,49 @@ def decode_pos1(buf: bytes) -> Tuple[int, int, Optional[int]]:
         raise CodecError(f"unsupported pos1 version {version}")
     narrow = bool(flags & POS1_FLAG_NARROW)
     has_task = bool(flags & POS1_FLAG_TASK)
-    need = _POS1_HEAD.size + (4 if narrow else 8) + (8 if has_task else 0)
+    has_trace = bool(flags & POS1_FLAG_TRACE)
+    need = _POS1_HEAD.size + (4 if narrow else 8) + (8 if has_task else 0) \
+        + (_TRACE_EXT.size if has_trace else 0)
     if len(buf) != need:
         raise CodecError(f"pos1 length {len(buf)} != expected {need}")
     pos, goal = struct.unpack_from("<HH" if narrow else "<ii", buf,
                                    _POS1_HEAD.size)
+    off = _POS1_HEAD.size + (4 if narrow else 8)
     task_id = None
     if has_task:
-        (task_id,) = struct.unpack_from("<q", buf, need - 8)
-    return int(pos), int(goal), task_id
+        (task_id,) = struct.unpack_from("<q", buf, off)
+        off += 8
+    trace = None
+    if has_trace:
+        tid, send_ms, hop = _TRACE_EXT.unpack_from(buf, off)
+        trace = TraceCtx(tid, hop, send_ms)
+    return int(pos), int(goal), task_id, trace
 
 
-def encode_pos1_b64(pos: int, goal: int,
-                    task_id: Optional[int] = None) -> str:
-    return base64.b64encode(encode_pos1(pos, goal, task_id)).decode()
+def decode_pos1(buf: bytes) -> Tuple[int, int, Optional[int]]:
+    """``(pos, goal, task_id-or-None)`` — the pre-trace1 3-tuple shape most
+    consumers want (any trace block is validated, then dropped)."""
+    pos, goal, task_id, _ = decode_pos1_full(buf)
+    return pos, goal, task_id
+
+
+def encode_pos1_b64(pos: int, goal: int, task_id: Optional[int] = None,
+                    trace: Optional[TraceCtx] = None) -> str:
+    return base64.b64encode(encode_pos1(pos, goal, task_id, trace)).decode()
+
+
+def _pos1_raw(data: str) -> bytes:
+    try:
+        return base64.b64decode(data, validate=True)
+    except Exception as e:
+        raise CodecError(f"bad pos1 base64 framing: {e}") from None
 
 
 def decode_pos1_b64(data: str) -> Tuple[int, int, Optional[int]]:
-    try:
-        raw = base64.b64decode(data, validate=True)
-    except Exception as e:
-        raise CodecError(f"bad pos1 base64 framing: {e}") from None
-    return decode_pos1(raw)
+    return decode_pos1(_pos1_raw(data))
+
+
+def decode_pos1_full_b64(data: str
+                         ) -> Tuple[int, int, Optional[int],
+                                    Optional[TraceCtx]]:
+    return decode_pos1_full(_pos1_raw(data))

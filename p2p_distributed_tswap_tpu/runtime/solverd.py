@@ -79,6 +79,8 @@ import numpy as np
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
 from p2p_distributed_tswap_tpu.obs import HeartbeatWriter, registry, trace
+from p2p_distributed_tswap_tpu.obs import events as obs_events
+from p2p_distributed_tswap_tpu.obs import flightrec
 from p2p_distributed_tswap_tpu.obs.beacon import MetricsBeacon
 from p2p_distributed_tswap_tpu.obs.heartbeat import TICK_BUDGET_MS
 from p2p_distributed_tswap_tpu.ops.distance import (
@@ -731,6 +733,15 @@ class TickRunner:
                 except (ValueError, pcodec.CodecError):
                     self.registry.count("solverd.bad_packets")
                     return False
+                if pkt.trace is not None:
+                    # trace1 block on the packed frame: the receive side
+                    # of the manager->solverd hop (plan.request event +
+                    # clock-skew-clamped one-way latency)
+                    obs_events.emit("plan.request",
+                                    trace_id=pkt.trace.trace_id,
+                                    hop=pkt.trace.hop,
+                                    send_ms=pkt.trace.send_ms,
+                                    seq=data.get("seq"))
                 if not self._packet_sane(pkt):
                     # a malformed-but-well-framed packet (bit flip, buggy
                     # peer) must not wrap negative lanes into live ones or
@@ -765,7 +776,7 @@ class TickRunner:
             caps = data.get("caps") or []
             self._req = {"mode": "packed", "seq": data.get("seq"),
                          "caps": caps, "t0": t0, "t0_ns": t0_ns,
-                         "t_dec": time.perf_counter()}
+                         "tc": pkt.trace, "t_dec": time.perf_counter()}
             if pcodec.CODEC_NAME not in caps:
                 # JSON-response fallback: the pipelined loop ingests
                 # request k+1 (mutating the roster) before finishing k,
@@ -784,9 +795,15 @@ class TickRunner:
         if not agents:
             self._req = None
             return False
+        json_tc = obs_events.parse_tc(data)
+        if json_tc is not None:
+            obs_events.emit("plan.request", trace_id=json_tc[0],
+                            hop=json_tc[1], send_ms=json_tc[2],
+                            seq=data.get("seq"))
+            json_tc = pcodec.TraceCtx(*json_tc)
         self._req = {"mode": "json", "seq": data.get("seq"),
                      "agents": agents, "t0": t0, "t0_ns": t0_ns,
-                     "t_dec": time.perf_counter()}
+                     "tc": json_tc, "t_dec": time.perf_counter()}
         return True
 
     def begin(self) -> Optional[PendingTick]:
@@ -823,6 +840,12 @@ class TickRunner:
                         + (t_plan - t_fetch0)))
         with trace.span("solverd.reply_encode", parent="solverd.tick"):
             w = self.grid.width
+            # echo the request's trace context one hop on (fresh send
+            # stamp): the manager's plan.response event closes the loop
+            resp_tc = None
+            req_tc = r.get("tc")
+            if req_tc is not None and obs_events.ctx_enabled():
+                resp_tc = req_tc.next_hop()
             if r["mode"] == "json":
                 resp = {
                     "type": "plan_response",
@@ -833,17 +856,21 @@ class TickRunner:
                                "goal": [g % w, g // w]}
                               for pid, c, g in result],
                 }
+                if resp_tc is not None:
+                    resp["tc"] = [resp_tc.trace_id, resp_tc.hop,
+                                  resp_tc.send_ms]
             else:
                 lanes, npos, ngoal = result
                 if pcodec.CODEC_NAME in r["caps"]:
+                    rpkt = pcodec.encode_response(r["seq"], lanes, npos,
+                                                  ngoal)
+                    rpkt.trace = resp_tc
                     resp = {
                         "type": "plan_response",
                         "seq": r["seq"],
                         "codec": pcodec.CODEC_NAME,
                         "duration_micros": us,
-                        "data": pcodec.encode_b64(
-                            pcodec.encode_response(r["seq"], lanes, npos,
-                                                   ngoal)),
+                        "data": pcodec.encode_b64(rpkt),
                     }
                 else:
                     # packed request from a peer that cannot read packed
@@ -862,6 +889,9 @@ class TickRunner:
                                       "goal": [int(g) % w, int(g) // w]})
                     resp = {"type": "plan_response", "seq": r["seq"],
                             "duration_micros": us, "moves": moves}
+                    if resp_tc is not None:
+                        resp["tc"] = [resp_tc.trace_id, resp_tc.hop,
+                                      resp_tc.send_ms]
         t_end = time.perf_counter()
         self.ticks += 1
         total_ms = 1000.0 * (t_end - r["t0"])
@@ -948,6 +978,10 @@ def main(argv=None) -> int:
 
     tracer = trace.configure(enabled=True if args.trace else None,
                              proc="solverd")
+    # lifecycle events + always-on flight recorder (ISSUE 5): SIGUSR2 /
+    # crash / exit dumps, plus the bus flight_dump query handled below
+    obs_events.configure("solverd")
+    flightrec.install("solverd")
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -1072,6 +1106,14 @@ def main(argv=None) -> int:
         data = frame.get("data") or {}
         if data.get("type") == "stats_request":
             answer_stats()
+            continue
+        if data.get("type") == "flight_dump":
+            # black-box query: dump the ring and answer with the path
+            path = flightrec.dump(reason="bus_request")
+            bus.publish("solver", {
+                "type": "flight_dump_response", "proc": "solverd",
+                "peer_id": "solverd", "path": path,
+                "events": len(flightrec.get_recorder())})
             continue
         if data.get("type") != "plan_request":
             continue

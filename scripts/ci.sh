@@ -21,11 +21,16 @@ python -m compileall -q -f \
     analysis \
     analysis/fleet_top.py \
     analysis/bus_scaling.py \
+    analysis/task_timeline.py \
+    analysis/blackbox.py \
     p2p_distributed_tswap_tpu/obs/registry.py \
     p2p_distributed_tswap_tpu/obs/beacon.py \
+    p2p_distributed_tswap_tpu/obs/events.py \
+    p2p_distributed_tswap_tpu/obs/flightrec.py \
     p2p_distributed_tswap_tpu/obs/fleet_aggregator.py \
     p2p_distributed_tswap_tpu/runtime/region.py \
     scripts/bus_smoke.py \
+    scripts/trace_smoke.py \
     bench.py
 echo "syntax OK"
 
@@ -43,6 +48,12 @@ echo "== busd relay micro-smoke =="
 # N-client fanout sanity under the fast relay framing (ISSUE 4): fast +
 # legacy subscribers, wildcard region watcher, hub fanout counters
 JAX_PLATFORMS=cpu python scripts/bus_smoke.py
+
+echo "== trace smoke =="
+# ISSUE 5: a tiny live fleet under JG_TRACE=1 JG_TRACE_SAMPLE=1.0 must
+# reconstruct >= 1 fully-attributed task timeline (task_timeline.py
+# --once --json) — proof the trace context propagates on the real wire
+JAX_PLATFORMS=cpu python scripts/trace_smoke.py
 
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log

@@ -68,9 +68,21 @@ def wire_fuzz(seed: int, ticks: int, snapshot_every: int) -> list:
     dec = pc.PackedStateDecoder()
     lines = []
     for seq, fleet in script:
-        b64 = pc.encode_b64(enc.encode_tick(seq, fleet))
-        lines.append((seq, fleet, b64))
-        dec.apply(pc.decode_b64(b64))
+        pkt = enc.encode_tick(seq, fleet)
+        # ~half the packets carry a trace1 context (ISSUE 5); ids stay
+        # under 2^53 — the JSON wire (and the golden probe's JSON parse)
+        # carries numbers as doubles
+        trace = None
+        if rng.random() < 0.5:
+            trace = pc.TraceCtx(int(rng.integers(1, 1 << 52)),
+                                int(rng.integers(0, 1 << 16)),
+                                int(rng.integers(1, 1 << 44)))
+            pkt.trace = trace
+        b64 = pc.encode_b64(pkt)
+        lines.append((seq, fleet, trace, b64))
+        back = pc.decode_b64(b64)
+        assert back.trace == trace, f"seed {seed} seq {seq}: trace diverged"
+        dec.apply(back)
         got = {dec.name_of(k): list(v) for k, v in dec.state.items()}
         want = {n: [p, g] for n, p, g in fleet}
         assert got == want, f"seed {seed} seq {seq}: decoder diverged"
@@ -94,9 +106,14 @@ def pos1_fuzz(seed: int, count: int = 200) -> bool:
         hi = 1 << 20 if rng.random() < 0.4 else 65536
         pos, goal = int(rng.integers(hi)), int(rng.integers(hi))
         task = int(rng.integers(1 << 40)) if rng.random() < 0.5 else None
-        cases.append((pos, goal, task))
-        blob = pc.encode_pos1(pos, goal, task)
-        assert pc.decode_pos1(blob) == (pos, goal, task), \
+        trace = None
+        if rng.random() < 0.5:  # trace1 ext (ISSUE 5), ids under 2^53
+            trace = pc.TraceCtx(int(rng.integers(1, 1 << 52)),
+                                int(rng.integers(0, 1 << 16)),
+                                int(rng.integers(1, 1 << 44)))
+        cases.append((pos, goal, task, trace))
+        blob = pc.encode_pos1(pos, goal, task, trace)
+        assert pc.decode_pos1_full(blob) == (pos, goal, task, trace), \
             f"pos1 seed {seed}: py round-trip diverged"
         # truncation and magic corruption must raise, never mis-decode
         for bad in (blob[:-1], b"\xff" + blob[1:], blob + b"\x00"):
@@ -108,12 +125,14 @@ def pos1_fuzz(seed: int, count: int = 200) -> bool:
     binary = _golden_binary()
     if binary is None:
         return False
-    py_lines = [pc.encode_pos1_b64(p, g, t) for p, g, t in cases]
+    py_lines = [pc.encode_pos1_b64(p, g, t, tr) for p, g, t, tr in cases]
     feed = "\n".join(
-        '{"pos":%d,"goal":%d%s}' % (p, g,
-                                    ',"task":%d' % t if t is not None
-                                    else "")
-        for p, g, t in cases) + "\n"
+        '{"pos":%d,"goal":%d%s%s}' % (
+            p, g,
+            ',"task":%d' % t if t is not None else "",
+            "" if tr is None else
+            ',"trace":[%d,%d,%d]' % (tr.trace_id, tr.hop, tr.send_ms))
+        for p, g, t, tr in cases) + "\n"
     out = subprocess.run([str(binary), "--pos1-encode"], input=feed,
                          capture_output=True, text=True, check=True,
                          timeout=120)
@@ -124,10 +143,13 @@ def pos1_fuzz(seed: int, count: int = 200) -> bool:
                          capture_output=True, text=True, check=True,
                          timeout=120)
     import json as _json
-    for (p, g, t), line in zip(cases, out.stdout.splitlines()):
+    for (p, g, t, tr), line in zip(cases, out.stdout.splitlines()):
         d = _json.loads(line)
         assert (d["pos"], d["goal"], d["task"]) == (p, g, t), \
             f"pos1 seed {seed}: cpp decoder diverged"
+        want_tr = None if tr is None else [tr.trace_id, tr.hop, tr.send_ms]
+        assert d.get("trace") == want_tr, \
+            f"pos1 seed {seed}: cpp trace decode diverged"
     return True
 
 
@@ -137,15 +159,18 @@ def golden_fuzz(lines_by_seed: dict) -> bool:
         return False
     for seed, (snapshot_every, lines) in lines_by_seed.items():
         feed = "\n".join(
-            '{"seq":%d,"snapshot_every":%d,"fleet":[%s]}' % (
+            '{"seq":%d,"snapshot_every":%d,"fleet":[%s]%s}' % (
                 seq, snapshot_every,
-                ",".join('["%s",%d,%d]' % (n, p, g) for n, p, g in fleet))
-            for seq, fleet, _ in lines) + "\n"
+                ",".join('["%s",%d,%d]' % (n, p, g) for n, p, g in fleet),
+                "" if trace is None else
+                ',"trace":[%d,%d,%d]' % (trace.trace_id, trace.hop,
+                                         trace.send_ms))
+            for seq, fleet, trace, _ in lines) + "\n"
         out = subprocess.run([str(binary), "--encode"], input=feed,
                              capture_output=True, text=True, check=True,
                              timeout=120)
         cpp = out.stdout.split()
-        py = [b64 for _, _, b64 in lines]
+        py = [b64 for _, _, _, b64 in lines]
         assert cpp == py, f"seed {seed}: cpp encoder bytes diverged"
     return True
 

@@ -27,3 +27,16 @@ force_virtual_cpu_devices(8)
 import jax  # noqa: E402  (after XLA_FLAGS, intentionally)
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Expose each phase's report on the item so fixtures can see whether
+    the test body failed (the e2e failure-artifact collector in
+    test_runtime_e2e.py dumps flight rings + log tails on rep_call.failed,
+    ISSUE 5 satellite)."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)

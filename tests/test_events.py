@@ -1,0 +1,289 @@
+"""ISSUE 5 unit tests: lifecycle events, trace-context helpers, the
+flight-recorder ring, hop-monotonicity across a simulated relay chain, and
+timeline/blackbox reconstruction from synthetic event logs."""
+
+import importlib.util
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.obs import events as ev
+from p2p_distributed_tswap_tpu.obs import flightrec
+from p2p_distributed_tswap_tpu.obs import registry as reg
+from p2p_distributed_tswap_tpu.obs import trace
+from p2p_distributed_tswap_tpu.runtime.plan_codec import TraceCtx
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def load_analysis(mod: str):
+    spec = importlib.util.spec_from_file_location(
+        f"analysis_{mod}", ROOT / "analysis" / f"{mod}.py")
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_is_bounded_and_dumps(tmp_path):
+    rec = flightrec.FlightRecorder(proc="t", capacity=8)
+    for k in range(20):
+        rec.record({"ts_ms": k, "event": "e", "k": k})
+    assert len(rec) == 8
+    assert [e["k"] for e in rec.tail()] == list(range(12, 20))
+    path = rec.dump(str(tmp_path / "t.flight.jsonl"), reason="test")
+    lines = [json.loads(line)
+             for line in Path(path).read_text().splitlines()]
+    assert lines[0]["meta"] == "flight" and lines[0]["reason"] == "test"
+    assert lines[0]["events"] == 8
+    assert [e["k"] for e in lines[1:]] == list(range(12, 20))
+
+
+def test_flight_dump_survives_bad_path():
+    rec = flightrec.FlightRecorder(proc="t")
+    rec.record({"ts_ms": 1, "event": "e"})
+    assert rec.dump("/proc/definitely/not/writable/x.jsonl") is None
+
+
+# ---------------------------------------------------------------------------
+# trace-context helpers + sampling
+# ---------------------------------------------------------------------------
+
+def test_tc_wire_round_trip():
+    tc = ev.make_tc(123, 4)
+    assert ev.parse_tc({"tc": tc}) == (123, 4, tc[2])
+    assert ev.parse_tc({}) is None
+    assert ev.parse_tc({"tc": [1, 2]}) is None
+    assert ev.parse_tc({"tc": "nope"}) is None
+
+
+def test_sampling_is_deterministic_and_proportional(monkeypatch):
+    monkeypatch.setenv("JG_TRACE_SAMPLE", "0.25")
+    picks = [ev.sampled(i) for i in range(997 * 4)]
+    assert picks == [ev.sampled(i) for i in range(997 * 4)]  # deterministic
+    rate = sum(picks) / len(picks)
+    assert 0.2 < rate < 0.3
+    monkeypatch.setenv("JG_TRACE_SAMPLE", "1.0")
+    assert all(ev.sampled(i) for i in range(100))
+    monkeypatch.setenv("JG_TRACE_SAMPLE", "0")
+    assert not any(ev.sampled(i) for i in range(100))
+
+
+def test_hop_latency_clamps_and_counts_skew():
+    r = reg.get_registry()
+    r.clear()
+    now = ev.now_ms()
+    lat = ev.hop_latency_ms(now - 50, edge="task.claim")
+    assert 40 <= lat <= 1000
+    # a sender stamp FROM THE FUTURE (peer clock ahead): clamped, counted
+    lat = ev.hop_latency_ms(now + 10_000, edge="task.claim")
+    assert lat == 0.0
+    assert r.counter_value("hop.clock_skew_events") == 1
+    snap = r.snapshot()
+    assert any(k.startswith("hop_latency_ms") for k in snap["hists"])
+
+
+def test_hops_monotone_across_simulated_relay_chain():
+    """The property the wire protocol promises: every send advances the
+    hop, every receive max-merges, so a task's event chain ordered by
+    causality has non-decreasing hops — across any number of relays."""
+    tc = TraceCtx(trace_id=42, hop=0, send_ms=ev.now_ms())
+    seen = [tc.hop]
+    for _ in range(12):  # manager -> agent -> agent -> ... relay chain
+        tc = tc.next_hop()
+        seen.append(tc.hop)
+    assert seen == sorted(seen)
+    assert len(set(seen)) == len(seen)  # strictly increasing per send
+
+
+def test_event_log_writes_through_when_traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("JG_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("JG_TRACE_SAMPLE", "1.0")
+    trace.configure(enabled=True, proc="evtest")
+    flightrec.configure("evtest")
+    log = ev.configure("evtest")
+    try:
+        log.emit("task.dispatch", trace_id=7, hop=1, task_id=7, peer="a")
+        log.emit("task.claim", trace_id=7, hop=1, task_id=7,
+                 send_ms=ev.now_ms() - 3)
+        files = list(tmp_path.glob("evtest-*.events.jsonl"))
+        assert len(files) == 1
+        lines = [json.loads(x) for x in
+                 files[0].read_text().splitlines()]
+        assert [x["event"] for x in lines] == ["task.dispatch",
+                                               "task.claim"]
+        assert lines[1]["wire_ms"] >= 0
+        # flight ring recorded both regardless of tracing
+        assert len(flightrec.get_recorder()) == 2
+        # flow events landed in the tracer ring (s for the dispatch root)
+        evs = trace.get_tracer()._drain()
+        flows = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+        assert [f["ph"] for f in flows] == ["s", "t"]
+        assert all(f["id"] == 7 for f in flows)
+    finally:
+        trace.configure(enabled=False, proc="py")
+        ev.configure("py")
+        flightrec.configure("py")
+
+
+def test_event_log_silent_without_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv("JG_TRACE_DIR", str(tmp_path))
+    trace.configure(enabled=False, proc="evoff")
+    flightrec.configure("evoff")
+    log = ev.configure("evoff")
+    try:
+        log.emit("task.dispatch", trace_id=9, hop=1, task_id=9)
+        assert not list(tmp_path.glob("*.events.jsonl"))  # no event file
+        assert len(flightrec.get_recorder()) == 1  # black box still on
+    finally:
+        ev.configure("py")
+        flightrec.configure("py")
+
+
+# ---------------------------------------------------------------------------
+# timeline reconstruction (synthetic logs)
+# ---------------------------------------------------------------------------
+
+def synth_events(trace_id, t0, *, skip=(), swap=False):
+    chain = [
+        ("task.queue", "manager", 0, 0),
+        ("task.dispatch", "manager", 1, 10),
+        ("task.claim", "agent", 1, 12),
+        ("task.exec", "agent", 2, 500),
+        ("task.pickup", "agent", 2, 1500),
+        ("task.delivery", "agent", 2, 3000),
+        ("task.done", "manager", 3, 3004),
+        ("task.done_ack", "agent", 4, 3006),
+    ]
+    if swap:
+        chain[4:4] = [("task.swap_req", "agent", 2, 600),
+                      ("task.swap_resp", "agent", 2, 640)]
+    out = []
+    for name, proc, hop, dt in chain:
+        if name in skip:
+            continue
+        out.append({"ts_ms": t0 + dt, "proc": proc, "pid": 1,
+                    "event": name, "trace_id": trace_id, "hop": hop,
+                    "task_id": trace_id & 0xFFFF})
+    return out
+
+
+def write_events(directory, events_by_proc):
+    for proc, events in events_by_proc.items():
+        path = directory / f"{proc}-1.events.jsonl"
+        path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+
+
+def test_timeline_complete_chain_attributes_phases(tmp_path):
+    evs = synth_events(100, 1_000_000, swap=True)
+    write_events(tmp_path, {
+        "manager": [e for e in evs if e["proc"] == "manager"],
+        "agent": [e for e in evs if e["proc"] == "agent"]})
+    tl = load_analysis("task_timeline")
+    s = tl.summarize(tmp_path)
+    assert s["traces"] == 1 and s["tasks_complete"] == 1
+    assert s["coverage"] == 1.0 and s["orphans"] == 0
+    assert s["hop_violations"] == 0
+    r = s["tasks"][0]
+    ph = r["phases_ms"]
+    assert ph["queueing"] == 10
+    assert ph["wire"] == 2
+    assert ph["planning"] == 488       # claim(12) -> exec(500)
+    assert ph["to_pickup"] == 1000     # exec(500) -> pickup(1500)
+    assert ph["to_delivery"] == 1500
+    assert ph["done_wire"] == 4
+    assert ph["ack"] == 2
+    assert r["end_to_end_ms"] == 3006 - 10
+    # telescoping identity: phases sum to queue->ack exactly (no skew)
+    assert sum(ph.values()) == r["queue_to_ack_ms"]
+    assert r["swaps"] == 1 and r["swap_ms"] == 40
+
+
+def test_timeline_flags_gaps_and_orphans(tmp_path):
+    complete = synth_events(200, 1_000_000)
+    gappy = synth_events(201, 1_000_000, skip=("task.claim",))
+    orphan = synth_events(202, 1_000_000, skip=("task.queue",
+                                                "task.dispatch"))
+    write_events(tmp_path, {"all": complete + gappy + orphan})
+    tl = load_analysis("task_timeline")
+    s = tl.summarize(tmp_path)
+    assert s["traces"] == 3
+    assert s["tasks_done"] == 3        # all three reached task.done
+    assert s["tasks_complete"] == 1    # only one is gap-free
+    assert s["coverage"] == pytest.approx(1 / 3, rel=1e-3)
+    assert s["orphans"] == 1 and s["orphan_trace_ids"] == [202]
+    rec = next(r for r in s["tasks"] if r["trace_id"] == 201)
+    assert rec["missing"] == ["task.claim"]
+
+
+def test_timeline_counts_hop_violations(tmp_path):
+    evs = synth_events(300, 1_000_000)
+    for e in evs:
+        if e["event"] == "task.done":
+            e["hop"] = 0  # a relay that FORGOT to carry the hop forward
+    write_events(tmp_path, {"all": evs})
+    tl = load_analysis("task_timeline")
+    s = tl.summarize(tmp_path)
+    assert s["hop_violations"] == 1
+
+
+def test_timeline_clamps_skew_between_processes(tmp_path):
+    evs = synth_events(400, 1_000_000)
+    for e in evs:
+        if e["event"] == "task.done":  # manager clock 100 ms behind
+            e["ts_ms"] -= 104
+    write_events(tmp_path, {"all": evs})
+    tl = load_analysis("task_timeline")
+    s = tl.summarize(tmp_path)
+    r = s["tasks"][0]
+    assert r["complete"]
+    assert r["skew_ms"] == 100  # delivery(3000) -> done(2900): clamped
+    assert sum(r["phases_ms"].values()) == \
+        r["queue_to_ack_ms"] + r["skew_ms"]
+
+
+# ---------------------------------------------------------------------------
+# blackbox merge
+# ---------------------------------------------------------------------------
+
+def test_blackbox_merges_rings_time_ordered(tmp_path):
+    for proc, events in {
+        "a": [{"ts_ms": 1000, "proc": "a", "pid": 1, "event": "x"},
+              {"ts_ms": 3000, "proc": "a", "pid": 1, "event": "y"}],
+        "b": [{"ts_ms": 2000, "proc": "b", "pid": 2, "event": "z"}],
+    }.items():
+        rec = flightrec.FlightRecorder(proc=proc)
+        for e in events:
+            rec.record(e)
+        rec.dump(str(tmp_path / f"{proc}-1.flight.jsonl"), reason="test")
+    bb = load_analysis("blackbox")
+    metas, events = bb.load_dumps(tmp_path)
+    assert len(metas) == 2
+    assert [e["event"] for e in events] == ["x", "z", "y"]
+
+
+def test_blackbox_cli_exits_nonzero_without_dumps(tmp_path, capsys):
+    bb = load_analysis("blackbox")
+    assert bb.main(["--dir", str(tmp_path)]) == 1
+    assert "no *.flight.jsonl" in capsys.readouterr().out
+
+
+def test_timeline_early_done_without_pickup_is_complete(tmp_path):
+    """Reference semantics: done detection is positional, so a task whose
+    delivery cell is crossed before its pickup completes with NO pickup
+    phase — a legitimate shape, not a propagation gap."""
+    evs = synth_events(500, 1_000_000, skip=("task.pickup",))
+    write_events(tmp_path, {"all": evs})
+    tl = load_analysis("task_timeline")
+    s = tl.summarize(tmp_path)
+    r = s["tasks"][0]
+    assert r["complete"] and r["early_done"]
+    assert s["coverage"] == 1.0 and s["orphans"] == 0
+    # exec(500) -> delivery(3000) lands in the delivery leg
+    assert r["phases_ms"]["to_delivery"] == 2500
+    assert sum(r["phases_ms"].values()) == r["queue_to_ack_ms"]

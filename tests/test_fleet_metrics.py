@@ -498,3 +498,41 @@ def test_solverd_stats_include_network_summary():
         assert k in net
     # live tick accounting is always on (no tracing needed)
     assert runner.registry.snapshot()["hists"]["tick_ms"]["count"] == 1
+
+
+def test_aggregator_counter_reset_clamps_to_fresh_baseline():
+    """ISSUE 5 satellite: a process restart (same peer_id, fresh registry)
+    shrinks cumulative counters, and the naive beacon delta went negative
+    — fleet_top rendered negative B/s.  The aggregator must clamp to a
+    fresh baseline (the restarted process's totals over the beacon gap)
+    and count the reset."""
+    agg = FleetAggregator()
+    beacon = {"type": "metrics_beacon", "peer_id": "p1", "proc": "agent",
+              "pid": 1, "interval_s": 2.0}
+    before = {"uptime_s": 100.0,
+              "counters": {'bus.bytes_sent{topic="mapd"}': 50_000,
+                           'bus.bytes_received{topic="mapd"}': 70_000},
+              "gauges": {}, "hists": {}}
+    after_restart = {"uptime_s": 1.5,  # fresh registry: counters shrank
+                     "counters": {'bus.bytes_sent{topic="mapd"}': 400,
+                                  'bus.bytes_received{topic="mapd"}': 600},
+                     "gauges": {}, "hists": {}}
+    agg.ingest({**beacon, "metrics": before}, now_ms=10_000)
+    agg.ingest({**beacon, "metrics": after_restart}, now_ms=12_000)
+    r = agg.rollup(now_ms=12_000)
+    bw = r["peers"]["p1"]["bandwidth"]
+    # fresh baseline: 400 B / 2 s and 600 B / 2 s — never negative
+    assert bw["sent_kbps"] == pytest.approx(400 * 8 / 2 / 1000, rel=1e-3)
+    assert bw["recv_kbps"] == pytest.approx(600 * 8 / 2 / 1000, rel=1e-3)
+    assert r["fleet"]["counter_resets"] == 1
+    assert agg.counter_resets == 1
+    # a normal next beacon resumes delta rates without another reset
+    normal = {"uptime_s": 3.5,
+              "counters": {'bus.bytes_sent{topic="mapd"}': 2400,
+                           'bus.bytes_received{topic="mapd"}': 700},
+              "gauges": {}, "hists": {}}
+    agg.ingest({**beacon, "metrics": normal}, now_ms=14_000)
+    r = agg.rollup(now_ms=14_000)
+    assert r["peers"]["p1"]["bandwidth"]["sent_kbps"] == \
+        pytest.approx(2000 * 8 / 2 / 1000, rel=1e-3)
+    assert agg.counter_resets == 1

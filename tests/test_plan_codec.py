@@ -390,3 +390,101 @@ def test_tick_runner_seq_gap_requests_snapshot_and_recovers():
     resp = runner.handle(req(enc.encode_tick(4, fleet), 4))
     assert resp is not None and resp["seq"] == 4
     assert runner.packed.last_seq == 4
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5: trace1 context on the packed plan wire
+# ---------------------------------------------------------------------------
+
+def test_trace_ctx_round_trips_and_leaves_wire_unchanged_when_absent():
+    enc = pc.PackedFleetEncoder()
+    fleet = [("a", 5, 9), ("b", 7, 2)]
+    plain = pc.encode(enc.encode_tick(1, fleet))
+    enc2 = pc.PackedFleetEncoder()
+    pkt = enc2.encode_tick(1, fleet)
+    pkt.trace = pc.TraceCtx(trace_id=(1 << 44) | 42, hop=3,
+                            send_ms=1_754_200_000_123)
+    traced = pc.encode(pkt)
+    # kill-switch contract: without a context the bytes are identical to
+    # the pre-trace1 wire; with one, only the flag + 20-byte block differ
+    assert len(traced) == len(plain) + 20
+    back = pc.decode(traced)
+    assert back.trace == pkt.trace
+    assert pc.decode(plain).trace is None
+    np.testing.assert_array_equal(back.idx, pc.decode(plain).idx)
+    np.testing.assert_array_equal(back.pos, pc.decode(plain).pos)
+    # truncating the trace block is a length error, not a misparse
+    with pytest.raises(pc.CodecError):
+        pc.decode(traced[:-1])
+
+
+def test_trace_ctx_golden_bytes_match_cpp():
+    binary = golden_binary()
+    import json as _json
+
+    tc = [(1 << 40) | 7, 5, 1_754_200_111_222]
+    script = random_fleet_script(seed=3, ticks=4)
+    py_enc = pc.PackedFleetEncoder()
+    py_lines = []
+    feed = []
+    for seq, fleet in script:
+        pkt = py_enc.encode_tick(seq, fleet)
+        pkt.trace = pc.TraceCtx(tc[0] + seq, tc[1], tc[2])
+        py_lines.append(pc.encode_b64(pkt))
+        feed.append(_json.dumps({
+            "seq": seq, "fleet": [list(e) for e in fleet],
+            "trace": [tc[0] + seq, tc[1], tc[2]]}))
+    out = subprocess.run([str(binary), "--encode"],
+                         input="\n".join(feed) + "\n", text=True,
+                         capture_output=True, check=True, timeout=120)
+    assert out.stdout.split() == py_lines
+    # and the native decoder reports the same context back
+    out = subprocess.run([str(binary), "--decode"],
+                         input=py_lines[0] + "\n", text=True,
+                         capture_output=True, check=True, timeout=120)
+    decoded = _json.loads(out.stdout)
+    assert decoded["trace"] == [tc[0] + 1, tc[1], tc[2]]
+
+
+def test_tick_runner_echoes_trace_ctx_one_hop_on(monkeypatch):
+    """solverd answers a traced plan_request with the same trace_id, hop+1
+    and a fresh send stamp — on both the packed and JSON response paths."""
+    monkeypatch.setenv("JG_TRACE_CTX", "1")
+    from p2p_distributed_tswap_tpu.core.grid import Grid
+    from p2p_distributed_tswap_tpu.runtime.solverd import (PlanService,
+                                                           TickRunner)
+
+    grid = Grid.default()
+    runner = TickRunner(PlanService(grid, capacity_min=4), grid)
+    enc = pc.PackedFleetEncoder()
+    pkt = enc.encode_tick(1, [("a", 5, 9)])
+    pkt.trace = pc.TraceCtx(trace_id=777, hop=1, send_ms=1)
+    resp = runner.handle({"type": "plan_request", "seq": 1,
+                          "codec": pc.CODEC_NAME, "caps": [pc.CODEC_NAME],
+                          "base_seq": 0, "data": pc.encode_b64(pkt)})
+    rt = pc.decode_b64(resp["data"]).trace
+    assert rt is not None and rt.trace_id == 777 and rt.hop == 2
+    assert rt.send_ms > 1
+    # JSON wire: "tc" echoed on the response envelope
+    resp = runner.handle({"type": "plan_request", "seq": 2,
+                          "tc": [888, 1, 1],
+                          "agents": [{"peer_id": "a", "pos": [1, 1],
+                                      "goal": [5, 5]}]})
+    assert resp["tc"][0] == 888 and resp["tc"][1] == 2
+
+
+def test_tick_runner_kill_switch_suppresses_response_ctx(monkeypatch):
+    monkeypatch.setenv("JG_TRACE_CTX", "0")
+    from p2p_distributed_tswap_tpu.core.grid import Grid
+    from p2p_distributed_tswap_tpu.runtime.solverd import (PlanService,
+                                                           TickRunner)
+
+    grid = Grid.default()
+    runner = TickRunner(PlanService(grid, capacity_min=4), grid)
+    enc = pc.PackedFleetEncoder()
+    pkt = enc.encode_tick(1, [("a", 5, 9)])
+    pkt.trace = pc.TraceCtx(trace_id=777, hop=1, send_ms=1)
+    resp = runner.handle({"type": "plan_request", "seq": 1,
+                          "codec": pc.CODEC_NAME, "caps": [pc.CODEC_NAME],
+                          "base_seq": 0, "data": pc.encode_b64(pkt)})
+    assert pc.decode_b64(resp["data"]).trace is None

@@ -397,3 +397,38 @@ def test_region_crossing_resubscribe_no_missed_beacons(busd):
         f"missed neighbor beacons across the border: got {received}")
     walker.close()
     publisher.close()
+
+
+def test_pos1_trace_ext_round_trip_and_golden():
+    """ISSUE 5: the pos1 trace1 block (busy-claim heartbeats carry their
+    task's causal context) round-trips in python, is byte-identical to the
+    native encoder, and decodes back identically; packets without it are
+    byte-identical to the pre-trace1 wire."""
+    import json as _json
+
+    from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+
+    tc = pc.TraceCtx(trace_id=(1 << 45) | 99, hop=7,
+                     send_ms=1_754_200_333_444)
+    plain = pc.encode_pos1(100, 200, 55)
+    traced = pc.encode_pos1(100, 200, 55, tc)
+    assert len(traced) == len(plain) + 20
+    assert pc.decode_pos1_full(traced) == (100, 200, 55, tc)
+    assert pc.decode_pos1(traced) == (100, 200, 55)  # legacy 3-tuple view
+    assert pc.decode_pos1_full(plain)[3] is None
+    with pytest.raises(pc.CodecError):
+        pc.decode_pos1(traced[:-1])
+
+    binary = golden_binary()
+    feed = _json.dumps({"pos": 100, "goal": 200, "task": 55,
+                        "trace": [tc.trace_id, tc.hop, tc.send_ms]}) + "\n"
+    out = subprocess.run([str(binary), "--pos1-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert out.stdout.strip() == pc.encode_pos1_b64(100, 200, 55, tc)
+    out = subprocess.run([str(binary), "--pos1-decode"],
+                         input=out.stdout, capture_output=True, text=True,
+                         check=True, timeout=120)
+    decoded = _json.loads(out.stdout)
+    assert decoded == {"pos": 100, "goal": 200, "task": 55,
+                       "trace": [tc.trace_id, tc.hop, tc.send_ms]}
