@@ -15,6 +15,14 @@ fast decision tick.  Three variants, worst first:
 - ``region``     — the defaults: pos1 beacons on mapd.pos.<rx>.<ry> region
   topics, 3x3 neighborhood subscriptions, manager on the wildcard.
 
+``--shards`` (ISSUE 6) sweeps the FEDERATED BUS POOL on top of the region
+variant: ``--shards 1,3`` runs the single hub and a 3-shard pool on
+identical traffic.  Pool rows carry aggregate AND per-shard numbers
+(fanout, CPU, peering traffic) from each shard's own beacon
+(peer "busd-s<i>"), plus summed /proc CPU across the pool — the
+acceptance metric is aggregate hub CPU per message and per-shard peak
+fanout vs the single-hub baseline, at no tasks/s regression.
+
 All numbers come from the processes' own ``mapd.metrics`` beacons (busd's
 per-topic ``bus.fanout_msgs/bytes`` registry counters, diffed across the
 measurement window) plus busd's /proc CPU clock — no instrumentation is
@@ -47,6 +55,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 from p2p_distributed_tswap_tpu.core.config import RuntimeConfig  # noqa: E402
 from p2p_distributed_tswap_tpu.runtime import region  # noqa: E402
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime.buspool import (  # noqa: E402
+    free_port as _free_port)
 from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
     Fleet, ensure_built)
 
@@ -55,14 +65,6 @@ VARIANTS = {
     "flat": {"JG_REGION_GOSSIP": "0"},
     "region": {},
 }
-
-
-def _free_port():
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _proc_cpu_s(pid: int) -> float:
@@ -91,7 +93,12 @@ class BeaconWatch:
                 continue
             d = f.get("data") or {}
             if d.get("type") == "metrics_beacon":
-                self.samples.setdefault(d.get("proc"), []).append(
+                # busd pool members are distinct peers ("busd-s<i>"): key
+                # them by peer_id so per-shard windows don't interleave
+                key = d.get("proc")
+                if key == "busd":
+                    key = d.get("peer_id") or key
+                self.samples.setdefault(key, []).append(
                     (time.monotonic(), d.get("metrics") or {}))
 
     def window(self, proc: str):
@@ -99,6 +106,10 @@ class BeaconWatch:
         if len(s) < 2:
             return None
         return s[0][1], s[-1][1]
+
+    def busd_keys(self):
+        """Every busd peer seen ("busd" single hub / "busd-s<i>" pool)."""
+        return sorted(k for k in self.samples if str(k).startswith("busd"))
 
     def close(self):
         self.bus.close()
@@ -145,18 +156,43 @@ def _sample_pos_share(port: int, seconds: float) -> dict:
     return by
 
 
-def run_variant(variant: str, args, map_file: str, tick_ms: int) -> dict:
+def _pool_cpu_s(pids) -> float:
+    """Summed utime+stime across the busd pool (a dead pid counts 0)."""
+    total = 0.0
+    for pid in pids:
+        try:
+            total += _proc_cpu_s(pid)
+        except (OSError, IndexError, ValueError):
+            pass
+    return total
+
+
+def _busd_delta(watch: BeaconWatch, name: str, **kw) -> float:
+    """Counter delta summed across every busd pool member's window."""
+    total = 0.0
+    for key in watch.busd_keys():
+        win = watch.window(key)
+        if win:
+            total += _counter_delta(win[0], win[1], name, **kw)
+    return total
+
+
+def run_variant(variant: str, args, map_file: str, tick_ms: int,
+                shards: int = 1) -> dict:
     port = _free_port()
     env = dict(VARIANTS[variant])
     env["JG_REGION_CELLS"] = str(args.region_cells)
+    if shards > 1:
+        env["JG_BUS_SHARDS"] = str(shards)
     cfg = RuntimeConfig(decision_interval_ms=tick_ms)
-    log_dir = Path(args.log_dir) / f"{variant}_{args.agents}_{tick_ms}"
+    log_dir = Path(args.log_dir) \
+        / f"{variant}_s{shards}_{args.agents}_{tick_ms}"
     watch = None
     with Fleet("decentralized", num_agents=args.agents, port=port,
                map_file=map_file, log_dir=str(log_dir), env=env,
-               config=cfg) as fleet:
+               config=cfg, bus_shards=shards) as fleet:
         try:
-            busd_pid = fleet.procs[0].pid
+            busd_pids = [p.pid for p in fleet.bus_pool.procs]
             time.sleep(3 + args.agents * 0.05)  # discovery + initial pos
             fleet.command(f"tasks {args.agents}")
             watch = BeaconWatch(port)
@@ -174,7 +210,7 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int) -> dict:
             if variant != "region":
                 pos_share = _sample_pos_share(port, 2.0)
             watch.samples.clear()
-            cpu0 = _proc_cpu_s(busd_pid)
+            cpu0 = _pool_cpu_s(busd_pids)
             t0 = time.monotonic()
             t_end = t0 + args.window
             while time.monotonic() < t_end:
@@ -182,10 +218,11 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int) -> dict:
                 if time.monotonic() >= next_tasks:
                     next_tasks = time.monotonic() + 3.0
                     fleet.command(f"tasks {args.agents}")
-            cpu1 = _proc_cpu_s(busd_pid)
+            cpu1 = _pool_cpu_s(busd_pids)
             wall = time.monotonic() - t0
-            win = watch.window("busd")
-            if win is None:
+            busd_keys = [k for k in watch.busd_keys()
+                         if watch.window(k) is not None]
+            if not busd_keys:
                 # the fleet collapsed under this wire (e.g. the flat JSON
                 # broadcast at 50 agents / 20 ms saturates the host: the
                 # scheduler starves even the hub's 2 s beacon) — that IS
@@ -194,6 +231,7 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int) -> dict:
                 fleet.quit()
                 return {
                     "variant": variant,
+                    "shards": shards,
                     "agents": args.agents,
                     "tick_ms": tick_ms,
                     "window_s": round(wall, 1),
@@ -202,17 +240,17 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int) -> dict:
                     "note": "no busd beacons landed in the window; fleet "
                             "unsustainable at this rung on this host",
                 }
-            first, last = win
-            fan_msgs = _counter_delta(first, last, "bus.fanout_msgs")
-            fan_bytes = _counter_delta(first, last, "bus.fanout_bytes")
+            fan_msgs = _busd_delta(watch, "bus.fanout_msgs")
+            fan_bytes = _busd_delta(watch, "bus.fanout_bytes")
             if variant == "region":
-                pos_fan_bytes = _counter_delta(
-                    first, last, "bus.fanout_bytes",
+                pos_fan_bytes = _busd_delta(
+                    watch, "bus.fanout_bytes",
                     topic_prefix=region.POS_TOPIC_PREFIX)
-                pos_fan_msgs = _counter_delta(
-                    first, last, "bus.fanout_msgs",
+                pos_fan_msgs = _busd_delta(
+                    watch, "bus.fanout_msgs",
                     topic_prefix=region.POS_TOPIC_PREFIX)
             else:
+                first, last = watch.window(busd_keys[0])
                 share = pos_share["pos_byte_share"]
                 pos_fan_bytes = _counter_delta(
                     first, last, "bus.fanout_bytes", topic="mapd") * share
@@ -231,6 +269,7 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int) -> dict:
                     - (h0 or {}).get("count", 0)
             row = {
                 "variant": variant,
+                "shards": shards,
                 "agents": args.agents,
                 "tick_ms": tick_ms,
                 "window_s": round(wall, 1),
@@ -242,10 +281,31 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int) -> dict:
                 "busd_cpu_pct": round(100 * (cpu1 - cpu0) / wall, 1),
                 "busd_cpu_us_per_msg": round(
                     1e6 * (cpu1 - cpu0) / max(fan_msgs, 1), 2),
-                "slow_consumer_drops": int(_counter_delta(
-                    first, last, "bus.slow_consumer_drops")),
+                "slow_consumer_drops": int(_busd_delta(
+                    watch, "bus.slow_consumer_drops")),
                 "tasks_done_in_window": int(tasks_done),
             }
+            if shards > 1:
+                # per-shard breakdown: peak fanout (the new headroom
+                # metric), CPU share, and the peering tax
+                per_shard = {}
+                for key in busd_keys:
+                    w = watch.window(key)
+                    per_shard[key] = {
+                        "fanout_kb_per_s": round(_counter_delta(
+                            w[0], w[1], "bus.fanout_bytes") / wall / 1024,
+                            1),
+                        "fanout_msgs_per_s": round(_counter_delta(
+                            w[0], w[1], "bus.fanout_msgs") / wall, 1),
+                        "peer_rx_msgs_per_s": round(_counter_delta(
+                            w[0], w[1], "bus.peer_rx_msgs") / wall, 1),
+                        "peer_tx_msgs_per_s": round(_counter_delta(
+                            w[0], w[1], "bus.peer_tx_msgs") / wall, 1),
+                    }
+                row["per_shard"] = per_shard
+                row["peak_shard_fanout_kb_per_s"] = max(
+                    (v["fanout_kb_per_s"] for v in per_shard.values()),
+                    default=0.0)
             if pos_share is not None:
                 row["pos_byte_share_sampled"] = pos_share["pos_byte_share"]
             fleet.quit()
@@ -268,6 +328,10 @@ def main():
                     help="JG_REGION_CELLS for the fleet (16 matches the "
                          "radius-15 view on a 100² map)")
     ap.add_argument("--variants", default="flat-json,flat,region")
+    ap.add_argument("--shards", default="1",
+                    help="busd pool sizes to sweep on the region variant "
+                         "(comma list, e.g. 1,3); the flat variants always "
+                         "run the single hub")
     ap.add_argument("--settle", type=float, default=8.0)
     ap.add_argument("--window", type=float, default=20.0)
     ap.add_argument("--log-dir", default="/tmp/bus_scaling_logs")
@@ -279,17 +343,23 @@ def main():
     Path(map_file).write_text(
         "\n".join(["." * args.side] * args.side) + "\n")
 
+    shard_sweep = [int(s) for s in args.shards.split(",")]
     rows = []
     for tick_ms in [int(t) for t in args.ticks.split(",")]:
         for variant in args.variants.split(","):
-            row = run_variant(variant, args, map_file, tick_ms)
-            rows.append(row)
-            print(json.dumps(row), flush=True)
-            time.sleep(2)  # let the previous fleet's ports drain
+            # the shard sweep applies to the region variant (the pool
+            # routes region topics); flat variants stay single-hub
+            for shards in (shard_sweep if variant == "region" else [1]):
+                row = run_variant(variant, args, map_file, tick_ms, shards)
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+                time.sleep(2)  # let the previous fleet's ports drain
 
     by_tick = {}
     for r in rows:
-        by_tick.setdefault(r["tick_ms"], {})[r["variant"]] = r
+        key = r["variant"] if r.get("shards", 1) <= 1 \
+            else f"{r['variant']}-s{r['shards']}"
+        by_tick.setdefault(r["tick_ms"], {})[key] = r
     result = {
         "experiment": "live-fleet bus fanout: region gossip + pos1 + busd "
                       "fast path vs the flat JSON wire",
@@ -326,28 +396,52 @@ def main():
                                  by["flat"]["busd_cpu_us_per_msg"]]
     if ratios:
         result["pos_fanout_bytes_ratio_flatjson_over_region"] = ratios
+    # shard-pool vs single-hub comparison at each rung (ISSUE 6
+    # acceptance: aggregate CPU/msg and per-shard peak fanout improve,
+    # tasks/s holds)
+    for tick_ms, by in sorted(by_tick.items()):
+        single = by.get("region", {})
+        for key, r in by.items():
+            if r.get("shards", 1) <= 1 or r.get("collapsed") \
+                    or single.get("collapsed") or not single:
+                continue
+            cmp = {
+                "busd_cpu_us_per_msg": [single.get("busd_cpu_us_per_msg"),
+                                        r.get("busd_cpu_us_per_msg")],
+                "peak_shard_fanout_kb_per_s": [
+                    single.get("relayed_kb_per_s"),
+                    r.get("peak_shard_fanout_kb_per_s")],
+                "tasks_done_in_window": [
+                    single.get("tasks_done_in_window"),
+                    r.get("tasks_done_in_window")],
+            }
+            result.setdefault("pool_vs_single_hub", {}).setdefault(
+                str(tick_ms), {})[key] = cmp
     print(json.dumps(result), flush=True)
     if args.out:
         Path(args.out).parent.mkdir(parents=True, exist_ok=True)
         Path(args.out).write_text(json.dumps(result, indent=2))
-        md = ["| variant | tick | relayed msg/s | relayed KB/s "
+        md = ["| variant | shards | tick | relayed msg/s | relayed KB/s "
               "| pos B/peer/s | busd CPU % | CPU µs/msg | drops "
-              "| tasks done |",
-              "|---|---|---|---|---|---|---|---|---|"]
+              "| tasks done | peak shard KB/s |",
+              "|---|---|---|---|---|---|---|---|---|---|---|"]
         for r in rows:
             if r.get("collapsed"):
-                md.append(f"| {r['variant']} | {r['tick_ms']} ms | "
+                md.append(f"| {r['variant']} | {r.get('shards', 1)} | "
+                          f"{r['tick_ms']} ms | "
                           f"COLLAPSED (fleet unsustainable) | | | "
-                          f"{r['busd_cpu_pct']} | | | 0 |")
+                          f"{r['busd_cpu_pct']} | | | 0 | |")
                 continue
             md.append(
-                f"| {r['variant']} | {r['tick_ms']} ms | "
+                f"| {r['variant']} | {r.get('shards', 1)} | "
+                f"{r['tick_ms']} ms | "
                 f"{r['relayed_msgs_per_s']} | "
                 f"{r['relayed_kb_per_s']} | "
                 f"{r['pos_fanout_bytes_per_peer_per_s']} | "
                 f"{r['busd_cpu_pct']} | {r['busd_cpu_us_per_msg']} | "
                 f"{r['slow_consumer_drops']} | "
-                f"{r['tasks_done_in_window']} |")
+                f"{r['tasks_done_in_window']} | "
+                f"{r.get('peak_shard_fanout_kb_per_s', '')} |")
         for tick, ratio in (result.get(
                 "pos_fanout_bytes_ratio_flatjson_over_region") or {}).items():
             md.append(f"\nper-peer position fanout bytes at {tick} ms: "

@@ -54,8 +54,11 @@ def render(rollup: dict) -> str:
         t, c, k = p["tick"], p["cache"], p["tasks"]
         bw = p["bandwidth"]
         age = f"{p['age_s']:.0f}s" + ("!" if p["stale"] else "")
+        proc = p["proc"]
+        if p.get("shard") is not None:  # busd pool member (ISSUE 6)
+            proc = f"{proc}[s{p['shard']}]"
         lines.append(
-            f"{peer[:28]:<28} {p['proc'][:20]:<20} {age:>5} "
+            f"{peer[:28]:<28} {proc[:20]:<20} {age:>5} "
             f"{_fmt(t and t['p50_ms'], '.1f'):>8} "
             f"{_fmt(t and t['p95_ms'], '.1f'):>8} "
             f"{_fmt(t and t['over_budget']):>5} "
@@ -64,6 +67,21 @@ def render(rollup: dict) -> str:
             f"{_fmt(c and c['recompiles']):>6} "
             f"{_fmt(k and k['completed']):>6} "
             f"{_fmt(k and k['latency_p95_ms'], '.0f'):>8}")
+    # per-shard bus health (busd rows with a `bus` section): relay
+    # fanout, queue depth, peering links + traffic — the live view of
+    # each pool member's load
+    bus_rows = [(peer, p) for peer, p in rollup["peers"].items()
+                if p.get("bus")]
+    if bus_rows:
+        lines.append("BUS " + " | ".join(
+            f"{(('s' + str(p['shard'])) if p.get('shard') is not None else peer)}:"
+            f" {p['bus']['fanout_kbps']:.0f}kbps"
+            f" q={p['bus']['queued_bytes']}B"
+            f" cl={p['bus']['clients']}"
+            f" links={p['bus']['peer_links']}"
+            f" peer rx/tx={p['bus']['peer_rx_msgs']}/{p['bus']['peer_tx_msgs']}"
+            f" drops={p['bus']['slow_consumer_drops']}"
+            for peer, p in bus_rows))
     return "\n".join(lines)
 
 

@@ -239,8 +239,10 @@ int main(int argc, char** argv) {
 
   int64_t last_broadcast = mono_ms();
   while (!g_stop && bus.connected()) {
-    pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
-    poll(&pfd, 1, 200);
+    // poll every shard link (a pool spreads region beacons across fds)
+    std::vector<pollfd> pfds;
+    bus.append_pollfds(pfds);
+    poll(pfds.data(), static_cast<nfds_t>(pfds.size()), 200);
 
     bool alive = bus.pump([&](const BusClient::Msg& m) {
       const Json& d = m.data;

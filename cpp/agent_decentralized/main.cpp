@@ -172,8 +172,9 @@ int main(int argc, char** argv) {
     bus.publish("mapd", req);
     int64_t deadline = mono_ms() + 2000;
     while (mono_ms() < deadline && !g_stop) {
-      pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
-      poll(&pfd, 1, 100);
+      std::vector<pollfd> pfds;
+      bus.append_pollfds(pfds);
+      poll(pfds.data(), static_cast<nfds_t>(pfds.size()), 100);
       bus.pump([&](const BusClient::Msg& m) {
         const Json& d = m.data;
         if (d["type"].as_str() != "occupied_response") return;
@@ -478,11 +479,14 @@ int main(int argc, char** argv) {
   bus.set_reconnect([&]() { publish_position(); });
 
   while (!g_stop && bus.connected()) {
-    pollfd pfd{bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0};
+    // poll every shard link (a pool spreads region beacons across fds)
+    std::vector<pollfd> pfds;
+    bus.append_pollfds(pfds);
     int64_t now = mono_ms();
     int timeout = static_cast<int>(
         std::max<int64_t>(0, last_tick + args.tick_ms - now));
-    poll(&pfd, 1, std::min(timeout, 100));
+    poll(pfds.data(), static_cast<nfds_t>(pfds.size()),
+         std::min(timeout, 100));
 
     bool alive = bus.pump([&](const BusClient::Msg& m) {
       const Json& d = m.data;

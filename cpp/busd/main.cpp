@@ -30,6 +30,32 @@
 //   (managers use `mapd.pos.*` to see every region beacon without
 //   enumerating regions).
 //
+// Federated shard pool (ISSUE 6): one busd remains the fleet's throughput
+// ceiling and single point of failure, so the bus itself shards.  A pool
+// member runs with `--shard i --shards n --peers <port,port,...>` (the
+// full pool port list, index = shard id; runtime/buspool.py spawns it):
+//
+// - Topic ownership is the deterministic shardmap
+//   (cpp/common/shardmap.hpp ≡ runtime/shardmap.py): region position
+//   topics spread across all shards, the control plane lives on the HOME
+//   shard (0).  Shard-aware clients (caps `shard1`) route subs and
+//   publishes to the owning shard themselves.
+// - busd↔busd peering.  The higher-index shard initiates one TCP link to
+//   every lower-index shard (hello caps `["relay1","peer1"]`); links ride
+//   the relay fast path (M-frames, refcounted renderings, writev).
+//   Peering is interest-scoped: a shard subscribes a topic over its links
+//   only while it has >= 1 LOCAL subscriber for it, so cross-shard
+//   traffic is bounded by actual interest, not the pool size.
+// - Loop prevention: a frame that ARRIVED over a peer link is delivered
+//   to local clients only — never re-forwarded to another peer link.
+//   Every pair of shards has a direct link and subscriptions propagate on
+//   all links, so one hop always suffices; a frame can never loop or
+//   duplicate.  (Shard-aware clients whose wildcard subscription spans
+//   every shard are also skipped for peer-forwarded frames — they already
+//   saw the frame at its origin shard.)
+// - `JG_BUS_SHARDS=1` (the default) is the kill switch: no peers, no new
+//   caps, byte-identical single-hub wire.
+//
 // Usage: mapd_bus [port]           (default 7400)
 
 #include <limits.h>
@@ -53,6 +79,7 @@
 #include "../common/metrics.hpp"
 #include "../common/net.hpp"
 #include "../common/region.hpp"  // kPosTopicPrefix (droppable beacons)
+#include "../common/shardmap.hpp"
 
 using namespace mapd;
 
@@ -66,13 +93,34 @@ struct OutFrame {
 struct Client {
   LineConn conn;  // input framing only; output goes through the queue
   std::string peer_id;
-  bool fast = false;  // advertised caps:["relay1"] in hello
+  bool fast = false;   // advertised caps:["relay1"] in hello
+  bool shard1 = false;  // shard-aware client: routes its own subs/pubs
+  bool is_peer = false;  // busd↔busd peering link (caps:["peer1"])
+  int peer_shard = -1;   // shard index of the remote busd (peer links)
   std::set<std::string> topics;
   std::set<std::string> prefixes;  // from "<prefix>.*" subscriptions
+  // prefix subs by a shard1 client that span EVERY shard (e.g. the
+  // manager's "mapd.pos.*"): peer-forwarded frames skip these — the
+  // client already receives them at the origin shard
+  std::set<std::string> span_prefixes;
   std::deque<OutFrame> outq;
   size_t out_bytes = 0;   // total queued
   size_t front_off = 0;   // bytes of outq.front() already written
   explicit Client(int fd) : conn(fd) {}
+};
+
+// One outbound peer link slot (this shard initiates to every lower
+// shard index); reconnects with backoff like a BusClient.  Dials are
+// NONBLOCKING (EINPROGRESS + POLLOUT): the relay loop must never stall
+// behind a SYN-dropping dead peer host.
+struct PeerSlot {
+  int shard = -1;
+  uint16_t port = 0;
+  int fd = -1;          // live Client in the clients map, or -1
+  int pending_fd = -1;  // nonblocking connect in flight, or -1
+  int64_t pending_since_ms = 0;
+  int64_t backoff_ms = 0;
+  int64_t next_attempt_ms = 0;
 };
 
 volatile sig_atomic_t g_stop = 0;
@@ -100,6 +148,33 @@ int main(int argc, char** argv) {
   // agents on other hosts can reach the hub (RUN_INSTRUCTIONS cross-host)
   const std::string bind_addr =
       knobs.get_str("--bind", "MAPD_BUS_BIND", "127.0.0.1");
+  // Federated pool membership (ISSUE 6): my shard index, the pool size,
+  // and the full pool port list for the peering links.
+  const int my_shard = static_cast<int>(
+      knobs.get_int("--shard", "JG_BUS_SHARD_INDEX", 0));
+  const int num_shards = static_cast<int>(
+      knobs.get_int("--shards", "JG_BUS_SHARDS", 1));
+  const std::string peers_spec =
+      knobs.get_str("--peers", shardmap::kShardPortsEnv, "");
+  const std::string peer_host =
+      knobs.get_str("--peer-host", "JG_BUS_PEER_HOST", "127.0.0.1");
+  const std::string my_peer_id =
+      num_shards > 1 ? "busd-s" + std::to_string(my_shard) : "busd";
+  std::vector<uint16_t> pool_ports;
+  if (num_shards > 1) {
+    if (my_shard < 0 || my_shard >= num_shards) {
+      fprintf(stderr, "mapd_bus: --shard %d out of range for --shards %d\n",
+              my_shard, num_shards);
+      return 1;
+    }
+    pool_ports = shardmap::parse_shard_ports(peers_spec);
+    if (static_cast<int>(pool_ports.size()) != num_shards) {
+      fprintf(stderr,
+              "mapd_bus: --shards %d but --peers lists %zu port(s)\n",
+              num_shards, pool_ports.size());
+      return 1;
+    }
+  }
   // Fault injection for protocol tests: silently drop the first
   // `drop_count` published frames whose data `type` equals `drop_type`
   // (e.g. sever the swap_response of a task exchange to prove the
@@ -129,7 +204,7 @@ int main(int argc, char** argv) {
   // flight recorder (ISSUE 5): the hub's black box records membership
   // churn and slow-consumer actions — the fleet-side context for any
   // incident blackbox.py reconstructs
-  events_init("busd");
+  events_init(my_peer_id.c_str());
 
   int listen_fd = tcp_listen(port, bind_addr);
   if (listen_fd < 0) {
@@ -138,12 +213,34 @@ int main(int argc, char** argv) {
     return 1;
   }
   set_nonblocking(listen_fd);
-  log_info("mapd_bus listening on %s:%u\n", bind_addr.c_str(), port);
+  log_info("mapd_bus listening on %s:%u%s\n", bind_addr.c_str(), port,
+           num_shards > 1
+               ? (" (shard " + std::to_string(my_shard) + "/" +
+                  std::to_string(num_shards) + ")").c_str()
+               : "");
 
   std::map<int, std::unique_ptr<Client>> clients;
   std::map<std::string, std::set<int>> subs_exact;  // topic -> fds
   std::vector<std::pair<std::string, int>> subs_prefix;  // (prefix, fd)
   std::set<int> evict;  // hard-limit overflows, reaped with the dead list
+
+  // Interest-scoped peering: refcounts of LOCAL (non-peer) subscribers
+  // per exact topic / prefix.  A topic is subscribed over the peer links
+  // exactly while some local client wants it, so cross-shard traffic is
+  // bounded by interest, not pool size.  (Prefixes propagate in their
+  // wildcard form "<prefix>*".)
+  std::map<std::string, int> local_exact_refs;
+  std::map<std::string, int> local_prefix_refs;
+
+  // Outbound peer links: this shard initiates to every LOWER shard index
+  // (one TCP per pair pool-wide); inbound links arrive from higher ones.
+  std::vector<PeerSlot> peer_slots;
+  for (int j = 0; num_shards > 1 && j < my_shard; ++j) {
+    PeerSlot slot;  // field defaults (fd/pending_fd = -1) are the truth
+    slot.shard = j;
+    slot.port = pool_ports[static_cast<size_t>(j)];
+    peer_slots.push_back(slot);
+  }
 
   auto enqueue = [&](Client& c, int fd,
                      const std::shared_ptr<const std::string>& frame,
@@ -214,21 +311,43 @@ int main(int argc, char** argv) {
     return true;
   };
 
+  // Send a control line (sub/unsub/hello) on a peer link.
+  auto peer_send = [&](Client& c, int fd, const std::string& line) {
+    enqueue(c, fd, std::make_shared<const std::string>(line + "\n"), false);
+  };
+
+  // Propagate a local-interest change to every live peer link.
+  auto peers_sub = [&](const std::string& wire_topic, bool sub) {
+    if (num_shards <= 1) return;
+    Json j;
+    j.set("op", sub ? "sub" : "unsub").set("topic", wire_topic);
+    const std::string line = j.dump();
+    for (auto& [fd, c] : clients)
+      if (c->is_peer) peer_send(*c, fd, line);
+  };
+
   // Fan a payload out to `topic`'s subscribers.  `raw` is the payload
   // text (valid JSON from well-behaved peers) — NEVER parsed here; the
   // two wire renderings are built lazily, at most once each, and the
   // same buffer is shared by every recipient's queue.
+  // `from_peer`: the frame arrived over a busd↔busd link — deliver to
+  // LOCAL clients only (never re-forward to another peer link: one hop
+  // always suffices in the full mesh, and this is what makes loops
+  // impossible), and skip shard-aware clients whose matching wildcard
+  // spans every shard (they already saw it at the origin shard).
   auto relay_payload = [&](const std::string& topic, const std::string& from,
-                           const std::string& raw, int except_fd) {
+                           const std::string& raw, int except_fd,
+                           bool from_peer) {
     std::shared_ptr<const std::string> fast, legacy;
     const bool droppable = droppable_topic(topic);
     int fanout = 0;
     double fanout_bytes = 0;
-    auto deliver = [&](int fd) {
+    auto deliver = [&](int fd, bool via_span_prefix) {
       auto it = clients.find(fd);
       if (it == clients.end()) return;
       Client& c = *it->second;
       if (fd == except_fd || c.peer_id.empty()) return;
+      if (from_peer && (c.is_peer || (c.shard1 && via_span_prefix))) return;
       const auto& frame = c.fast
           ? (fast ? fast
                   : (fast = std::make_shared<const std::string>(
@@ -241,16 +360,25 @@ int main(int argc, char** argv) {
       enqueue(c, fd, frame, droppable);
       ++fanout;
       fanout_bytes += static_cast<double>(frame->size());
+      if (c.is_peer) {
+        metrics_count("bus.peer_tx_msgs");
+        metrics_count("bus.peer_tx_bytes",
+                      static_cast<double>(frame->size()));
+      }
     };
     auto ex = subs_exact.find(topic);
     if (ex != subs_exact.end())
-      for (int fd : ex->second) deliver(fd);
+      for (int fd : ex->second) deliver(fd, false);
     std::set<int> seen;  // exact + overlapping prefixes: one frame per fd
     for (const auto& [prefix, fd] : subs_prefix)
       if (topic.compare(0, prefix.size(), prefix) == 0 &&
           (ex == subs_exact.end() || !ex->second.count(fd)) &&
-          seen.insert(fd).second)
-        deliver(fd);
+          seen.insert(fd).second) {
+        auto it = clients.find(fd);
+        const bool span = it != clients.end() &&
+                          it->second->span_prefixes.count(prefix) > 0;
+        deliver(fd, span);
+      }
     // hub-side fan-out accounting (actual wire bytes incl. framing);
     // rides the busd metrics beacon into the fleet rollup
     if (fanout) {
@@ -261,27 +389,44 @@ int main(int argc, char** argv) {
   };
 
   // Control frames (welcome / peers / peer_joined / peer_left) stay JSON
-  // on both wires; `topic` routes them ("" = every client).
+  // on both wires; `topic` routes them ("" = every client).  Peer links
+  // never receive them — discovery is per-shard (the control plane meets
+  // on the home shard, where every fleet member subscribes).
   auto broadcast_control = [&](const Json& frame, const std::string& topic,
                                int except_fd) {
     auto line = std::make_shared<const std::string>(frame.dump() + "\n");
     for (auto& [fd, c] : clients) {
-      if (fd == except_fd || c->peer_id.empty()) continue;
+      if (fd == except_fd || c->peer_id.empty() || c->is_peer) continue;
       if (!topic.empty() && !c->topics.count(topic)) continue;
       enqueue(*c, fd, line, false);
     }
   };
 
   // The hub beacons its own registry too (same schema as every BusClient):
-  // fan-out volume per topic + connected-client gauge, as peer "busd".
+  // fan-out volume per topic + connected-client gauge, as peer "busd"
+  // (single hub) / "busd-s<i>" (pool member, `shard` field on the payload
+  // so the fleet aggregator renders per-shard rows).
   int64_t next_beacon_ms = 0;
   auto maybe_beacon = [&]() {
     int64_t now = mono_ms();
     if (now < next_beacon_ms) return;
     next_beacon_ms = now + 2000;
-    metrics_gauge("bus.clients", static_cast<double>(clients.size()));
-    relay_payload("mapd.metrics", "busd",
-                  make_metrics_beacon("busd", "busd", 2.0).dump(), -1);
+    size_t queued = 0;
+    size_t live_peers = 0;
+    for (auto& [fd, c] : clients) {
+      queued += c->out_bytes;
+      if (c->is_peer) ++live_peers;
+    }
+    metrics_gauge("bus.clients",
+                  static_cast<double>(clients.size() - live_peers));
+    metrics_gauge("bus.queued_bytes", static_cast<double>(queued));
+    if (num_shards > 1)
+      metrics_gauge("bus.peer_links", static_cast<double>(live_peers));
+    Json b = make_metrics_beacon(my_peer_id, "busd", 2.0);
+    if (num_shards > 1)
+      b.set("shard", static_cast<int64_t>(my_shard))
+          .set("shards", static_cast<int64_t>(num_shards));
+    relay_payload("mapd.metrics", my_peer_id, b.dump(), -1, false);
   };
 
   auto drop_subs = [&](int fd, Client& c) {
@@ -291,12 +436,139 @@ int main(int argc, char** argv) {
         it->second.erase(fd);
         if (it->second.empty()) subs_exact.erase(it);
       }
+      if (!c.is_peer && --local_exact_refs[t] <= 0) {
+        local_exact_refs.erase(t);
+        peers_sub(t, false);
+      }
     }
     for (auto it = subs_prefix.begin(); it != subs_prefix.end();)
       it = (it->second == fd) ? subs_prefix.erase(it) : std::next(it);
+    if (!c.is_peer)
+      for (const auto& p : c.prefixes) {
+        if (c.span_prefixes.count(p)) continue;  // never counted
+        if (--local_prefix_refs[p] <= 0) {
+          local_prefix_refs.erase(p);
+          peers_sub(p + "*", false);
+        }
+      }
+  };
+
+  // Register an ESTABLISHED outbound peer link: hello + replay of every
+  // current local interest (the interest-scoped subscriptions).
+  auto arm_peer_link = [&](PeerSlot& slot, int fd) {
+    set_nonblocking(fd);
+    if (sndbuf_kb > 0) {
+      int v = sndbuf_kb * 1024;
+      setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &v, sizeof(v));
+    }
+    auto c = std::make_unique<Client>(fd);
+    c->peer_id = "busd-s" + std::to_string(slot.shard);
+    c->is_peer = true;
+    c->fast = true;  // both ends are relay1 by construction
+    c->peer_shard = slot.shard;
+    Client& ref = *c;
+    clients.emplace(fd, std::move(c));
+    slot.fd = fd;
+    slot.backoff_ms = 0;
+    Json hello;
+    Json caps;
+    caps.push_back(Json("relay1"));
+    caps.push_back(Json("peer1"));
+    hello.set("op", "hello")
+        .set("peer_id", my_peer_id)
+        .set("caps", caps)
+        .set("shard", static_cast<int64_t>(my_shard));
+    peer_send(ref, fd, hello.dump());
+    for (const auto& [t, refs] : local_exact_refs)
+      if (refs > 0) {
+        Json j;
+        j.set("op", "sub").set("topic", t);
+        peer_send(ref, fd, j.dump());
+      }
+    for (const auto& [p, refs] : local_prefix_refs)
+      if (refs > 0) {
+        Json j;
+        j.set("op", "sub").set("topic", p + "*");
+        peer_send(ref, fd, j.dump());
+      }
+    metrics_count("bus.peer_connects");
+    log_info("🔗 peer link up to shard %d (port %u)\n", slot.shard,
+             slot.port);
+  };
+
+  // Backoff-paced outbound peering maintenance.  Dials are nonblocking
+  // — connect() returns EINPROGRESS and completion is observed via
+  // POLLOUT + SO_ERROR on later wakeups (the pending fd rides the main
+  // poll set), so an unreachable peer host can never freeze the relay
+  // loop; a dead shard degrades its topics, not the pool.
+  auto peer_dial_failed = [&](PeerSlot& slot, int64_t now) {
+    slot.backoff_ms = slot.backoff_ms
+                          ? std::min<int64_t>(slot.backoff_ms * 2, 4000)
+                          : 250;
+    slot.next_attempt_ms = now + slot.backoff_ms;
+  };
+  auto maintain_peer_links = [&]() {
+    int64_t now = mono_ms();
+    for (auto& slot : peer_slots) {
+      if (slot.fd >= 0) continue;
+      if (slot.pending_fd >= 0) {
+        // connect in flight: zero-timeout progress check
+        pollfd p{slot.pending_fd, POLLOUT, 0};
+        if (poll(&p, 1, 0) > 0 &&
+            (p.revents & (POLLOUT | POLLERR | POLLHUP))) {
+          int err = 0;
+          socklen_t len = sizeof(err);
+          getsockopt(slot.pending_fd, SOL_SOCKET, SO_ERROR, &err, &len);
+          const int fd = slot.pending_fd;
+          slot.pending_fd = -1;
+          if (err == 0) {
+            int one = 1;
+            setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            arm_peer_link(slot, fd);
+          } else {
+            close(fd);
+            peer_dial_failed(slot, now);
+          }
+        } else if (now - slot.pending_since_ms > 1000) {
+          close(slot.pending_fd);
+          slot.pending_fd = -1;
+          peer_dial_failed(slot, now);
+        }
+        continue;
+      }
+      if (now < slot.next_attempt_ms) continue;
+      int fd = socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) {
+        peer_dial_failed(slot, now);
+        continue;
+      }
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(slot.port);
+      if (inet_pton(AF_INET, peer_host.c_str(), &addr.sin_addr) != 1) {
+        close(fd);
+        peer_dial_failed(slot, now);
+        continue;
+      }
+      set_nonblocking(fd);
+      int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                       sizeof(addr));
+      if (rc == 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        arm_peer_link(slot, fd);
+      } else if (errno == EINPROGRESS) {
+        slot.pending_fd = fd;
+        slot.pending_since_ms = now;
+      } else {
+        close(fd);
+        peer_dial_failed(slot, now);
+      }
+    }
   };
 
   while (!g_stop) {
+    maintain_peer_links();
     std::vector<pollfd> pfds;
     pfds.push_back({listen_fd, POLLIN, 0});
     for (auto& [fd, c] : clients) {
@@ -304,6 +576,11 @@ int main(int argc, char** argv) {
       if (c->out_bytes > 0) ev |= POLLOUT;
       pfds.push_back({fd, ev, 0});
     }
+    // in-flight peer dials: their completion wakes the loop (the
+    // per-client processing below skips fds not in the clients map)
+    for (const auto& slot : peer_slots)
+      if (slot.pending_fd >= 0)
+        pfds.push_back({slot.pending_fd, POLLOUT, 0});
     int rc = poll(pfds.data(), pfds.size(), 1000);
     if (rc < 0) {
       if (errno == EINTR) continue;
@@ -376,7 +653,25 @@ int main(int argc, char** argv) {
             }
           }
           metrics_count("bus.relay_fast_frames");
-          relay_payload(topic, c.peer_id, raw, fd);
+          relay_payload(topic, c.peer_id, raw, fd, false);
+          continue;
+        }
+        if (!line->empty() && (*line)[0] == 'M' && c.is_peer) {
+          // peer-forwarded frame: `M<topic> <from> <payload>` — the
+          // remote shard's delivery of a frame some local client here
+          // subscribed to.  The ORIGINAL sender rides in <from>; relay
+          // to LOCAL clients only (the one-hop loop-prevention rule).
+          size_t s1 = line->find(' ');
+          size_t s2 = s1 == std::string::npos ? std::string::npos
+                                              : line->find(' ', s1 + 1);
+          if (s2 == std::string::npos || s1 < 2) continue;
+          const std::string topic = line->substr(1, s1 - 1);
+          const std::string from = line->substr(s1 + 1, s2 - s1 - 1);
+          const std::string raw = line->substr(s2 + 1);
+          metrics_count("bus.peer_rx_msgs");
+          metrics_count("bus.peer_rx_bytes",
+                        static_cast<double>(line->size() + 1));
+          relay_payload(topic, from, raw, fd, true);
           continue;
         }
         auto parsed = Json::parse(*line);
@@ -385,17 +680,47 @@ int main(int argc, char** argv) {
         const std::string& op = j["op"].as_str();
         if (op == "hello") {
           c.peer_id = j["peer_id"].as_str();
-          event_emit("bus.peer_joined", nullptr, -1, c.peer_id);
-          for (const auto& cap : j["caps"].as_array())
+          for (const auto& cap : j["caps"].as_array()) {
             if (cap.as_str() == "relay1") c.fast = true;
+            if (cap.as_str() == "shard1") c.shard1 = true;
+            if (cap.as_str() == "peer1" && num_shards > 1) {
+              // inbound peering link from a higher-index shard
+              c.is_peer = true;
+              c.peer_shard = static_cast<int>(j["shard"].as_int());
+            }
+          }
+          event_emit(c.is_peer ? "bus.peer_link_joined" : "bus.peer_joined",
+                     nullptr, -1, c.peer_id);
           Json caps;
           caps.push_back(Json("relay1"));
+          if (num_shards > 1) caps.push_back(Json("peer1"));
           Json welcome;
           welcome.set("op", "welcome")
               .set("peer_id", c.peer_id)
               .set("caps", caps);
+          if (num_shards > 1)
+            welcome.set("shard", static_cast<int64_t>(my_shard))
+                .set("shards", static_cast<int64_t>(num_shards));
           enqueue(c, fd, std::make_shared<const std::string>(
                              welcome.dump() + "\n"), false);
+          if (c.is_peer) {
+            // the responder side never initiates, so it replays ITS
+            // local interests over the new link right away (the mirror
+            // of arm_peer_link on the initiator side)
+            metrics_count("bus.peer_accepts");
+            for (const auto& [t, refs] : local_exact_refs)
+              if (refs > 0) {
+                Json s;
+                s.set("op", "sub").set("topic", t);
+                peer_send(c, fd, s.dump());
+              }
+            for (const auto& [p, refs] : local_prefix_refs)
+              if (refs > 0) {
+                Json s;
+                s.set("op", "sub").set("topic", p + "*");
+                peer_send(c, fd, s.dump());
+              }
+          }
         } else if (op == "sub") {
           const std::string& topic = j["topic"].as_str();
           if (topic.size() > 2 &&
@@ -404,22 +729,45 @@ int main(int argc, char** argv) {
             // "mapd.pos.*"); no peer_joined — prefix consumers are
             // infrastructure, not discoverable fleet members
             const std::string prefix = topic.substr(0, topic.size() - 1);
-            if (c.prefixes.insert(prefix).second)
+            if (c.prefixes.insert(prefix).second) {
               subs_prefix.emplace_back(prefix, fd);
+              const bool span =
+                  c.shard1 && num_shards > 1 &&
+                  shardmap::shards_for_subscription(topic, num_shards)
+                          .size() > 1;
+              if (span) c.span_prefixes.insert(prefix);
+              // span subscribers receive at every SOURCE shard (they
+              // subscribed there themselves), so they are NOT local
+              // interest for peering — counting them would pull the
+              // whole cross-shard stream here just to discard it at
+              // delivery (the span-suppression rule)
+              if (!c.is_peer && !span && ++local_prefix_refs[prefix] == 1)
+                peers_sub(topic, true);
+            }
           } else if (c.topics.insert(topic).second) {
             subs_exact[topic].insert(fd);
-            Json joined;  // discovery event, like an mDNS "discovered"
-            joined.set("op", "peer_joined")
-                .set("peer_id", c.peer_id)
-                .set("topic", topic);
-            broadcast_control(joined, topic, fd);
+            if (!c.is_peer) {
+              if (++local_exact_refs[topic] == 1) peers_sub(topic, true);
+              Json joined;  // discovery event, like an mDNS "discovered"
+              joined.set("op", "peer_joined")
+                  .set("peer_id", c.peer_id)
+                  .set("topic", topic);
+              broadcast_control(joined, topic, fd);
+            }
           }
         } else if (op == "unsub") {
           const std::string& topic = j["topic"].as_str();
           if (topic.size() > 2 &&
               topic.compare(topic.size() - 2, 2, ".*") == 0) {
             const std::string prefix = topic.substr(0, topic.size() - 1);
-            c.prefixes.erase(prefix);
+            if (c.prefixes.erase(prefix)) {
+              const bool was_span = c.span_prefixes.erase(prefix) > 0;
+              if (!c.is_peer && !was_span
+                  && --local_prefix_refs[prefix] <= 0) {
+                local_prefix_refs.erase(prefix);
+                peers_sub(topic, false);
+              }
+            }
             for (auto pit = subs_prefix.begin(); pit != subs_prefix.end();)
               pit = (pit->second == fd && pit->first == prefix)
                         ? subs_prefix.erase(pit)
@@ -429,6 +777,10 @@ int main(int argc, char** argv) {
             if (ex != subs_exact.end()) {
               ex->second.erase(fd);
               if (ex->second.empty()) subs_exact.erase(ex);
+            }
+            if (!c.is_peer && --local_exact_refs[topic] <= 0) {
+              local_exact_refs.erase(topic);
+              peers_sub(topic, false);
             }
           }
         } else if (op == "pub") {
@@ -442,12 +794,12 @@ int main(int argc, char** argv) {
             continue;
           }
           metrics_count("bus.relay_json_frames");
-          relay_payload(topic, c.peer_id, j["data"].dump(), fd);
+          relay_payload(topic, c.peer_id, j["data"].dump(), fd, false);
         } else if (op == "peers") {
           const std::string& topic = j["topic"].as_str();
           Json peers;
           for (auto& [ofd, oc] : clients)
-            if (ofd != fd && oc->topics.count(topic) &&
+            if (ofd != fd && !oc->is_peer && oc->topics.count(topic) &&
                 !oc->peer_id.empty())
               peers.push_back(Json(oc->peer_id));
           if (peers.is_null()) peers = Json(JsonArray{});
@@ -475,10 +827,27 @@ int main(int argc, char** argv) {
       auto it = clients.find(fd);
       if (it == clients.end()) continue;
       std::string peer = it->second->peer_id;
-      if (!peer.empty()) event_emit("bus.peer_left", nullptr, -1, peer);
+      const bool was_peer_link = it->second->is_peer;
+      if (!peer.empty())
+        event_emit(was_peer_link ? "bus.peer_link_left" : "bus.peer_left",
+                   nullptr, -1, peer);
       drop_subs(fd, *it->second);
       it->second->conn.close_fd();
       clients.erase(it);
+      if (was_peer_link) {
+        // outbound slot: re-arm the backoff so the link self-heals
+        for (auto& slot : peer_slots)
+          if (slot.fd == fd) {
+            slot.fd = -1;
+            slot.backoff_ms = slot.backoff_ms
+                                  ? std::min<int64_t>(slot.backoff_ms * 2,
+                                                      4000)
+                                  : 250;
+            slot.next_attempt_ms = mono_ms() + slot.backoff_ms;
+          }
+        log_warn("🔗 peer link down (%s)\n", peer.c_str());
+        continue;  // infrastructure: no peer_left discovery event
+      }
       if (!peer.empty()) {
         Json left;  // discovery event, like an mDNS "expired"
         left.set("op", "peer_left").set("peer_id", peer);

@@ -30,6 +30,21 @@
 // Kill switch: JG_BUS_FASTFRAME=0 keeps this client on the legacy JSON
 // wire end to end; an old hub (welcome without caps) does the same.
 // A topic ending in ".*" subscribes by prefix (busd wildcard matching).
+//
+// Sharded bus pool (ISSUE 6): when JG_BUS_SHARD_PORTS advertises a pool,
+// the client becomes SHARD-AWARE — one connection per shard it needs,
+// each subscription/publish routed to the owning shard by the
+// deterministic shardmap (cpp/common/shardmap.hpp ≡ runtime/shardmap.py:
+// region position topics spread across the pool, the control plane on the
+// home shard), the `shard1` cap advertised so busd suppresses duplicate
+// peer-forwarded deliveries, and reconnect/backoff handled PER SHARD: a
+// dead shard degrades its regions while the rest of the pool flows
+// (non-home shards always self-heal, independent of set_reconnect).
+// Every publish dropped while the owning shard is down is counted
+// (`bus.pub_dropped_disconnected`) and — for control-plane topics — held
+// in a small bounded outbox replayed when that shard returns.
+// With a single port (JG_BUS_SHARDS=1 kill switch) the wire is
+// byte-identical to the single-hub client.
 #pragma once
 
 #include <poll.h>
@@ -45,10 +60,12 @@
 #include <random>
 #include <set>
 #include <string>
+#include <vector>
 
 #include "json.hpp"
 #include "metrics.hpp"
 #include "net.hpp"
+#include "shardmap.hpp"
 
 namespace mapd {
 
@@ -68,6 +85,14 @@ inline int64_t mono_ms() {
 inline bool fastframe_enabled() {
   const char* v = getenv("JG_BUS_FASTFRAME");
   return !v || (*v && strcmp(v, "0") && strcmp(v, "false"));
+}
+
+// Control-plane topics are everything busd itself refuses to shed under
+// backpressure: not position beacons, not metrics, not path samples.
+// These are the frames the replay outbox preserves across an outage.
+inline bool bus_control_topic(const std::string& topic) {
+  return topic.compare(0, 9, "mapd.pos.") != 0 &&
+         topic != "mapd.metrics" && topic != "mapd.path";
 }
 
 // Random peer id, shaped like a libp2p PeerId for log familiarity.
@@ -91,17 +116,27 @@ class BusClient {
 
   BusClient() = default;
 
+  // Connect to the bus.  `port` is the home shard; when
+  // JG_BUS_SHARD_PORTS advertises a pool the other shards are dialed
+  // lazily, on the first subscription or publish that routes to them.
   bool connect(const std::string& host, uint16_t port,
                const std::string& peer_id) {
     host_ = host;
-    port_ = port;
     peer_id_ = peer_id;
-    int fd = tcp_connect(host, port);
+    auto ports = shardmap::shard_ports_from_env(port);
+    links_.clear();
+    links_.resize(ports.size());
+    for (size_t i = 0; i < ports.size(); ++i) links_[i].port = ports[i];
+    n_ = static_cast<int>(links_.size());
+    // the HOME shard keeps the startup contract: fail loudly
+    Link& home = links_[shardmap::kHomeShard];
+    int fd = tcp_connect(host, home.port);
     if (fd < 0) return false;
     set_nonblocking(fd);
-    conn_ = LineConn(fd);
-    fast_hub_ = false;  // until the hub's welcome advertises relay1
-    send_hello();
+    home.conn = LineConn(fd);
+    home.ever_attempted = true;
+    home.fast_hub = false;  // until the hub's welcome advertises relay1
+    send_hello(home);
     return true;
   }
 
@@ -112,19 +147,39 @@ class BusClient {
   // re-broadcast their position).  The reference's brokerless gossipsub
   // mesh has no hub to lose (manager.rs:94-98) — with this, losing busd
   // degrades the fleet instead of destroying it (VERDICT r2 item 5).
-  // Messages published while disconnected are dropped (the bus is a lossy
-  // broadcast medium; periodic heartbeats re-establish state).
+  // Messages published while disconnected are counted
+  // (bus.pub_dropped_disconnected); control-plane frames additionally ride
+  // the bounded replay outbox, flushed when the owning shard reconnects.
+  // NON-home shards of a pool always self-heal, reconnect mode or not.
   void set_reconnect(const std::function<void()>& on_reconnect) {
     reconnect_ = true;
     on_reconnect_ = on_reconnect;
   }
 
   const std::string& peer_id() const { return peer_id_; }
-  int fd() const { return conn_.fd(); }
+  int fd() const { return home().conn.fd(); }
+  int num_shards() const { return n_; }
   // "Logically alive": role main-loops poll this; a client in reconnect
-  // mode stays alive across bus outages.
-  bool connected() const { return conn_.valid() || reconnect_; }
-  bool wants_write() const { return conn_.wants_write(); }
+  // mode stays alive across bus outages.  Pool semantics: alive while the
+  // HOME shard link lives (a dead region shard only degrades coverage).
+  bool connected() const { return home().conn.valid() || reconnect_; }
+  bool wants_write() const {
+    for (const auto& l : links_)
+      if (l.conn.valid() && l.conn.wants_write()) return true;
+    return false;
+  }
+
+  // Append one pollfd per live shard link (role main-loops poll every
+  // shard, not just home, so a region beacon on another shard wakes the
+  // loop immediately instead of on the next timeout).
+  void append_pollfds(std::vector<pollfd>& out) const {
+    for (const auto& l : links_)
+      if (l.conn.valid())
+        out.push_back({l.conn.fd(),
+                       static_cast<short>(
+                           POLLIN | (l.conn.wants_write() ? POLLOUT : 0)),
+                       0});
+  }
 
   // Fleet-wide live metrics: publish this process's MetricsRegistry
   // snapshot on topic "mapd.metrics" every `interval_ms` (same beacon
@@ -138,40 +193,45 @@ class BusClient {
   }
 
   void subscribe(const std::string& topic) {
-    topics_.insert(topic);
-    Json j;
-    j.set("op", "sub").set("topic", topic);
-    send_control(j);
+    for (int s : shardmap::shards_for_subscription(topic, n_)) {
+      Link& l = ensure_link(s);
+      l.topics.insert(topic);
+      if (l.conn.valid()) {
+        Json j;
+        j.set("op", "sub").set("topic", topic);
+        l.conn.send_line(j.dump());
+      }
+    }
   }
 
   void unsubscribe(const std::string& topic) {
-    topics_.erase(topic);
-    Json j;
-    j.set("op", "unsub").set("topic", topic);
-    send_control(j);
+    for (int s : shardmap::shards_for_subscription(topic, n_)) {
+      Link& l = links_[static_cast<size_t>(s)];
+      l.topics.erase(topic);
+      if (l.conn.valid()) {
+        Json j;
+        j.set("op", "unsub").set("topic", topic);
+        l.conn.send_line(j.dump());
+      }
+    }
   }
 
   // True once the hub's welcome advertised the relay1 fast framing (and
   // JG_BUS_FASTFRAME didn't veto it): publishes go out as P-frames.
-  bool fast_hub() const { return fast_hub_; }
+  // (Per-link state in a pool; this reports the home shard.)
+  bool fast_hub() const { return home().fast_hub; }
 
   void publish(const std::string& topic, const Json& data) {
-    if (!conn_.valid()) return;  // disconnected: lossy medium, drop
-    std::string line;
-    if (fast_hub_ && topic.find(' ') == std::string::npos) {
-      // fast framing: the hub relays on a topic peek, no JSON parse
-      line = "P" + topic + " " + data.dump();
-    } else {
-      Json j;
-      j.set("op", "pub").set("topic", topic).set("data", data);
-      line = j.dump();
+    Link& l = ensure_link(shardmap::shard_of(topic, n_));
+    if (!l.conn.valid()) {
+      // disconnected: the drop is COUNTED, and control-plane frames ride
+      // the bounded replay outbox for the shard's return
+      metrics_count("bus.pub_dropped_disconnected", 1,
+                    "topic=\"" + topic + "\"");
+      outbox_maybe(topic, data.dump());
+      return;
     }
-    // wire bytes: the framed line PLUS its newline (send_line appends it) —
-    // keeps py/cpp bandwidth numbers byte-identical (bus_client.py publish)
-    metrics_count("bus.msgs_sent", 1, "topic=\"" + topic + "\"");
-    metrics_count("bus.bytes_sent", static_cast<double>(line.size() + 1),
-                  "topic=\"" + topic + "\"");
-    conn_.send_line(line);
+    publish_on(l, topic, data.dump());
   }
 
   void query_peers(const std::string& topic) {
@@ -180,85 +240,259 @@ class BusClient {
     send_control(j);
   }
 
-  // Pump socket events.  Returns false if the bus connection died and
-  // reconnect mode is off; with set_reconnect, outages are absorbed (a
-  // backoff-paced reconnect attempt rides each pump call) and pump keeps
-  // returning true.
+  // Pump socket events on every shard link.  Returns false if the HOME
+  // bus connection died and reconnect mode is off; with set_reconnect,
+  // outages are absorbed (a backoff-paced reconnect attempt rides each
+  // pump call) and pump keeps returning true.  Non-home shard outages
+  // never end the loop — they self-heal with the same backoff.
   // on_msg: application messages; on_event: peer_joined/peer_left/peers.
   bool pump(const std::function<void(const Msg&)>& on_msg,
             const std::function<void(const Json&)>& on_event = nullptr) {
     maybe_publish_beacon();
-    if (!conn_.valid()) return try_reconnect();
-    if (!conn_.on_readable()) return drop_or_retry();
-    while (auto line = conn_.next_line()) {
-      if (!line->empty() && (*line)[0] == 'M') {
-        // fast relay frame: `M<topic> <from> <payload-json>`
-        size_t s1 = line->find(' ');
-        size_t s2 = s1 == std::string::npos ? std::string::npos
-                                            : line->find(' ', s1 + 1);
-        if (s2 == std::string::npos) continue;
-        auto data = Json::parse(line->substr(s2 + 1));
-        if (!data) continue;  // garbage payload: ignore like any bad frame
-        const std::string topic = line->substr(1, s1 - 1);
-        metrics_count("bus.msgs_received", 1, "topic=\"" + topic + "\"");
-        metrics_count("bus.bytes_received",
-                      static_cast<double>(line->size() + 1),
-                      "topic=\"" + topic + "\"");
-        if (on_msg)
-          on_msg(Msg{topic, line->substr(s1 + 1, s2 - s1 - 1), *data});
+    bool alive = true;
+    for (auto& l : links_) {
+      if (!l.conn.valid()) {
+        if (!try_reconnect(l)) alive = false;
         continue;
       }
-      auto parsed = Json::parse(*line);
-      if (!parsed || !parsed->is_object()) continue;  // ignore garbage frames
-      const Json& j = *parsed;
-      const std::string& op = j["op"].as_str();
-      if (op == "msg") {
-        // wire bytes: framed line + its newline (stripped by next_line)
-        const std::string& topic = j["topic"].as_str();
-        metrics_count("bus.msgs_received", 1, "topic=\"" + topic + "\"");
-        metrics_count("bus.bytes_received",
-                      static_cast<double>(line->size() + 1),
-                      "topic=\"" + topic + "\"");
-        if (on_msg) on_msg(Msg{topic, j["from"].as_str(), j["data"]});
-      } else {
-        if (op == "welcome") {
-          // caps negotiation: switch publishes to the fast framing only
-          // when the hub advertises it (an old hub stays legacy)
-          if (fastframe_enabled())
-            for (const auto& cap : j["caps"].as_array())
-              if (cap.as_str() == "relay1") fast_hub_ = true;
-        }
-        if (on_event) on_event(j);
+      if (!l.conn.on_readable()) {
+        if (!drop_or_retry(l)) alive = false;
+        continue;
       }
+      while (auto line = l.conn.next_line()) handle_line(l, *line, on_msg,
+                                                         on_event);
+      if (l.conn.valid() && !l.conn.on_writable())
+        if (!drop_or_retry(l)) alive = false;
     }
-    if (!conn_.on_writable()) return drop_or_retry();
-    return true;
+    return alive;
   }
 
-  bool flush() { return conn_.on_writable(); }
+  bool flush() {
+    bool ok = true;
+    for (auto& l : links_)
+      if (l.conn.valid() && !l.conn.on_writable()) ok = false;
+    return ok;
+  }
+
   void close() {
     reconnect_ = false;
-    conn_.close_fd();
+    for (auto& l : links_) l.conn.close_fd();
   }
 
  private:
+  struct Link {
+    LineConn conn;
+    uint16_t port = 0;
+    bool fast_hub = false;
+    bool ever_attempted = false;
+    int64_t backoff_ms = 0;
+    int64_t next_attempt_ms = 0;
+    std::set<std::string> topics;  // subscriptions owned by this shard
+  };
+
+  Link& home() { return links_[shardmap::kHomeShard]; }
+  const Link& home() const { return links_[shardmap::kHomeShard]; }
+  bool is_home(const Link& l) const { return &l == &links_[0]; }
+
   void send_control(const Json& j) {
-    if (conn_.valid()) conn_.send_line(j.dump());
+    Link& h = home();
+    if (h.conn.valid()) h.conn.send_line(j.dump());
   }
 
-  void send_hello() {
+  void send_hello(Link& l) {
     Json hello;
     hello.set("op", "hello").set("peer_id", peer_id_);
-    if (fastframe_enabled()) {
-      Json caps;
-      caps.push_back(Json("relay1"));
-      hello.set("caps", caps);
+    Json caps;
+    if (fastframe_enabled()) caps.push_back(Json("relay1"));
+    // shard1 is orthogonal to the relay framing: a pool client must
+    // advertise it even with JG_BUS_FASTFRAME=0, or busd would count
+    // its span wildcards as peering interest and double-deliver.  It
+    // rides only on a real pool — the single-hub hello (and the
+    // JG_BUS_SHARDS=1 kill switch) stays byte-identical.
+    if (n_ > 1) caps.push_back(Json("shard1"));
+    if (!caps.is_null()) hello.set("caps", caps);
+    l.conn.send_line(hello.dump());
+  }
+
+  // The link for `shard`, dialed lazily on first use.
+  Link& ensure_link(int shard) {
+    Link& l = links_[static_cast<size_t>(shard)];
+    if (!l.conn.valid() && !l.ever_attempted) {
+      l.ever_attempted = true;
+      int fd = tcp_connect_timeout(host_, l.port, 250);
+      if (fd < 0) {
+        l.backoff_ms = 250;
+        l.next_attempt_ms = mono_ms() + l.backoff_ms;
+        return l;
+      }
+      l.conn = LineConn(fd);
+      l.fast_hub = false;
+      send_hello(l);
+      for (const auto& t : l.topics) {
+        Json j;
+        j.set("op", "sub").set("topic", t);
+        l.conn.send_line(j.dump());
+      }
     }
-    conn_.send_line(hello.dump());
+    return l;
+  }
+
+  void publish_on(Link& l, const std::string& topic,
+                  const std::string& payload) {
+    std::string line;
+    if (l.fast_hub && topic.find(' ') == std::string::npos) {
+      // fast framing: the hub relays on a topic peek, no JSON parse
+      line = "P" + topic + " " + payload;
+    } else {
+      Json j;
+      j.set("op", "pub").set("topic", topic);
+      line = j.dump();
+      // splice the pre-rendered payload in as the "data" member (the
+      // outbox stores payload text, not Json values)
+      line.insert(line.size() - 1, ",\"data\":" + payload);
+    }
+    // wire bytes: the framed line PLUS its newline (send_line appends it) —
+    // keeps py/cpp bandwidth numbers byte-identical (bus_client.py publish)
+    metrics_count("bus.msgs_sent", 1, "topic=\"" + topic + "\"");
+    metrics_count("bus.bytes_sent", static_cast<double>(line.size() + 1),
+                  "topic=\"" + topic + "\"");
+    l.conn.send_line(line);
+  }
+
+  // Queue a dropped frame for replay-on-reconnect — control-plane topics
+  // only (droppable beacon streams are superseded by the next beat).
+  void outbox_maybe(const std::string& topic, const std::string& payload) {
+    if (!bus_control_topic(topic)) return;
+    if (outbox_max_ == 0) return;
+    if (outbox_.size() >= outbox_max_) {
+      metrics_count("bus.outbox_overflow");
+      outbox_.pop_front();
+    }
+    outbox_.emplace_back(topic, payload);
+  }
+
+  void flush_outbox(Link& l) {
+    if (outbox_.empty()) return;
+    const int shard = static_cast<int>(&l - links_.data());
+    std::deque<std::pair<std::string, std::string>> keep;
+    for (auto& [topic, payload] : outbox_) {
+      if (shardmap::shard_of(topic, n_) == shard) {
+        publish_on(l, topic, payload);
+        metrics_count("bus.pub_replayed", 1, "topic=\"" + topic + "\"");
+      } else {
+        keep.emplace_back(std::move(topic), std::move(payload));
+      }
+    }
+    outbox_ = std::move(keep);
+  }
+
+  void handle_line(Link& l, const std::string& line,
+                   const std::function<void(const Msg&)>& on_msg,
+                   const std::function<void(const Json&)>& on_event) {
+    if (!line.empty() && line[0] == 'M') {
+      // fast relay frame: `M<topic> <from> <payload-json>`
+      size_t s1 = line.find(' ');
+      size_t s2 = s1 == std::string::npos ? std::string::npos
+                                          : line.find(' ', s1 + 1);
+      if (s2 == std::string::npos) return;
+      auto data = Json::parse(line.substr(s2 + 1));
+      if (!data) return;  // garbage payload: ignore like any bad frame
+      const std::string topic = line.substr(1, s1 - 1);
+      metrics_count("bus.msgs_received", 1, "topic=\"" + topic + "\"");
+      metrics_count("bus.bytes_received",
+                    static_cast<double>(line.size() + 1),
+                    "topic=\"" + topic + "\"");
+      if (on_msg)
+        on_msg(Msg{topic, line.substr(s1 + 1, s2 - s1 - 1), *data});
+      return;
+    }
+    auto parsed = Json::parse(line);
+    if (!parsed || !parsed->is_object()) return;  // ignore garbage frames
+    const Json& j = *parsed;
+    const std::string& op = j["op"].as_str();
+    if (op == "msg") {
+      // wire bytes: framed line + its newline (stripped by next_line)
+      const std::string& topic = j["topic"].as_str();
+      metrics_count("bus.msgs_received", 1, "topic=\"" + topic + "\"");
+      metrics_count("bus.bytes_received",
+                    static_cast<double>(line.size() + 1),
+                    "topic=\"" + topic + "\"");
+      if (on_msg) on_msg(Msg{topic, j["from"].as_str(), j["data"]});
+    } else {
+      if (op == "welcome") {
+        // caps negotiation: switch publishes to the fast framing only
+        // when the hub advertises it (an old hub stays legacy), per link
+        if (fastframe_enabled())
+          for (const auto& cap : j["caps"].as_array())
+            if (cap.as_str() == "relay1") l.fast_hub = true;
+      }
+      if (on_event) on_event(j);
+    }
+  }
+
+  // Connection died mid-pump: without reconnect mode propagate the death
+  // (HOME shard only); otherwise drop the socket and arm the backoff.
+  bool drop_or_retry(Link& l) {
+    const bool fatal = is_home(l) && !reconnect_;
+    const int err = errno;  // capture BEFORE close() can overwrite it
+    l.conn.close_fd();
+    l.fast_hub = false;  // renegotiate with whatever hub comes back
+    if (fatal) return false;
+    l.backoff_ms = 250;
+    l.next_attempt_ms = mono_ms() + l.backoff_ms;
+    fprintf(stderr,
+            "bus: shard %d connection lost (errno=%d), reconnecting "
+            "(backoff %lld ms)\n",
+            static_cast<int>(&l - links_.data()), err,
+            static_cast<long long>(l.backoff_ms));
+    return true;
+  }
+
+  bool try_reconnect(Link& l) {
+    if (is_home(l) && !reconnect_) return false;
+    if (!l.ever_attempted) return true;  // lazily dialed on first use
+    int64_t now = mono_ms();
+    if (now < l.next_attempt_ms) return true;  // not due yet
+    // bounded connect: a silently-unreachable bus host must not freeze
+    // the single-threaded role loop for the kernel SYN timeout.  The
+    // timeout scales with the backoff (250 ms first try, up to 1 s) so a
+    // reachable-but-slow link (SYN+accept > 250 ms) converges instead of
+    // aborting every attempt forever.
+    int fd = tcp_connect_timeout(
+        host_, l.port,
+        static_cast<int>(std::min<int64_t>(
+            std::max<int64_t>(l.backoff_ms, 250), 1000)));
+    if (fd < 0) {
+      l.backoff_ms = l.backoff_ms
+                         ? std::min<int64_t>(l.backoff_ms * 2, 4000)
+                         : 250;
+      l.next_attempt_ms = now + l.backoff_ms;
+      fprintf(stderr, "bus: shard %d reconnect attempt failed (errno=%d), "
+              "next in %lld ms\n",
+              static_cast<int>(&l - links_.data()), errno,
+              static_cast<long long>(l.backoff_ms));
+      return true;
+    }
+    set_nonblocking(fd);
+    l.conn = LineConn(fd);
+    l.backoff_ms = 0;
+    l.fast_hub = false;
+    send_hello(l);
+    for (const auto& t : l.topics) {
+      Json j;
+      j.set("op", "sub").set("topic", t);
+      l.conn.send_line(j.dump());
+    }
+    fprintf(stderr, "bus: reconnected as %s (shard %d, %zu topics "
+            "resubscribed)\n", peer_id_.c_str(),
+            static_cast<int>(&l - links_.data()), l.topics.size());
+    flush_outbox(l);
+    if (is_home(l) && on_reconnect_) on_reconnect_();
+    return true;
   }
 
   void maybe_publish_beacon() {
-    if (beacon_proc_.empty() || !conn_.valid()) return;
+    if (beacon_proc_.empty() || !home().conn.valid()) return;
     int64_t now = mono_ms();
     if (now < next_beacon_ms_) return;
     next_beacon_ms_ = now + beacon_interval_ms_;
@@ -267,68 +501,19 @@ class BusClient {
                                 beacon_interval_ms_ / 1000.0));
   }
 
-  // Connection died mid-pump: without reconnect mode propagate the death;
-  // with it, drop the socket and arm the backoff timer.
-  bool drop_or_retry() {
-    if (!reconnect_) return false;
-    const int err = errno;  // capture BEFORE close() can overwrite it
-    conn_.close_fd();
-    fast_hub_ = false;  // renegotiate with whatever hub comes back
-    backoff_ms_ = 250;
-    next_attempt_ms_ = mono_ms() + backoff_ms_;
-    fprintf(stderr,
-            "bus: connection lost (errno=%d), reconnecting (backoff "
-            "%lld ms)\n", err, static_cast<long long>(backoff_ms_));
-    return true;
-  }
-
-  bool try_reconnect() {
-    if (!reconnect_) return false;
-    int64_t now = mono_ms();
-    if (now < next_attempt_ms_) return true;  // not due yet
-    // bounded connect: a silently-unreachable bus host must not freeze
-    // the single-threaded role loop for the kernel SYN timeout.  The
-    // timeout scales with the backoff (250 ms first try, up to 1 s) so a
-    // reachable-but-slow link (SYN+accept > 250 ms) converges instead of
-    // aborting every attempt forever.
-    int fd = tcp_connect_timeout(
-        host_, port_,
-        static_cast<int>(std::min<int64_t>(std::max<int64_t>(backoff_ms_, 250),
-                                           1000)));
-    if (fd < 0) {
-      backoff_ms_ = backoff_ms_ ? std::min<int64_t>(backoff_ms_ * 2, 4000)
-                                : 250;
-      next_attempt_ms_ = now + backoff_ms_;
-      fprintf(stderr, "bus: reconnect attempt failed (errno=%d), next in "
-              "%lld ms\n", errno, static_cast<long long>(backoff_ms_));
-      return true;
-    }
-    set_nonblocking(fd);
-    conn_ = LineConn(fd);
-    backoff_ms_ = 0;
-    fast_hub_ = false;
-    send_hello();
-    for (const auto& t : topics_) {
-      Json j;
-      j.set("op", "sub").set("topic", t);
-      conn_.send_line(j.dump());
-    }
-    fprintf(stderr, "bus: reconnected as %s (%zu topics resubscribed)\n",
-            peer_id_.c_str(), topics_.size());
-    if (on_reconnect_) on_reconnect_();
-    return true;
-  }
-
-  LineConn conn_;
+  std::vector<Link> links_ = std::vector<Link>(1);
+  int n_ = 1;
   std::string peer_id_;
   std::string host_;
-  uint16_t port_ = 0;
-  bool fast_hub_ = false;
   bool reconnect_ = false;
   std::function<void()> on_reconnect_;
-  std::set<std::string> topics_;
-  int64_t backoff_ms_ = 0;
-  int64_t next_attempt_ms_ = 0;
+  std::deque<std::pair<std::string, std::string>> outbox_;
+  size_t outbox_max_ = []() -> size_t {
+    const char* v = getenv("JG_BUS_OUTBOX");
+    if (!v || !*v) return 128;
+    long n = atol(v);
+    return n > 0 ? static_cast<size_t>(n) : 0;  // <=0 disables replay
+  }();
   std::string beacon_proc_;  // empty = beacons off
   int64_t beacon_interval_ms_ = 2000;
   int64_t next_beacon_ms_ = 0;

@@ -1,0 +1,138 @@
+// Deterministic topic→shard map for the federated bus pool — native
+// mirror of p2p_distributed_tswap_tpu/runtime/shardmap.py (ISSUE 6
+// tentpole; kept choice-identical, golden-tested via
+// cpp/probes/codec_golden.cpp --shardmap).
+//
+// Ownership rules (every topic owned by EXACTLY ONE shard):
+// - region position topics "mapd.pos.<rx>.<ry>" spread across ALL
+//   shards by the region indices: (rx*7919 + ry*104729) % n;
+// - a position topic with a non-numeric suffix falls back to FNV-1a
+//   over the topic string;
+// - everything else (control plane: "mapd", "mapd.path",
+//   "mapd.metrics", the "solver" plan wire) lives on the HOME shard
+//   (index 0) and reaches the rest over busd↔busd peering.
+// Subscriptions: exact topic → its owner; a ".*" wildcard that can
+// match position topics → ALL shards; any other wildcard → home.
+// JG_BUS_SHARDS=1 (default): everything is shard 0 — the kill switch
+// that keeps the single-hub wire verbatim.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "region.hpp"  // kPosTopicPrefix
+
+namespace mapd {
+namespace shardmap {
+
+constexpr int kHomeShard = 0;
+constexpr const char* kShardPortsEnv = "JG_BUS_SHARD_PORTS";
+
+inline uint32_t fnv1a32(const std::string& s) {
+  uint32_t h = 2166136261u;
+  for (unsigned char b : s) {
+    h ^= b;
+    h *= 16777619u;
+  }
+  return h;
+}
+
+inline bool all_digits(const std::string& s) {
+  if (s.empty()) return false;
+  for (char c : s)
+    if (c < '0' || c > '9') return false;
+  return true;
+}
+
+// The single owning shard of `topic` in an `num_shards` pool.
+inline int shard_of(const std::string& topic, int num_shards) {
+  if (num_shards <= 1) return kHomeShard;
+  const size_t plen = strlen(kPosTopicPrefix);
+  if (topic.compare(0, plen, kPosTopicPrefix) == 0 &&
+      (topic.empty() || topic.back() != '*')) {
+    const std::string suffix = topic.substr(plen);
+    const size_t dot = suffix.find('.');
+    if (dot != std::string::npos && all_digits(suffix.substr(0, dot)) &&
+        all_digits(suffix.substr(dot + 1))) {
+      // the region math IS the shard map (identical to shardmap.py)
+      const long long rx = atoll(suffix.substr(0, dot).c_str());
+      const long long ry = atoll(suffix.substr(dot + 1).c_str());
+      return static_cast<int>((rx * 7919 + ry * 104729) % num_shards);
+    }
+    return static_cast<int>(fnv1a32(topic) % num_shards);
+  }
+  return kHomeShard;
+}
+
+// Every shard a subscription must reach (see shardmap.py).
+inline std::vector<int> shards_for_subscription(const std::string& topic,
+                                                int num_shards) {
+  if (num_shards <= 1) return {kHomeShard};
+  if (topic.size() >= 2 &&
+      topic.compare(topic.size() - 2, 2, ".*") == 0) {
+    const std::string prefix = topic.substr(0, topic.size() - 1);
+    const std::string pos_prefix = kPosTopicPrefix;
+    const bool spans =
+        prefix.compare(0, pos_prefix.size(), pos_prefix) == 0 ||
+        pos_prefix.compare(0, prefix.size(), prefix) == 0;
+    if (spans) {
+      std::vector<int> all(static_cast<size_t>(num_shards));
+      for (int i = 0; i < num_shards; ++i) all[static_cast<size_t>(i)] = i;
+      return all;
+    }
+    return {kHomeShard};
+  }
+  return {shard_of(topic, num_shards)};
+}
+
+// Parse a JG_BUS_SHARD_PORTS value ("7450,7451") into the ordered shard
+// port list; returns empty on a malformed entry (callers treat that as a
+// fatal misconfiguration, never a silent fallback).
+inline std::vector<uint16_t> parse_shard_ports(const std::string& spec) {
+  std::vector<uint16_t> ports;
+  size_t start = 0;
+  while (start <= spec.size()) {
+    size_t comma = spec.find(',', start);
+    std::string tok = spec.substr(
+        start, comma == std::string::npos ? std::string::npos
+                                          : comma - start);
+    // trim spaces
+    while (!tok.empty() && tok.front() == ' ') tok.erase(tok.begin());
+    while (!tok.empty() && tok.back() == ' ') tok.pop_back();
+    if (!tok.empty()) {
+      if (!all_digits(tok)) return {};
+      long v = atol(tok.c_str());
+      if (v <= 0 || v > 65535) return {};
+      ports.push_back(static_cast<uint16_t>(v));
+    }
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return ports;
+}
+
+// The shard port list the environment advertises, else the single
+// `default_port` (legacy single-hub wire).  A malformed value is FATAL,
+// matching the Python mirror: a half-parsed pool map must never route
+// silently (a quiet single-hub fallback would misroute every region
+// publish through home while the rest of the fleet shards).
+inline std::vector<uint16_t> shard_ports_from_env(uint16_t default_port) {
+  const char* spec = getenv(kShardPortsEnv);
+  if (spec && *spec) {
+    auto ports = parse_shard_ports(spec);
+    if (ports.empty()) {
+      fprintf(stderr, "shardmap: malformed %s=\"%s\"\n", kShardPortsEnv,
+              spec);
+      exit(2);
+    }
+    return ports;
+  }
+  return {default_port};
+}
+
+}  // namespace shardmap
+}  // namespace mapd

@@ -722,12 +722,13 @@ int main(int argc, char** argv) {
   bool running = true;
 
   while (running && !g_stop && bus.connected()) {
-    pollfd pfds[2] = {
-        {bus.fd(), static_cast<short>(POLLIN | (bus.wants_write() ? POLLOUT : 0)), 0},
-        {STDIN_FILENO, POLLIN, 0}};
-    poll(pfds, 2, 100);
+    // poll every shard link plus stdin (stdin stays LAST in the vector)
+    std::vector<pollfd> pfds;
+    bus.append_pollfds(pfds);
+    pfds.push_back({STDIN_FILENO, POLLIN, 0});
+    poll(pfds.data(), static_cast<nfds_t>(pfds.size()), 100);
 
-    if (pfds[1].revents & POLLIN) {
+    if (pfds.back().revents & POLLIN) {
       char buf[4096];
       ssize_t n = read(STDIN_FILENO, buf, sizeof(buf));
       if (n > 0) {

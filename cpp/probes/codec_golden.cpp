@@ -16,6 +16,10 @@
 //   --pos1-decode  stdin: one base64 pos1 beacon per line
 //                  stdout: {"pos":P,"goal":G,"task":T|null} per line
 //                  ("null" for undecodable input)
+//   --shardmap     stdin: one JSON per line {"topic":s,"shards":n}
+//                  stdout: {"shard":k,"subs":[k...]} per line — the
+//                  topic→shard map (cpp/common/shardmap.hpp) the Python
+//                  side asserts choice-identical (ISSUE 6)
 
 #include <cstdio>
 #include <iostream>
@@ -23,6 +27,7 @@
 
 #include "../common/json.hpp"
 #include "../common/plan_codec.hpp"
+#include "../common/shardmap.hpp"
 
 using namespace mapd;
 
@@ -56,10 +61,10 @@ static Json trace_json(bool has, const codec::TraceCtx& t) {
 int main(int argc, char** argv) {
   const std::string mode = argc > 1 ? argv[1] : "";
   if (mode != "--encode" && mode != "--decode" && mode != "--pos1-encode" &&
-      mode != "--pos1-decode") {
+      mode != "--pos1-decode" && mode != "--shardmap") {
     fprintf(stderr,
             "usage: codec_golden --encode|--decode|--pos1-encode|"
-            "--pos1-decode < lines\n");
+            "--pos1-decode|--shardmap < lines\n");
     return 2;
   }
   codec::PackedFleetEncoder enc;
@@ -82,6 +87,25 @@ int main(int argc, char** argv) {
                  static_cast<int32_t>(j["goal"].as_int()), j.has("task"),
                  j["task"].as_int(), has_tc ? &tc : nullptr)
                  .c_str());
+      continue;
+    }
+    if (mode == "--shardmap") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad shardmap script line\n");
+        return 1;
+      }
+      const std::string topic = (*parsed)["topic"].as_str();
+      const int n = static_cast<int>((*parsed)["shards"].as_int());
+      Json subs;
+      for (int s : shardmap::shards_for_subscription(topic, n))
+        subs.push_back(Json(static_cast<int64_t>(s)));
+      if (subs.is_null()) subs = Json(JsonArray{});
+      Json out;
+      out.set("shard",
+              static_cast<int64_t>(shardmap::shard_of(topic, n)))
+          .set("subs", subs);
+      printf("%s\n", out.dump().c_str());
       continue;
     }
     if (mode == "--pos1-decode") {

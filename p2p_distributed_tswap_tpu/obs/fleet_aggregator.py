@@ -17,7 +17,12 @@ operator-facing rollup ``analysis/fleet_top.py`` renders:
   advertised beacon intervals (payload ``interval_s``; ``stale_after_s``
   is the fallback for beacons without it) is flagged ``stale`` —
   wedged-but-alive processes surface here, complementing
-  runtime/fleet.py's exit-code capture of processes that died outright.
+  runtime/fleet.py's exit-code capture of processes that died outright;
+- per-shard bus health (ISSUE 6): a busd pool member's beacon carries
+  its ``shard`` index, and its rollup row gains a ``bus`` section —
+  relay fanout rate, queued bytes, live peering links, and peering
+  traffic — so fleet_top shows each shard's load and the peering tax
+  live.
 """
 
 from __future__ import annotations
@@ -170,6 +175,7 @@ class FleetAggregator:
         out = {
             "proc": p.get("proc", "?"),
             "pid": p.get("pid"),
+            "shard": p.get("shard"),  # busd pool member index (ISSUE 6)
             "last_seen_ms": st.last_seen_ms,
             "age_s": round(age_s, 3),
             "stale": age_s > stale_after,
@@ -179,6 +185,35 @@ class FleetAggregator:
             "cache": None,
             "tasks": None,
         }
+        if p.get("proc") == "busd":
+            # per-shard bus health: fanout rate (delta when a previous
+            # beacon exists, else cumulative average), queue depth, and
+            # the peering tax
+            fan = counter_total(m, "bus.fanout_bytes")
+            fan_msgs = counter_total(m, "bus.fanout_msgs")
+            if st.prev_metrics is not None \
+                    and st.last_seen_ms > st.prev_ts_ms:
+                dt = (st.last_seen_ms - st.prev_ts_ms) / 1000.0
+                d_fan = fan - counter_total(st.prev_metrics,
+                                            "bus.fanout_bytes")
+                if d_fan < 0:
+                    d_fan = fan  # counter reset: restarted shard
+            else:
+                dt = max(m.get("uptime_s") or 0.0, 1e-9)
+                d_fan = fan
+            gauges = m.get("gauges") or {}
+            out["bus"] = {
+                "fanout_msgs": int(fan_msgs),
+                "fanout_kbps": round(max(0.0, d_fan) * 8.0 / (dt * 1000.0),
+                                     1),
+                "queued_bytes": int(gauges.get("bus.queued_bytes") or 0),
+                "clients": int(gauges.get("bus.clients") or 0),
+                "peer_links": int(gauges.get("bus.peer_links") or 0),
+                "peer_rx_msgs": int(counter_total(m, "bus.peer_rx_msgs")),
+                "peer_tx_msgs": int(counter_total(m, "bus.peer_tx_msgs")),
+                "slow_consumer_drops": int(
+                    counter_total(m, "bus.slow_consumer_drops")),
+            }
         if tick_hist and tick_hist["count"]:
             out["tick"] = {
                 "count": tick_hist["count"],

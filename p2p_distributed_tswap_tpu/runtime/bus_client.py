@@ -16,14 +16,32 @@ caps) it stays legacy automatically.  A topic ending in ``.*``
 subscribes by prefix (busd wildcard matching — managers use
 ``mapd.pos.*`` for the region-sharded position gossip).
 
+Sharded bus pool (ISSUE 6): when the environment advertises a pool
+(``JG_BUS_SHARD_PORTS=7450,7451,...`` — or ``shard_ports=[...]`` is
+passed), the client becomes SHARD-AWARE: it holds one connection per
+shard it needs, routes every subscription and publish to the owning
+shard (runtime/shardmap.py — region position topics spread across the
+pool, the control plane lives on the home shard), advertises the
+``shard1`` cap so busd can suppress duplicate peer-forwarded
+deliveries, and reconnects/fails over PER SHARD — a dead shard degrades
+its regions, not the fleet.  With a single port (the default and the
+``JG_BUS_SHARDS=1`` kill switch) the wire is byte-identical to the
+pre-pool client.
+
 Like the C++ client, it can survive a bus restart: with ``reconnect=True``
 a dropped connection is retried with exponential backoff (0.25 s .. 4 s);
 on success the client re-sends hello, re-subscribes every topic, and calls
 ``on_reconnect``.  While disconnected, ``publish`` drops (the bus is a
-lossy broadcast medium) and ``recv`` behaves like a timeout.  The
-reference's brokerless gossipsub mesh has no hub to lose — with this,
-losing busd degrades the fleet instead of destroying it (VERDICT r2
-item 5).
+lossy broadcast medium) and ``recv`` behaves like a timeout.  Every such
+drop is now counted (``bus.pub_dropped_disconnected``), and CONTROL-PLANE
+frames (anything busd itself would refuse to shed: not position beacons,
+not metrics, not path samples) go to a small bounded replay outbox that
+is flushed when the owning shard's connection comes back — so a manager
+command published into a bus bounce is delayed, not lost.  Non-home
+shards always self-heal with the same backoff, independent of the
+``reconnect`` flag.  The reference's brokerless gossipsub mesh has no hub
+to lose — with this, losing busd degrades the fleet instead of
+destroying it (VERDICT r2 item 5).
 
 Network accounting lives in the unified live-metrics registry
 (obs/registry.py): per-topic ``bus.msgs_sent`` / ``bus.bytes_sent`` /
@@ -38,12 +56,45 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import socket
 import time
-from typing import Callable, Iterator, Optional
+from collections import deque
+from typing import Callable, Iterator, List, Optional
 
 from p2p_distributed_tswap_tpu.obs import registry as _reg
 from p2p_distributed_tswap_tpu.obs import trace
+from p2p_distributed_tswap_tpu.runtime import shardmap
+
+# Topics busd's slow-consumer policy may shed (droppable streams) — the
+# complement is the control plane the replay outbox preserves.
+_DROPPABLE_PREFIX = "mapd.pos."
+_DROPPABLE_TOPICS = ("mapd.metrics", "mapd.path")
+
+
+def _is_control_topic(topic: str) -> bool:
+    return not (topic.startswith(_DROPPABLE_PREFIX)
+                or topic in _DROPPABLE_TOPICS)
+
+
+class _Link:
+    """One shard connection: socket + framing buffer + per-link caps and
+    backoff state (each shard negotiates and fails independently)."""
+
+    __slots__ = ("shard", "port", "sock", "buf", "topics", "backoff",
+                 "next_attempt", "attempted", "fast_hub", "hub_caps")
+
+    def __init__(self, shard: int, port: int):
+        self.shard = shard
+        self.port = port
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""
+        self.topics: set[str] = set()  # subscriptions owned by this shard
+        self.backoff = 0.0
+        self.next_attempt = 0.0
+        self.attempted = False  # ever dialed (lazy links dial on demand)
+        self.fast_hub = False
+        self.hub_caps: Optional[list] = None
 
 
 class BusClient:
@@ -52,203 +103,358 @@ class BusClient:
                  reconnect: bool = False,
                  on_reconnect: Optional[Callable[[], None]] = None,
                  registry: Optional[_reg.Registry] = None,
-                 fastframe: Optional[bool] = None):
+                 fastframe: Optional[bool] = None,
+                 shard_ports: Optional[List[int]] = None):
         self.peer_id = peer_id or f"py-{int(time.time() * 1000) % 10 ** 10}"
-        self._host, self._port, self._timeout = host, port, timeout
+        self._host, self._timeout = host, timeout
         self._reconnect = reconnect
         self._on_reconnect = on_reconnect
-        self._topics: set[str] = set()
-        self._backoff = 0.0
-        self._next_attempt = 0.0
         # relay fast framing: advertised in hello, armed by the hub's
         # welcome (see module docstring); None = the JG_BUS_FASTFRAME env
         self._fastframe = (os.environ.get("JG_BUS_FASTFRAME", "1")
                            not in ("0", "false", "")
                            if fastframe is None else fastframe)
-        self.hub_caps: Optional[list] = None  # from the last welcome
-        self._fast_hub = False
-        self.sock: Optional[socket.socket] = None
+        # shard pool map: explicit arg beats JG_BUS_SHARD_PORTS beats the
+        # single `port` (the legacy single-hub wire, byte-identical)
+        ports = (list(shard_ports) if shard_ports
+                 else shardmap.shard_ports_from_env(port))
+        self._links = [_Link(i, p) for i, p in enumerate(ports)]
+        self._n = len(self._links)
+        self._rr = 0  # round-robin cursor for buffered-frame draining
+        # bounded control-plane replay outbox: (topic, payload) of frames
+        # publish() had to drop while the owning shard was down, flushed
+        # in arrival order when that shard's link reconnects.
+        # JG_BUS_OUTBOX=0 disables replay entirely (same as the C++
+        # client — never an unbounded queue)
+        self._outbox_max = int(os.environ.get("JG_BUS_OUTBOX", "128")
+                               or 128)
+        self._outbox: deque = deque(maxlen=max(1, self._outbox_max))
         # network accounting sink: the process registry unless a test
         # injects its own (obs/registry.py is the single source of truth)
         self.registry = registry or _reg.get_registry()
-        self._connect()  # initial connect still raises: startup contract
+        self._closed = False
+        # initial connect to the HOME shard still raises: startup contract
+        self._connect(self._links[shardmap.HOME_SHARD])
 
-    # -- connection management -------------------------------------------
-    def _connect(self) -> None:
-        self.sock = socket.create_connection((self._host, self._port),
-                                             timeout=self._timeout)
-        self.sock.settimeout(self._timeout)
-        self._buf = b""
-        self._backoff = 0.0
-        self._fast_hub = False  # renegotiated by the hub's welcome
-        hello = {"op": "hello", "peer_id": self.peer_id}
-        if self._fastframe:
-            hello["caps"] = ["relay1"]
-        self._send_raw(hello)
-        for t in sorted(self._topics):
-            self._send_raw({"op": "sub", "topic": t})
-
-    def _drop(self) -> None:
-        """Connection died: close and arm the backoff timer (reconnect
-        mode), or propagate (legacy fail-fast mode)."""
-        if self.sock is not None:
-            try:
-                self.sock.close()
-            except OSError:
-                pass
-            self.sock = None
-        self._fast_hub = False  # renegotiate with whatever hub comes back
-        if not self._reconnect:
-            raise ConnectionError("bus closed")
-        self._backoff = min(self._backoff * 2, 4.0) if self._backoff else 0.25
-        self._next_attempt = time.monotonic() + self._backoff
-
-    def _try_reconnect(self) -> bool:
-        """One backoff-paced reconnect attempt; True if connected now."""
-        if self.sock is not None:
-            return True
-        if not self._reconnect:
-            return False  # closed or fail-fast client: stay down
-        if time.monotonic() < self._next_attempt:
-            return False
-        try:
-            self._connect()
-        except OSError:
-            self.sock = None
-            self._backoff = min(self._backoff * 2, 4.0) if self._backoff \
-                else 0.25
-            self._next_attempt = time.monotonic() + self._backoff
-            return False
-        trace.count("bus.reconnects")
-        trace.instant("bus.reconnect", peer_id=self.peer_id)
-        if self._on_reconnect:
-            self._on_reconnect()
-        return True
+    # -- back-compat views (home-shard semantics) -------------------------
+    @property
+    def port(self) -> int:
+        return self._links[shardmap.HOME_SHARD].port
 
     @property
-    def connected(self) -> bool:
-        return self.sock is not None
+    def sock(self):
+        return self._links[shardmap.HOME_SHARD].sock
+
+    @property
+    def hub_caps(self) -> Optional[list]:
+        return self._links[shardmap.HOME_SHARD].hub_caps
 
     @property
     def fast_hub(self) -> bool:
         """True once the hub's welcome negotiated the relay1 framing."""
-        return self._fast_hub
+        return self._links[shardmap.HOME_SHARD].fast_hub
+
+    @property
+    def connected(self) -> bool:
+        return self._links[shardmap.HOME_SHARD].sock is not None
+
+    @property
+    def num_shards(self) -> int:
+        return self._n
+
+    # -- connection management -------------------------------------------
+    def _connect(self, link: _Link,
+                 dial_timeout: Optional[float] = None) -> None:
+        """Dial one shard.  ``dial_timeout`` bounds the CONNECT only —
+        reconnect/lazy dials inside a role loop must not block for the
+        full I/O timeout against a SYN-dropping dead host (the C++
+        client bounds the same dial to 250 ms–1 s)."""
+        link.attempted = True
+        link.sock = socket.create_connection(
+            (self._host, link.port),
+            timeout=self._timeout if dial_timeout is None else dial_timeout)
+        link.sock.settimeout(self._timeout)
+        link.buf = b""
+        link.backoff = 0.0
+        link.fast_hub = False  # renegotiated by the hub's welcome
+        hello = {"op": "hello", "peer_id": self.peer_id}
+        caps = (["relay1"] if self._fastframe else [])
+        # shard1 is orthogonal to the relay framing: a pool client must
+        # advertise it even with JG_BUS_FASTFRAME=0, or busd would count
+        # its span wildcards as peering interest and double-deliver.  It
+        # rides only on a real pool — the single-hub hello (and the
+        # JG_BUS_SHARDS=1 kill switch) stays byte-identical.
+        if self._n > 1:
+            caps.append("shard1")
+        if caps:
+            hello["caps"] = caps
+        self._send_raw(link, hello)
+        for t in sorted(link.topics):
+            self._send_raw(link, {"op": "sub", "topic": t})
+
+    def _drop(self, link: _Link) -> None:
+        """Connection died: close and arm the backoff timer (reconnect
+        mode / non-home shard), or propagate (legacy fail-fast mode —
+        HOME shard only: one dead shard degrades, it doesn't destroy)."""
+        if link.sock is not None:
+            try:
+                link.sock.close()
+            except OSError:
+                pass
+            link.sock = None
+        link.fast_hub = False  # renegotiate with whatever hub comes back
+        if link.shard == shardmap.HOME_SHARD and not self._reconnect:
+            raise ConnectionError("bus closed")
+        link.backoff = min(link.backoff * 2, 4.0) if link.backoff else 0.25
+        link.next_attempt = time.monotonic() + link.backoff
+
+    def _try_reconnect(self, link: _Link) -> bool:
+        """One backoff-paced reconnect attempt; True if connected now."""
+        if link.sock is not None:
+            return True
+        if self._closed:
+            return False
+        if link.shard == shardmap.HOME_SHARD and not self._reconnect:
+            return False  # closed or fail-fast client: stay down
+        if time.monotonic() < link.next_attempt:
+            return False
+        try:
+            self._connect(link,
+                          dial_timeout=min(max(link.backoff, 0.25), 1.0))
+        except OSError:
+            link.sock = None
+            link.backoff = min(link.backoff * 2, 4.0) if link.backoff \
+                else 0.25
+            link.next_attempt = time.monotonic() + link.backoff
+            return False
+        trace.count("bus.reconnects")
+        trace.instant("bus.reconnect", peer_id=self.peer_id,
+                      shard=link.shard)
+        self._flush_outbox(link)
+        if self._on_reconnect and link.shard == shardmap.HOME_SHARD:
+            self._on_reconnect()
+        return True
+
+    def _ensure_link(self, shard: int) -> _Link:
+        """The link for ``shard``, connected lazily on first use (a shard
+        nobody publishes or subscribes to is never dialed)."""
+        link = self._links[shard]
+        if link.sock is None and not link.attempted and not self._closed:
+            # never attempted: dial now (failures arm the backoff; links
+            # that HAVE died stay down until the reconnect machinery —
+            # which honors the reconnect/home semantics — revives them)
+            try:
+                self._connect(link, dial_timeout=0.25)
+            except OSError:
+                link.sock = None
+                link.backoff = 0.25
+                link.next_attempt = time.monotonic() + link.backoff
+        return link
+
+    def _flush_outbox(self, link: _Link) -> None:
+        """Replay outboxed control-plane frames owned by a link that just
+        came back; frames for still-down shards stay queued.  Iterates a
+        SNAPSHOT: a send failure mid-replay re-queues through
+        _outbox_maybe, which must not mutate the deque being walked —
+        and once the link drops again, the rest stays queued for the
+        next reconnect."""
+        if not self._outbox:
+            return
+        pending = list(self._outbox)
+        self._outbox.clear()
+        for i, (topic, data) in enumerate(pending):
+            if shardmap.shard_of(topic, self._n) != link.shard:
+                self._outbox.append((topic, data))
+                continue
+            if link.sock is None:
+                # died mid-replay: keep this and everything after it
+                for item in pending[i:]:
+                    self._outbox.append(item)
+                return
+            self._publish_on(link, topic, data)
+            self.registry.count("bus.pub_replayed", topic=topic)
 
     # -- protocol ---------------------------------------------------------
-    def _send_raw(self, obj: dict) -> None:
-        assert self.sock is not None
-        self.sock.sendall((json.dumps(obj) + "\n").encode())
+    def _send_raw(self, link: _Link, obj: dict) -> None:
+        assert link.sock is not None
+        link.sock.sendall((json.dumps(obj) + "\n").encode())
 
-    def _send(self, obj: dict) -> None:
-        if self.sock is None:
-            self._try_reconnect()
-        if self.sock is None:
+    def _send(self, link: _Link, obj: dict) -> None:
+        if link.sock is None:
+            self._try_reconnect(link)
+        if link.sock is None:
             return  # disconnected: lossy medium, drop
         try:
-            self._send_raw(obj)
+            self._send_raw(link, obj)
         except OSError:
-            self._drop()
+            self._drop(link)
 
     def subscribe(self, topic: str) -> None:
-        self._topics.add(topic)
-        self._send({"op": "sub", "topic": topic})
+        for s in shardmap.shards_for_subscription(topic, self._n):
+            link = self._ensure_link(s)
+            link.topics.add(topic)
+            self._send(link, {"op": "sub", "topic": topic})
 
     def unsubscribe(self, topic: str) -> None:
-        self._topics.discard(topic)
-        self._send({"op": "unsub", "topic": topic})
+        for s in shardmap.shards_for_subscription(topic, self._n):
+            link = self._links[s]
+            link.topics.discard(topic)
+            self._send(link, {"op": "unsub", "topic": topic})
 
-    def publish(self, topic: str, data: dict) -> None:
-        if self._fast_hub and " " not in topic:
+    def _publish_on(self, link: _Link, topic: str, data: dict) -> None:
+        if link.fast_hub and " " not in topic:
             # fast framing: the hub relays on a topic peek, no JSON parse
             line = f"P{topic} " + json.dumps(data)
         else:
             line = json.dumps({"op": "pub", "topic": topic, "data": data})
-        if self.sock is None:
-            self._try_reconnect()
-        if self.sock is None:
-            return  # dropped frames are NOT counted as sent (matches C++)
         try:
             wire = (line + "\n").encode()
-            self.sock.sendall(wire)
+            link.sock.sendall(wire)
             # count ACTUAL wire bytes (framed line + newline), per topic
             self.registry.count("bus.msgs_sent", topic=topic)
             self.registry.count("bus.bytes_sent", len(wire), topic=topic)
         except OSError:
             self.registry.count("bus.send_drops")
-            self._drop()
+            self._outbox_maybe(topic, data)
+            self._drop(link)
+
+    def _outbox_maybe(self, topic: str, data: dict) -> None:
+        """Queue a dropped frame for replay-on-reconnect — control-plane
+        topics only (droppable beacon streams are superseded by the next
+        beat; replaying them would only add stale load)."""
+        if self._outbox_max <= 0 or not _is_control_topic(topic):
+            return
+        if len(self._outbox) == self._outbox.maxlen:
+            self.registry.count("bus.outbox_overflow")
+        self._outbox.append((topic, data))
+
+    def publish(self, topic: str, data: dict) -> None:
+        link = self._ensure_link(shardmap.shard_of(topic, self._n))
+        if link.sock is None:
+            self._try_reconnect(link)
+        if link.sock is None:
+            # dropped frames are NOT counted as sent (matches C++); they
+            # ARE counted as drops, and control-plane frames queue for
+            # replay when the owning shard comes back
+            self.registry.count("bus.pub_dropped_disconnected", topic=topic)
+            self._outbox_maybe(topic, data)
+            return
+        self._publish_on(link, topic, data)
 
     def query_peers(self, topic: str) -> None:
-        self._send({"op": "peers", "topic": topic})
+        self._send(self._links[shardmap.HOME_SHARD],
+                   {"op": "peers", "topic": topic})
+
+    # -- receive ----------------------------------------------------------
+    def _parse_line(self, link: _Link, line: bytes) -> Optional[dict]:
+        """One framed line -> normalized frame dict, or None to skip."""
+        if line[:1] == b"M":
+            # fast relay frame: `M<topic> <from> <payload-json>` —
+            # normalized to the legacy msg-dict shape for callers
+            head, _, payload = line.partition(b" ")
+            sender, _, payload = payload.partition(b" ")
+            try:
+                data = json.loads(payload)
+            except json.JSONDecodeError:
+                return None  # garbage payload: ignore like any frame
+            topic = head[1:].decode(errors="replace")
+            self.registry.count("bus.msgs_received", topic=topic)
+            self.registry.count("bus.bytes_received", len(line) + 1,
+                                topic=topic)
+            return {"op": "msg", "topic": topic,
+                    "from": sender.decode(errors="replace"),
+                    "data": data}
+        try:
+            frame = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        if frame.get("op") == "msg":
+            # wire bytes: the framed line plus its newline
+            topic = frame.get("topic", "")
+            self.registry.count("bus.msgs_received", topic=topic)
+            self.registry.count("bus.bytes_received", len(line) + 1,
+                                topic=topic)
+        elif frame.get("op") == "welcome":
+            # caps negotiation: switch publishes to fast framing only
+            # when the hub advertises it (old hub -> legacy), per link
+            link.hub_caps = frame.get("caps") or []
+            link.fast_hub = (self._fastframe
+                             and "relay1" in link.hub_caps)
+        return frame
+
+    def _next_buffered(self) -> Optional[dict]:
+        """Pop the next complete frame already buffered on any link
+        (round-robin across shards, so one busy shard cannot starve the
+        others)."""
+        for k in range(self._n):
+            link = self._links[(self._rr + k) % self._n]
+            while True:
+                nl = link.buf.find(b"\n")
+                if nl < 0:
+                    break
+                line = link.buf[:nl]
+                link.buf = link.buf[nl + 1:]
+                frame = self._parse_line(link, line)
+                if frame is not None:
+                    self._rr = (link.shard + 1) % self._n
+                    return frame
+        return None
 
     def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
-        """Next frame (any op) or None on timeout.  In reconnect mode an
-        outage reads as a timeout (reconnect attempts ride each call)."""
+        """Next frame (any op, any shard) or None on timeout.  In
+        reconnect mode an outage reads as a timeout (backoff-paced
+        reconnect attempts ride each call); a non-home shard outage never
+        raises — its regions degrade while the rest of the pool flows."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            if self.sock is None:
-                if not self._try_reconnect():
-                    # wait out the lesser of caller timeout / next attempt
-                    wait = max(0.0, self._next_attempt - time.monotonic())
-                    if deadline is not None:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            return None
-                        wait = min(wait, remaining)
-                    time.sleep(min(wait, 0.25))
-                    if deadline is not None and time.monotonic() >= deadline:
-                        return None
-                    continue
-            nl = self._buf.find(b"\n")
-            if nl >= 0:
-                line = self._buf[:nl]
-                self._buf = self._buf[nl + 1:]
-                if line[:1] == b"M":
-                    # fast relay frame: `M<topic> <from> <payload-json>` —
-                    # normalized to the legacy msg-dict shape for callers
-                    head, _, payload = line.partition(b" ")
-                    sender, _, payload = payload.partition(b" ")
-                    try:
-                        data = json.loads(payload)
-                    except json.JSONDecodeError:
-                        continue  # garbage payload: ignore like any frame
-                    topic = head[1:].decode(errors="replace")
-                    self.registry.count("bus.msgs_received", topic=topic)
-                    self.registry.count("bus.bytes_received", len(line) + 1,
-                                        topic=topic)
-                    return {"op": "msg", "topic": topic,
-                            "from": sender.decode(errors="replace"),
-                            "data": data}
-                try:
-                    frame = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if frame.get("op") == "msg":
-                    # wire bytes: the framed line plus its newline
-                    topic = frame.get("topic", "")
-                    self.registry.count("bus.msgs_received", topic=topic)
-                    self.registry.count("bus.bytes_received", len(line) + 1,
-                                        topic=topic)
-                elif frame.get("op") == "welcome":
-                    # caps negotiation: switch publishes to fast framing
-                    # only when the hub advertises it (old hub -> legacy)
-                    self.hub_caps = frame.get("caps") or []
-                    self._fast_hub = (self._fastframe
-                                      and "relay1" in self.hub_caps)
+            frame = self._next_buffered()
+            if frame is not None:
                 return frame
-            try:
-                self.sock.settimeout(
-                    None if deadline is None
-                    else max(0.001, deadline - time.monotonic()))
-                chunk = self.sock.recv(65536)
-            except socket.timeout:
+            for link in self._links:
+                if link.sock is None and link.next_attempt > 0.0:
+                    self._try_reconnect(link)
+            socks = [link.sock for link in self._links
+                     if link.sock is not None]
+            if not socks:
+                # everything down: wait out the lesser of caller timeout /
+                # the nearest due attempt (matches the old outage wait)
+                wait = max((link.next_attempt for link in self._links),
+                           default=0.0) - time.monotonic()
+                wait = max(0.0, wait)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining)
+                time.sleep(min(wait, 0.25))
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+                continue
+            slice_s = 0.25 if deadline is None else \
+                max(0.001, min(0.25, deadline - time.monotonic()))
+            if deadline is not None and deadline - time.monotonic() <= 0:
                 return None
-            except OSError:
-                self._drop()
-                continue
-            if not chunk:
-                self._drop()
-                continue
-            self._buf += chunk
+            try:
+                readable, _, _ = select.select(socks, [], [], slice_s)
+            except (OSError, ValueError):
+                readable = []  # a sock died mid-select: sweep below
+            if not readable and deadline is not None \
+                    and time.monotonic() >= deadline:
+                return None
+            for sock in readable:
+                link = next(l for l in self._links if l.sock is sock)
+                try:
+                    sock.settimeout(self._timeout)
+                    chunk = sock.recv(65536)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    self._drop(link)
+                    continue
+                if not chunk:
+                    self._drop(link)
+                    continue
+                link.buf += chunk
 
     def messages(self, duration: float) -> Iterator[dict]:
         """Application messages received within ``duration`` seconds."""
@@ -263,9 +469,11 @@ class BusClient:
 
     def close(self) -> None:
         self._reconnect = False
-        if self.sock is not None:
-            try:
-                self.sock.close()
-            except OSError:
-                pass
-            self.sock = None
+        self._closed = True
+        for link in self._links:
+            if link.sock is not None:
+                try:
+                    link.sock.close()
+                except OSError:
+                    pass
+                link.sock = None

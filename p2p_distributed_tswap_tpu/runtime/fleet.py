@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 
 from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
 from p2p_distributed_tswap_tpu.obs import trace
+from p2p_distributed_tswap_tpu.runtime import buspool
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 BUILD_DIR = REPO_ROOT / "cpp" / "build"
@@ -69,7 +70,8 @@ class Fleet:
                  solver: str = "cpu", log_dir: Optional[str] = None,
                  env: Optional[dict] = None,
                  config: Optional[RuntimeConfig] = None,
-                 solverd_args: Optional[List[str]] = None):
+                 solverd_args: Optional[List[str]] = None,
+                 bus_shards: Optional[int] = None):
         assert mode in ("centralized", "decentralized")
         build = ensure_built()
         self.procs: List[subprocess.Popen] = []
@@ -109,7 +111,23 @@ class Fleet:
             return p
 
         map_args = ["--map", map_file] if map_file else []
-        spawn("bus", [str(build / "mapd_bus"), str(port)])
+        # Sharded bus pool (ISSUE 6): JG_BUS_SHARDS (or the bus_shards
+        # arg) spawns that many federated busd shards — shard 0 keeps
+        # `port` so external tools (fleet_top, harness watchers) reach
+        # the control plane at the advertised address, and every child
+        # gets JG_BUS_SHARD_PORTS so its BusClient routes per shard.
+        # The default (1) is today's single hub, byte-identical.
+        shards = int(bus_shards if bus_shards is not None
+                     else (env or {}).get("JG_BUS_SHARDS")
+                     or os.environ.get("JG_BUS_SHARDS", "1") or 1)
+        self.bus_pool = buspool.BusPool(
+            build / "mapd_bus", num_shards=max(1, shards), home_port=port,
+            spawn=lambda name, cmd: spawn(name, cmd), settle_s=0.0)
+        # THIS pool is the children's bus — a stale JG_BUS_SHARD_PORTS
+        # inherited from the operator's shell (a previous manual pool)
+        # must never leak into a fresh fleet
+        penv.pop(buspool.SHARD_PORTS_ENV, None)
+        penv.update(self.bus_pool.env())
         time.sleep(0.3)
         if mode == "centralized" and solver == "tpu":
             # --solver=tpu planning happens in the JAX solver daemon

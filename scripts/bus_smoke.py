@@ -13,11 +13,21 @@ no cmake needed; SKIPs with a warning when no toolchain exists), then:
   wildcard watcher every region beacon, and the hub's own metrics beacon
   must report the fan-out.
 
-Exit 0 on success; ~5 s end to end.
+``--shards 3`` (ISSUE 6) runs the FEDERATED-POOL smoke instead: a
+3-shard busd pool with peering links, a shard-aware publisher spraying
+region beacons across every owning shard, a shard-aware wildcard watcher
+(must see each beacon exactly once — the duplicate-suppression rule), a
+LEGACY client parked on a non-home shard (must still see control-plane
+frames via peering), then one non-home shard is hard-killed: the
+surviving shards must keep relaying and the control plane must stay up
+(the one-dead-shard degradation contract).
+
+Exit 0 on success; ~5 s (single) / ~10 s (pool) end to end.
 """
 
 from __future__ import annotations
 
+import argparse
 import subprocess
 import sys
 import time
@@ -27,19 +37,115 @@ ROOT = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(ROOT))
 
 from p2p_distributed_tswap_tpu.runtime import plan_codec as pc  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import shardmap  # noqa: E402
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime.buspool import (  # noqa: E402
+    BusPool, free_port)
 from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu  # noqa: E402
 
 
-def free_port():
-    import socket
+def _drain(client, seconds: float, sink):
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        f = client.recv(timeout=0.1)
+        if f and f.get("op") == "msg":
+            sink.append((f["topic"], f.get("data") or {}))
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+
+def sharded_smoke(binary, num_shards: int) -> int:
+    with BusPool(binary, num_shards=num_shards, settle_s=1.0) as pool:
+        ports = pool.ports
+        # shard-aware wildcard watcher + control subscriber
+        watch = BusClient(port=ports[0], peer_id="watch",
+                          shard_ports=ports)
+        watch.subscribe("mapd.pos.*")
+        watch.subscribe("smoke")
+        # legacy single-connection client parked on a NON-home shard:
+        # control frames must reach it over the peering links
+        legacy = BusClient(port=ports[-1], peer_id="legacy")
+        legacy.subscribe("smoke")
+        pub = BusClient(port=ports[0], peer_id="pub", shard_ports=ports)
+        time.sleep(0.5)
+
+        n_pos, n_ctl = 60, 20
+        topics = [f"mapd.pos.{k % 7}.{k % 5}" for k in range(n_pos)]
+        owners = {shardmap.shard_of(t, num_shards) for t in topics}
+        assert len(owners) > 1, (
+            f"shardmap degenerated: all region topics on one shard "
+            f"({owners})")
+        for k, t in enumerate(topics):
+            pub.publish(t, {"type": "pos1", "seq": k})
+        for k in range(n_ctl):
+            pub.publish("smoke", {"seq": k})
+
+        got_watch, got_legacy = [], []
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and (
+                sum(1 for t, _ in got_watch if t != "smoke") < n_pos
+                or sum(1 for t, _ in got_watch if t == "smoke") < n_ctl
+                or len(got_legacy) < n_ctl):
+            _drain(watch, 0.2, got_watch)
+            _drain(legacy, 0.2, got_legacy)
+        pos_seqs = sorted(d["seq"] for t, d in got_watch if t != "smoke")
+        ctl_seqs = [d["seq"] for t, d in got_watch if t == "smoke"]
+        assert pos_seqs == list(range(n_pos)), (
+            f"wildcard watcher across {num_shards} shards saw "
+            f"{len(pos_seqs)}/{n_pos} beacons (dupes or losses): "
+            f"{pos_seqs[:20]}...")
+        assert ctl_seqs == list(range(n_ctl)), ctl_seqs
+        legacy_seqs = [d["seq"] for _, d in got_legacy]
+        assert legacy_seqs == list(range(n_ctl)), (
+            f"legacy client on shard {num_shards - 1} missed control "
+            f"frames via peering: {legacy_seqs}")
+
+        # kill one NON-home shard: its regions go dark, everything else
+        # must keep flowing (and nothing crashes)
+        dead = next(s for s in sorted(owners) if s != 0)
+        pool.kill_shard(dead)
+        time.sleep(1.0)
+        survivors = [t for t in topics
+                     if shardmap.shard_of(t, num_shards) != dead]
+        for k, t in enumerate(survivors):
+            pub.publish(t, {"type": "pos1", "seq": 1000 + k})
+        for k in range(5):
+            pub.publish("smoke", {"seq": 1000 + k})
+        got_watch.clear()
+        got_legacy.clear()
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and (
+                sum(1 for t, _ in got_watch if t != "smoke")
+                < len(survivors)
+                or len(got_legacy) < 5):
+            _drain(watch, 0.2, got_watch)
+            _drain(legacy, 0.2, got_legacy)
+        pos2 = sorted(d["seq"] for t, d in got_watch if t != "smoke")
+        assert pos2 == [1000 + k for k in range(len(survivors))], (
+            f"surviving shards degraded after shard {dead} kill: "
+            f"{len(pos2)}/{len(survivors)}")
+        assert [d["seq"] for _, d in got_legacy] \
+            == [1000 + k for k in range(5)], (
+            "control plane lost frames after a region shard died")
+        for c in (watch, legacy, pub):
+            c.close()
+        print(f"bus smoke OK (sharded): {num_shards}-shard pool, {n_pos} "
+              f"cross-shard beacons seen exactly once, {n_ctl} control "
+              f"frames via peering, shard-{dead} kill degraded only its "
+              f"regions")
+        return 0
 
 
 def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=1,
+                    help="run the federated-pool smoke with this many "
+                         "busd shards (default: single-hub smoke)")
+    args = ap.parse_args()
+    if args.shards > 1:
+        binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
+        if binary is None:
+            print("bus smoke: SKIPPED (no g++/binary)", file=sys.stderr)
+            return 0
+        return sharded_smoke(binary, args.shards)
     binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
     if binary is None:
         print("bus smoke: SKIPPED (no g++/binary)", file=sys.stderr)

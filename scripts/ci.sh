@@ -29,6 +29,8 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/obs/flightrec.py \
     p2p_distributed_tswap_tpu/obs/fleet_aggregator.py \
     p2p_distributed_tswap_tpu/runtime/region.py \
+    p2p_distributed_tswap_tpu/runtime/shardmap.py \
+    p2p_distributed_tswap_tpu/runtime/buspool.py \
     scripts/bus_smoke.py \
     scripts/trace_smoke.py \
     bench.py
@@ -48,6 +50,12 @@ echo "== busd relay micro-smoke =="
 # N-client fanout sanity under the fast relay framing (ISSUE 4): fast +
 # legacy subscribers, wildcard region watcher, hub fanout counters
 JAX_PLATFORMS=cpu python scripts/bus_smoke.py
+
+echo "== busd shard-pool smoke =="
+# federated 3-shard pool (ISSUE 6): cross-shard publish, wildcard
+# spanning without duplicates, peering to a legacy client, and the
+# one-shard-kill degradation contract
+JAX_PLATFORMS=cpu python scripts/bus_smoke.py --shards 3
 
 echo "== trace smoke =="
 # ISSUE 5: a tiny live fleet under JG_TRACE=1 JG_TRACE_SAMPLE=1.0 must

@@ -1,0 +1,445 @@
+"""Federated multi-busd message plane (ISSUE 6): shardmap golden +
+property tests, peering loop prevention, the disconnected-publish outbox,
+single-hub wire byte-identity (the JG_BUS_SHARDS=1 kill switch), and the
+kill-one-shard live-fleet degradation contract.
+
+The busd-backed tests compile ``cpp/busd/main.cpp`` with a bare ``g++``
+when no prebuilt ``mapd_bus`` exists (single translation unit — no
+cmake/ninja needed), exactly like tests/test_region_bus.py.
+"""
+
+import json
+import socket
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.runtime import region, shardmap  # noqa: F401
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+from p2p_distributed_tswap_tpu.runtime.buspool import BusPool, free_port
+from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def busd_binary() -> Path:
+    binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    return binary
+
+
+def golden_binary() -> Path:
+    binary = build_single_tu("mapd_codec_golden",
+                             "cpp/probes/codec_golden.cpp")
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    return binary
+
+
+# ---------------------------------------------------------------------------
+# shardmap: ownership properties + py↔cpp golden
+# ---------------------------------------------------------------------------
+
+def test_every_topic_owned_by_exactly_one_shard():
+    """The ownership invariant the whole plane rests on: shard_of is a
+    deterministic total function into [0, n) — every topic has exactly
+    one owner, and an exact subscription goes exactly there."""
+    rng = np.random.default_rng(11)
+    topics = ["mapd", "mapd.path", "mapd.metrics", "solver", "smoke",
+              "mapd.pos.weird", "mapd.pos.x.y", "mapd.pos.1.2.3"]
+    topics += [f"mapd.pos.{int(rng.integers(64))}.{int(rng.integers(64))}"
+               for _ in range(200)]
+    for n in (1, 2, 3, 5, 8):
+        for t in topics:
+            s1 = shardmap.shard_of(t, n)
+            s2 = shardmap.shard_of(t, n)
+            assert s1 == s2, "shard_of must be deterministic"
+            assert 0 <= s1 < n
+            assert shardmap.shards_for_subscription(t, n) == [s1]
+
+
+def test_control_plane_lives_on_home_shard():
+    for n in (2, 3, 8):
+        for t in ("mapd", "mapd.path", "mapd.metrics", "solver",
+                  "anything.else"):
+            assert shardmap.shard_of(t, n) == shardmap.HOME_SHARD
+
+
+def test_pos_topics_spread_and_wildcard_spans():
+    """Region topics must actually use the pool (no degenerate map), and
+    the pos wildcard must span every shard — while a non-pos wildcard
+    stays home."""
+    for n in (2, 3, 5):
+        owners = {shardmap.shard_of(f"mapd.pos.{x}.{y}", n)
+                  for x in range(16) for y in range(16)}
+        assert owners == set(range(n)), (n, owners)
+        assert shardmap.shards_for_subscription("mapd.pos.*", n) \
+            == list(range(n))
+        assert shardmap.shards_for_subscription("mapd.pos.3.*", n) \
+            == list(range(n))
+        # "mapd.*" can match pos topics too: must span
+        assert shardmap.shards_for_subscription("mapd.*", n) \
+            == list(range(n))
+        assert shardmap.shards_for_subscription("solver.*", n) \
+            == [shardmap.HOME_SHARD]
+    assert shardmap.shards_for_subscription("mapd.pos.*", 1) == [0]
+
+
+def test_shard_ports_parsing():
+    assert shardmap.parse_shard_ports("7450,7451, 7452") \
+        == [7450, 7451, 7452]
+    with pytest.raises(ValueError):
+        shardmap.parse_shard_ports("")
+    with pytest.raises(ValueError):
+        shardmap.parse_shard_ports("74x0")
+
+
+def test_shardmap_golden_matches_cpp():
+    """py and cpp must make IDENTICAL routing choices for every topic —
+    a divergence silently splits the fleet across shards."""
+    binary = golden_binary()
+    rng = np.random.default_rng(5)
+    cases = []
+    for _ in range(120):
+        n = int(rng.integers(1, 9))
+        kind = rng.random()
+        if kind < 0.5:
+            t = f"mapd.pos.{int(rng.integers(100))}.{int(rng.integers(100))}"
+        elif kind < 0.65:
+            t = "mapd.pos." + "".join(
+                chr(97 + int(c)) for c in rng.integers(0, 26, size=5))
+        elif kind < 0.8:
+            t = ["mapd", "mapd.path", "mapd.metrics", "solver"][
+                int(rng.integers(4))]
+        else:
+            t = ["mapd.pos.*", "mapd.*", "solver.*", "mapd.pos.7.*"][
+                int(rng.integers(4))]
+        cases.append((t, n))
+    feed = "\n".join(json.dumps({"topic": t, "shards": n})
+                     for t, n in cases) + "\n"
+    out = subprocess.run([str(binary), "--shardmap"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=60)
+    for (t, n), line in zip(cases, out.stdout.splitlines()):
+        got = json.loads(line)
+        assert got["shard"] == shardmap.shard_of(t, n), (t, n, got)
+        assert got["subs"] == shardmap.shards_for_subscription(t, n), \
+            (t, n, got)
+
+
+# ---------------------------------------------------------------------------
+# peering: loop prevention + cross-shard healing
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def pool3(tmp_path):
+    with BusPool(busd_binary(), num_shards=3, log_dir=tmp_path,
+                 extra_args=["--log-level", "debug"],
+                 settle_s=0.8) as pool:
+        yield pool
+
+
+def _collect(client, want: int, budget_s: float = 6.0):
+    got = []
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline and len(got) < want:
+        f = client.recv(timeout=0.2)
+        if f and f.get("op") == "msg":
+            got.append(f)
+    return got
+
+
+def test_peering_no_loops_no_duplicates(pool3):
+    """One frame published into the pool arrives EXACTLY ONCE at every
+    subscriber, wherever it sits — the origin-tagged one-hop relay rule
+    means a frame can neither loop between busds nor double-deliver
+    through the full mesh."""
+    ports = pool3.ports
+    # a legacy subscriber parked on EVERY shard, same control topic
+    subs = []
+    for i, p in enumerate(ports):
+        c = BusClient(port=p, peer_id=f"sub{i}")
+        c.subscribe("loopcheck")
+        subs.append(c)
+    pub = BusClient(port=ports[1], peer_id="pub")  # non-home origin
+    time.sleep(0.5)
+    pub.publish("loopcheck", {"n": 1})
+    for c in subs:
+        got = _collect(c, 1)
+        assert len(got) == 1 and got[0]["data"] == {"n": 1}, (
+            f"{c.peer_id}: {got}")
+    # no late echoes: a loop would keep frames circulating
+    time.sleep(1.0)
+    for c in subs:
+        extra = _collect(c, 1, budget_s=0.7)
+        assert extra == [], f"{c.peer_id} saw a duplicate: {extra}"
+    for c in subs:
+        c.close()
+    pub.close()
+
+
+def test_misrouted_publish_heals_via_peering(pool3):
+    """A legacy client attached to the WRONG shard publishes a region
+    topic; the exact subscriber at the owning shard must still get it —
+    interest-scoped peering routes around client-side ignorance."""
+    ports = pool3.ports
+    topic = "mapd.pos.1.0"
+    owner = shardmap.shard_of(topic, 3)
+    wrong = next(i for i in range(3) if i != owner)
+    sub = BusClient(port=ports[owner], peer_id="sub")
+    sub.subscribe(topic)
+    pub = BusClient(port=ports[wrong], peer_id="oldpub")
+    time.sleep(0.5)
+    pub.publish(topic, {"type": "pos1", "seq": 7})
+    got = _collect(sub, 1)
+    assert len(got) == 1 and got[0]["data"]["seq"] == 7, got
+    sub.close()
+    pub.close()
+
+
+def test_shard_aware_wildcard_no_duplicates_fastframe_off(pool3,
+                                                         monkeypatch):
+    """shard1 is orthogonal to the relay framing: with JG_BUS_FASTFRAME=0
+    a pool client must STILL advertise shard1, or busd counts its span
+    wildcard as peering interest and double-delivers every beacon."""
+    monkeypatch.setenv("JG_BUS_FASTFRAME", "0")
+    ports = pool3.ports
+    aware = BusClient(port=ports[0], peer_id="aware0", shard_ports=ports)
+    aware.subscribe("mapd.pos.*")
+    pub = BusClient(port=ports[0], peer_id="pub0", shard_ports=ports)
+    time.sleep(0.5)
+    topics = [f"mapd.pos.{k}.{k % 3}" for k in range(9)]
+    for k, t in enumerate(topics):
+        pub.publish(t, {"seq": k})
+    got = _collect(aware, len(topics))
+    assert sorted(f["data"]["seq"] for f in got) == list(range(len(topics)))
+    extra = _collect(aware, 1, budget_s=0.7)
+    assert extra == [], f"duplicates with fastframe off: {extra}"
+    aware.close()
+    pub.close()
+
+
+def test_shard_aware_wildcard_no_duplicates(pool3):
+    """A shard-aware wildcard subscriber connects to every shard itself;
+    busd must NOT also forward it peer-relayed copies (the span-aware
+    suppression) — each beacon exactly once, even when a legacy wildcard
+    watcher on the home shard pulls the same beacons over peering."""
+    ports = pool3.ports
+    aware = BusClient(port=ports[0], peer_id="aware", shard_ports=ports)
+    aware.subscribe("mapd.pos.*")
+    legacy = BusClient(port=ports[0], peer_id="legacywild")
+    legacy.subscribe("mapd.pos.*")
+    pub = BusClient(port=ports[0], peer_id="pub", shard_ports=ports)
+    time.sleep(0.5)
+    topics = [f"mapd.pos.{k}.{k % 3}" for k in range(12)]
+    assert len({shardmap.shard_of(t, 3) for t in topics}) == 3
+    for k, t in enumerate(topics):
+        pub.publish(t, {"seq": k})
+    for name, c in (("aware", aware), ("legacy", legacy)):
+        got = _collect(c, len(topics))
+        seqs = sorted(f["data"]["seq"] for f in got)
+        assert seqs == list(range(len(topics))), (name, seqs)
+        extra = _collect(c, 1, budget_s=0.7)
+        assert extra == [], f"{name} saw duplicates: {extra}"
+    aware.close()
+    legacy.close()
+    pub.close()
+
+
+# ---------------------------------------------------------------------------
+# disconnected publish: drop counter + control-plane replay outbox
+# ---------------------------------------------------------------------------
+
+def test_publish_drop_counted_and_control_replayed(tmp_path):
+    """Publishing into a bus outage: every drop is counted, and
+    control-plane frames come back out of the outbox when the bus does —
+    a command published into a bounce is delayed, not lost.  Droppable
+    beacon topics are NOT replayed (superseded streams)."""
+    from p2p_distributed_tswap_tpu.obs import registry as reg
+
+    binary = busd_binary()
+    port = free_port()
+
+    def start_busd():
+        return subprocess.Popen(
+            [str(binary), str(port)], stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    bus = start_busd()
+    try:
+        time.sleep(0.4)
+        r = reg.Registry()
+        client = BusClient(port=port, peer_id="replayer", reconnect=True,
+                           registry=r)
+        client.subscribe("ctl")
+        watcher = BusClient(port=port, peer_id="watcher", reconnect=True)
+        watcher.subscribe("ctl")
+        time.sleep(0.3)
+
+        bus.terminate()
+        bus.wait(timeout=5)
+        # let both clients notice the outage
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and client.connected:
+            client.recv(timeout=0.2)
+        assert not client.connected
+
+        for k in range(3):
+            client.publish("ctl", {"type": "cmd", "seq": k})
+        client.publish("mapd.pos.0.0", {"type": "pos1", "seq": 99})
+        snap = r.snapshot()["counters"]
+        dropped = sum(v for key, v in snap.items()
+                      if key.startswith("bus.pub_dropped_disconnected"))
+        assert dropped == 4, snap
+
+        # drop the watcher's dead socket too, so it reconnects (below)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and watcher.connected:
+            watcher.recv(timeout=0.2)
+
+        bus = start_busd()
+        # the WATCHER must be back and resubscribed before the replayer
+        # flushes, or the replay fans out to nobody (the outbox preserves
+        # frames across the client's outage — not subscribers')
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not watcher.connected:
+            watcher.recv(timeout=0.2)
+        assert watcher.connected
+        time.sleep(0.4)  # the re-sub must land in busd before the flush
+        got = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and len(got) < 3:
+            client.recv(timeout=0.1)  # drives replayer's reconnect+flush
+            f = watcher.recv(timeout=0.1)
+            if f and f.get("op") == "msg":
+                got.append((f["topic"], f["data"]))
+        assert [d["seq"] for t, d in got if t == "ctl"] == [0, 1, 2], got
+        # the beacon frame must NOT have been replayed
+        assert all(t == "ctl" for t, _ in got), got
+        snap = r.snapshot()["counters"]
+        replayed = sum(v for key, v in snap.items()
+                       if key.startswith("bus.pub_replayed"))
+        assert replayed == 3, snap
+        client.close()
+        watcher.close()
+    finally:
+        bus.terminate()
+
+
+# ---------------------------------------------------------------------------
+# kill switch: the single-hub wire is byte-identical
+# ---------------------------------------------------------------------------
+
+def test_single_shard_wire_bytes_unchanged():
+    """JG_BUS_SHARDS=1 (a single port) must keep the exact pre-pool
+    wire: hello advertises relay1 only (no shard1 cap), and publishes
+    render byte-identically — pinned here against a raw socket."""
+    received = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def server():
+        conn, _ = srv.accept()
+        conn.sendall(b'{"op":"welcome","peer_id":"x","caps":["relay1"]}\n')
+        end = time.monotonic() + 3
+        buf = b""
+        while time.monotonic() < end and buf.count(b"\n") < 4:
+            conn.settimeout(0.5)
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            if not chunk:
+                break
+            buf += chunk
+        received.append(buf)
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    c = BusClient(port=port, peer_id="pinned")
+    c.subscribe("mapd")
+    # drain the welcome so fast framing arms, exactly like a live client
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not c.fast_hub:
+        c.recv(timeout=0.2)
+    c.publish("mapd", {"type": "task", "task_id": 1})
+    c.publish("mapd pos", {"k": 1})  # space in topic: legacy JSON path
+    c.close()
+    t.join(timeout=5)
+    srv.close()
+    lines = received[0].split(b"\n")
+    assert lines[0] == b'{"op": "hello", "peer_id": "pinned", ' \
+        b'"caps": ["relay1"]}', lines[0]
+    assert lines[1] == b'{"op": "sub", "topic": "mapd"}', lines[1]
+    assert lines[2] == b'P' + b'mapd {"type": "task", "task_id": 1}', \
+        lines[2]
+    assert lines[3] == b'{"op": "pub", "topic": "mapd pos", ' \
+        b'"data": {"k": 1}}', lines[3]
+
+
+# ---------------------------------------------------------------------------
+# live fleet: one dead shard degrades its regions, not the fleet
+# ---------------------------------------------------------------------------
+
+def _runtime_binaries_available() -> bool:
+    build = ROOT / "cpp" / "build"
+    return all((build / b).exists()
+               for b in ("mapd_bus", "mapd_manager_decentralized",
+                         "mapd_agent_decentralized"))
+
+
+def test_fleet_survives_region_shard_kill(tmp_path):
+    """Kill one NON-home bus shard under a live decentralized fleet: the
+    dead shard's region beacons go dark, but the control plane (home
+    shard) keeps dispatching and the fleet keeps COMPLETING tasks — the
+    ISSUE 6 acceptance drill.  Small regions (4 cells on a 12x12 map)
+    give 9 region topics spread across all 3 shards."""
+    from p2p_distributed_tswap_tpu.runtime.fleet import Fleet
+
+    if not _runtime_binaries_available():
+        pytest.skip("runtime binaries not built")
+    tiny_map = tmp_path / "tiny.map.txt"
+    tiny_map.write_text("\n".join(["." * 12] * 12) + "\n")
+    log_dir = tmp_path / "logs"
+
+    def agents_done() -> int:
+        done = 0
+        for f in log_dir.glob("agent_*.log"):
+            done += f.read_text(errors="ignore").count("DONE")
+        return done
+
+    with Fleet("decentralized", num_agents=3, port=free_port(),
+               map_file=str(tiny_map), log_dir=str(log_dir),
+               env={"JG_REGION_CELLS": "4"}, bus_shards=3) as fleet:
+        assert len(fleet.bus_pool.ports) == 3
+        time.sleep(4)  # discovery + initial positions
+        fleet.command("tasks 3")
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline and agents_done() < 2:
+            time.sleep(0.5)
+        before = agents_done()
+        assert before >= 2, "fleet not completing tasks pre-kill"
+
+        # kill a non-home shard (owns a third of the region topics)
+        fleet.bus_pool.kill_shard(1)
+        time.sleep(1.0)
+        fleet.command("tasks 3")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and agents_done() < before + 2:
+            fleet.command("tasks 1")
+            time.sleep(2.0)
+        after = agents_done()
+        fleet.quit()
+        assert after >= before + 2, (
+            f"fleet stopped completing tasks after a region shard died "
+            f"({before} -> {after}): " + "".join(
+                f.read_text(errors='ignore')[-400:]
+                for f in sorted(log_dir.glob('*.log'))))
