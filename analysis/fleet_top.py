@@ -27,6 +27,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from p2p_distributed_tswap_tpu.obs import slo as _slo  # noqa: E402
 from p2p_distributed_tswap_tpu.obs.beacon import METRICS_TOPIC  # noqa: E402
 from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (  # noqa: E402
     FleetAggregator,
@@ -38,7 +39,7 @@ def _fmt(v, spec: str = "", dash: str = "-") -> str:
     return dash if v is None else format(v, spec)
 
 
-def render(rollup: dict) -> str:
+def render(rollup: dict, spec=None, color: bool = False) -> str:
     """Plain-text table over the rollup (the live view body)."""
     f = rollup["fleet"]
     lines = [
@@ -82,6 +83,21 @@ def render(rollup: dict) -> str:
             f" peer rx/tx={p['bus']['peer_rx_msgs']}/{p['bus']['peer_tx_msgs']}"
             f" drops={p['bus']['slow_consumer_drops']}"
             for peer, p in bus_rows))
+    # fleet task throughput (ISSUE 7): manager done-counter derivations
+    if f.get("tasks_dispatched") is not None:
+        ratio = f.get("completion_ratio")
+        lines.append(
+            f"TASKS fleet {_fmt(f.get('tasks_per_s'), '.2f')}/s"
+            f"  completion "
+            f"{_fmt(None if ratio is None else 100 * ratio, '.1f')}%"
+            f"  dispatched {f['tasks_dispatched']}"
+            f"  done {f['tasks_completed']}")
+    # live SLO verdicts from the active spec (rollup-resolvable signals
+    # only — phase-attribution SLOs read unknown without an event dir,
+    # which is the honest live answer, never a silent pass)
+    if spec is not None:
+        result = _slo.evaluate(spec, _slo.signals_from_rollup(rollup))
+        lines.append(_slo.render_line(result, color=color))
     return "\n".join(lines)
 
 
@@ -117,7 +133,16 @@ def main(argv=None) -> int:
                     help="--once collection window (seconds; spans at "
                          "least two 2 s beacon intervals by default)")
     ap.add_argument("--budget-ms", type=float, default=500.0)
+    ap.add_argument("--slo-spec", default=None, metavar="FILE",
+                    help="SLO spec JSON to judge the live rollup against "
+                         "('default' = the built-in rated-load spec); "
+                         "adds a red/green verdict line per SLO")
     args = ap.parse_args(argv)
+
+    spec = None
+    if args.slo_spec is not None:
+        spec = _slo.load_spec(
+            None if args.slo_spec == "default" else args.slo_spec)
 
     try:
         bus = BusClient(host=args.host, port=args.port, peer_id="fleet_top",
@@ -136,14 +161,23 @@ def main(argv=None) -> int:
             print("fleet_top: no metrics beacons observed "
                   f"within {args.wait:.1f}s", file=sys.stderr)
             return 1
-        print(json.dumps(rollup, indent=2) if args.json else render(rollup))
+        if args.json:
+            if spec is not None:
+                # the JSON consumer gets the verdicts too — --slo-spec
+                # must never be silently ignored by an output mode
+                rollup["slo"] = _slo.evaluate(
+                    spec, _slo.signals_from_rollup(rollup))
+            print(json.dumps(rollup, indent=2))
+        else:
+            print(render(rollup, spec=spec))
         return 0
 
     try:
         while True:
             collect(agg, bus, args.interval)
             # ANSI clear + home: a poor man's curses, pipe-safe
-            out = render(agg.rollup())
+            out = render(agg.rollup(), spec=spec,
+                         color=sys.stdout.isatty())
             if sys.stdout.isatty():
                 sys.stdout.write("\x1b[2J\x1b[H")
             print(out, flush=True)

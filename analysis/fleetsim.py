@@ -1,0 +1,526 @@
+#!/usr/bin/env python
+"""Fleetsim: saturation-aware load generation + SLO verdicts (ISSUE 7).
+
+The crossover harness (analysis/solver_crossover.py) proved that
+simulated agents can close the task loop over the real wire; this
+harness grows that into the production traffic rehearsal ROADMAP item 4
+names: a load generator that multiplexes thousands of wire-faithful
+agents in one process (runtime/simagent.py — pos1 region beacons,
+trace-context propagation, done-retransmit), drives the sharded busd
+pool + the centralized manager (+ solverd with ``--solver tpu``) at a
+configurable load, and JUDGES the run against a declarative SLO spec
+(obs/slo.py) evaluated from the fleet's own telemetry:
+
+- fleet tasks/s + completion ratio: manager ``manager.tasks_dispatched``
+  / ``manager.tasks_completed`` counter deltas over the measurement
+  window (read from its ``mapd.metrics`` beacons — no harness-side
+  instrumentation);
+- phase-attributed latency: ``analysis/task_timeline.py`` percentiles
+  over the run's lifecycle-event logs (JG_TRACE=1 is set by default),
+  so a breached latency SLO names the phase that ate the budget;
+- bus health: slow-consumer drops/evictions from the busd beacons, via
+  the fleet aggregator rollup.
+
+Modes:
+
+- single run (default): one rung at ``--agents``/``--tick-ms``, verdict
+  artifact written to ``--out`` (+ ``.md``), exit status = SLO gate
+  (0 pass, 1 breach, 2 signal went dark) — the CI regression gate;
+- ``--saturate N1,N2,...``: stepped-load search over agent counts (or
+  ``--saturate-ticks T1,T2,...`` over tick periods at fixed agents):
+  run rungs in order until an SLO breaches; the artifact records every
+  rung's verdicts and the max sustainable tasks/s (the last passing
+  rung), plus which SLO broke and the breaching phase.
+
+Usage:
+  python analysis/fleetsim.py --agents 1000 --shards 2 --out \\
+      results/fleetsim_r09.json
+  python analysis/fleetsim.py --agents 40 --side 24 --window 8 \\
+      --settle 6 --spec ci_spec.json          # the scaled-down CI gate
+  python analysis/fleetsim.py --saturate 250,500,1000,2000 --shards 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from p2p_distributed_tswap_tpu.obs import events as _events  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import registry as _reg  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import trace as _trace  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import slo as _slo  # noqa: E402
+from p2p_distributed_tswap_tpu.obs.beacon import METRICS_TOPIC  # noqa: E402
+from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (  # noqa: E402
+    FleetAggregator, counter_total)
+from p2p_distributed_tswap_tpu.obs.registry import hist_quantile  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import buspool  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402,E501
+from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
+    BUILD_DIR, ensure_built, wait_for_log)
+from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool  # noqa: E402,E501
+
+
+class _PeerWindow:
+    __slots__ = ("proc", "first", "last", "first_t", "last_t")
+
+    def __init__(self, proc: str, metrics: dict, t: float):
+        self.proc = proc
+        self.first = self.last = metrics
+        self.first_t = self.last_t = t
+
+
+class MetricsWindow:
+    """Ingest ``mapd.metrics`` beacons: feed the fleet aggregator (the
+    rollup the SLO engine reads) and keep per-PEER first/last snapshots
+    (keyed by peer_id — a busd pool's shards share the ``busd`` proc
+    name) so window-scoped counter deltas are exact, not beacon-cadence
+    approximations.  Each snapshot records its arrival time: rates
+    divide by the FIRST→LAST BEACON span, not the harness's window
+    wall clock (beacons land up to an interval late on either edge)."""
+
+    def __init__(self, port: int):
+        self.bus = BusClient(port=port, peer_id="fleetsim-watch")
+        self.bus.subscribe(METRICS_TOPIC)
+        self.agg = FleetAggregator()
+        self._peers = {}  # peer_id -> _PeerWindow
+
+    def pump(self, budget_s: float) -> None:
+        end = time.monotonic() + budget_s
+        while True:
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                return
+            f = self.bus.recv(timeout=min(0.2, remaining))
+            if not f or f.get("op") != "msg":
+                continue
+            d = f.get("data") or {}
+            if not self.agg.ingest(d):
+                continue
+            proc = d.get("proc", "?")
+            key = str(d.get("peer_id") or proc)
+            m = d.get("metrics") or {}
+            now = time.monotonic()
+            st = self._peers.get(key)
+            if st is None:
+                self._peers[key] = _PeerWindow(proc, m, now)
+            else:
+                st.last = m
+                st.last_t = now
+
+    def reset_window(self) -> None:
+        """Measurement window starts fresh (the aggregator keeps its
+        history: its delta rates want consecutive beacons)."""
+        self._peers.clear()
+
+    def seen(self, proc: str) -> bool:
+        return any(st.proc == proc for st in self._peers.values())
+
+    def delta(self, proc: str, counter: str) -> float:
+        """Window delta of a counter summed over every peer of ``proc``,
+        clamped at zero per peer (a restart inside the window resets
+        cumulative counters)."""
+        total = 0.0
+        for st in self._peers.values():
+            if st.proc != proc or st.last is st.first:
+                continue
+            total += max(0.0, counter_total(st.last, counter)
+                         - counter_total(st.first, counter))
+        return total
+
+    def span_s(self, proc: str) -> float:
+        """Longest first→last beacon span among ``proc``'s peers — the
+        honest denominator for the window delta rates."""
+        return max((st.last_t - st.first_t for st in self._peers.values()
+                    if st.proc == proc), default=0.0)
+
+    def close(self) -> None:
+        self.bus.close()
+
+
+def _timeline_summary(trace_dir: Path) -> dict:
+    from analysis import task_timeline
+
+    summary = task_timeline.summarize(trace_dir)
+    summary.pop("tasks", None)  # per-task records stay out of artifacts
+    return summary
+
+
+def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
+    """One measured load rung: fresh fleet, settle, window, verdicts."""
+    import shutil
+
+    ensure_built()
+    home_port = buspool.free_port()
+    log_dir = Path(args.log_dir) / f"a{agents}_t{tick_ms}_s{args.shards}"
+    # a fresh rung directory every time: event logs append per-pid and
+    # task_timeline merges every *.events.jsonl it finds, so a stale
+    # previous run at the same config (the CI gate's fixed --log-dir)
+    # would dilute — or fail — this run's phase signals
+    if log_dir.exists():
+        shutil.rmtree(log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    trace_dir = log_dir / "trace"
+    saved_env = dict(os.environ)
+    procs, logs = [], []
+
+    def spawn(name, cmd, stdin=None, env=None):
+        log = open(log_dir / f"{name}.log", "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, stdin=stdin, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             env=dict(os.environ, **(env or {})))
+        procs.append(p)
+        return p
+
+    pool = watch = sim = None
+    # fresh harness-process registry per rung: in a saturation ladder the
+    # pool's claim-wire histogram must not carry the previous rung's
+    # samples into this rung's p99
+    _reg.get_registry().clear()
+    try:
+        pool = buspool.BusPool(
+            BUILD_DIR / "mapd_bus", num_shards=args.shards,
+            home_port=home_port, spawn=spawn)
+        time.sleep(0.4)
+        # the harness process hosts the sim pool: it needs the same
+        # fleet environment the children get (shard map, trace sinks)
+        os.environ.update(pool.env())
+        if not args.no_trace:
+            os.environ["JG_TRACE"] = "1"
+            os.environ["JG_TRACE_DIR"] = str(trace_dir)
+            os.environ.setdefault("JG_TRACE_SAMPLE", "1.0")
+        os.environ.setdefault("JG_FLIGHT_DIR", str(log_dir))
+        # re-arm the harness-process sinks under the rung environment:
+        # the span tracer caches JG_TRACE at configure time, and the sim
+        # pool's lifecycle events only reach disk with it armed
+        _trace.configure(proc="simfleet")
+        _events.configure("simfleet")
+        if args.solver == "tpu":
+            sd_cmd = [sys.executable, "-m",
+                      "p2p_distributed_tswap_tpu.runtime.solverd",
+                      "--port", str(home_port), "--map", args.map_file,
+                      "--warm", str(agents), "--cpu"]
+            sd_proc = spawn("solverd", sd_cmd)
+            if not wait_for_log(log_dir / "solverd.log", "solverd up",
+                                900, proc=sd_proc):
+                raise RuntimeError("solverd never became ready")
+        mgr = spawn(
+            "manager",
+            [str(BUILD_DIR / "mapd_manager_centralized"),
+             "--port", str(home_port), "--map", args.map_file,
+             "--solver", "cpu" if args.solver == "native" else "tpu",
+             "--planning-interval-ms", str(tick_ms),
+             "--max-tracked-agents", str(agents + 16)],
+            stdin=subprocess.PIPE)
+        time.sleep(0.5)
+        sim = SimAgentPool(agents, args.side, port=home_port,
+                           seed=args.seed, heartbeat_s=args.heartbeat_s)
+        watch = MetricsWindow(home_port)
+        sim.heartbeat_all()
+        sim.pump(1.5)
+
+        def inject(k):
+            mgr.stdin.write(f"tasks {k}\n".encode())
+            mgr.stdin.flush()
+
+        open_loop = args.mode == "open"
+        inject_every = 1.0
+        per_inject = max(1, int(round(args.rate * inject_every)))
+        if not open_loop:
+            # ramped closed-loop fill (manager refills on every done):
+            # the fleet's standing load goes out in chunks, so
+            # dispatch->claim measures the steady wire rather than one
+            # thundering-herd burst the pool drains for seconds
+            ramp_s = min(args.ramp_s, args.settle * 0.5)
+            steps = max(1, int(ramp_s / 0.5))
+            chunk = -(-agents // steps)
+            sent = 0
+            while sent < agents:
+                inject(min(chunk, agents - sent))
+                sent += chunk
+                sim.pump(0.45)
+                watch.pump(0.05)
+
+        def drive(seconds: float):
+            nonlocal next_inject
+            end = time.monotonic() + seconds
+            while time.monotonic() < end:
+                if open_loop and time.monotonic() >= next_inject:
+                    next_inject = time.monotonic() + inject_every
+                    inject(per_inject)
+                sim.pump(0.3)
+                watch.pump(0.05)
+
+        next_inject = time.monotonic()
+        drive(args.settle)
+        # measurement window starts fresh: counters re-baseline, the sim
+        # pool's own done count snapshots
+        watch.reset_window()
+        sim_done0 = sim.done_count
+        t0 = time.monotonic()
+        drive(args.window)
+        wall = time.monotonic() - t0
+        watch.pump(2.5)  # one more beacon interval: final counters land
+
+        rollup = watch.agg.rollup()
+        signals = _slo.signals_from_rollup(rollup)
+        # window-exact overrides: beacon-cadence delta rates are the
+        # live view; the SLO verdict wants the measured window.  The
+        # rate denominator is the manager's own first->last beacon span
+        # (its counters move with its beacons, not with our wall clock).
+        mgr_proc = "manager_centralized"
+        d_disp = watch.delta(mgr_proc, "manager.tasks_dispatched")
+        d_done = watch.delta(mgr_proc, "manager.tasks_completed")
+        span = watch.span_s(mgr_proc)
+        if watch.seen(mgr_proc) and span > 0:
+            signals["fleet.tasks_per_s"] = round(d_done / span, 3)
+            if d_disp > 0:
+                signals["fleet.completion_ratio"] = round(
+                    min(1.0, d_done / d_disp), 4)
+            elif d_done > 0:
+                # window with completions but no fresh dispatches (e.g.
+                # drain phase): everything that could complete did
+                signals["fleet.completion_ratio"] = 1.0
+        else:
+            # <2 manager beacons in the window = DARK telemetry: drop
+            # the rollup-derived values too (they span the settle
+            # phase) so the SLO reads unknown (exit 2) — never a stale
+            # pre-window rate passing as measurement, never a
+            # fabricated 0.0 breach
+            signals.pop("fleet.tasks_per_s", None)
+            signals.pop("fleet.completion_ratio", None)
+        if watch.seen("busd") and watch.span_s("busd") > 0:
+            # bus-shedding SLOs judge the MEASURED WINDOW ("zero
+            # evictions at rated load"), not the warm-up thundering
+            # herd the cumulative busd counters include.  With <2
+            # beacons per shard the cumulative rollup value stands —
+            # conservative (includes warm-up), never a fabricated 0.
+            signals["bus.slow_consumer_evictions"] = int(
+                watch.delta("busd", "bus.slow_consumer_evictions"))
+            signals["bus.slow_consumer_drops"] = int(
+                watch.delta("busd", "bus.slow_consumer_drops"))
+        # always-on claim-wire percentile from the pool's own registry
+        # (hop_latency_ms{edge="task.claim"}) — works without JG_TRACE
+        snap = _reg.snapshot()
+        claim = (snap.get("hists") or {}).get(
+            'hop_latency_ms{edge="task.claim"}')
+        if claim and claim.get("count"):
+            signals["sim.claim_wire_p99_ms"] = round(
+                hist_quantile(claim, 0.99), 3)
+            signals["sim.claim_wire_p50_ms"] = round(
+                hist_quantile(claim, 0.5), 3)
+        timeline = None
+        if not args.no_trace and trace_dir.exists():
+            timeline = _timeline_summary(trace_dir)
+            signals.update(_slo.signals_from_timeline(timeline))
+        result = _slo.evaluate(spec, signals)
+        rung = {
+            "agents": agents,
+            "tick_ms": tick_ms,
+            "shards": args.shards,
+            "mode": args.mode,
+            "solver": args.solver,
+            "map": f"{args.side}x{args.side} empty",
+            "window_s": round(wall, 1),
+            "settle_s": args.settle,
+            "seed": args.seed,
+            "window_tasks_dispatched": int(d_disp),
+            "window_tasks_completed": int(d_done),
+            "sim": {**sim.stats(),
+                    "done_in_window": sim.done_count - sim_done0},
+            "signals": signals,
+            "slo": result,
+        }
+        if timeline is not None:
+            rung["timeline"] = timeline
+        return rung
+    finally:
+        for obj in (sim, watch):
+            if obj is not None:
+                obj.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if pool is not None:
+            pool.close()
+        for log in logs:
+            log.close()
+        os.environ.clear()
+        os.environ.update(saved_env)
+        # re-bind the sinks to the restored environment
+        _trace.configure(proc="simfleet")
+        _events.configure("simfleet")
+
+
+def write_artifact(out: Path, doc: dict) -> None:
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=2) + "\n")
+    md = [f"# fleetsim — {doc['experiment']}", ""]
+    for rung in doc["rungs"]:
+        md.append(f"### rung: {rung['agents']} agents @ "
+                  f"{rung['tick_ms']} ms tick, {rung['shards']} bus "
+                  f"shard(s) ({rung['mode']} loop, {rung['solver']})")
+        md.append("")
+        md.append(f"- window: {rung['window_s']} s — "
+                  f"{rung['window_tasks_completed']} completed / "
+                  f"{rung['window_tasks_dispatched']} dispatched "
+                  f"(fleet tasks/s "
+                  f"{rung['signals'].get('fleet.tasks_per_s', '-')})")
+        md.append("")
+        md.append(_slo.render_md(rung["slo"]))
+    if doc.get("saturation") is not None:
+        s = doc["saturation"]
+        md.append("## saturation search")
+        md.append("")
+        md.append(f"- max sustainable: **{s['max_sustainable_tasks_per_s']}"
+                  f" tasks/s** at {s['max_sustainable_agents']} agents"
+                  f" @ {s['max_sustainable_tick_ms']} ms tick"
+                  if s.get("max_sustainable_tasks_per_s") is not None
+                  else "- no rung passed the spec")
+        if s.get("breached_at") is not None:
+            md.append(f"- first breach: {s['breached_at']} — "
+                      f"SLO(s) {', '.join(s['breached_slos'])}"
+                      + (f", breaching phase {s['breaching_phase']}"
+                         if s.get("breaching_phase") else ""))
+        md.append("")
+    out.with_name(out.name + ".md").write_text("\n".join(md) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--agents", type=int, default=200)
+    ap.add_argument("--side", type=int, default=96,
+                    help="empty square map side (96 puts 1000 agents at "
+                         "~11%% density)")
+    ap.add_argument("--shards", type=int,
+                    default=int(os.environ.get("JG_BUS_SHARDS", "1") or 1),
+                    help="busd pool shards (the federated plane)")
+    ap.add_argument("--tick-ms", type=int, default=250,
+                    help="manager planning interval")
+    ap.add_argument("--mode", choices=["closed", "open"], default="closed",
+                    help="closed: one task per agent, manager refills on "
+                         "done (peak sustainable); open: inject --rate "
+                         "tasks/s regardless of completion")
+    ap.add_argument("--rate", type=float, default=10.0,
+                    help="open-loop injection rate (tasks/s)")
+    ap.add_argument("--window", type=float, default=30.0)
+    ap.add_argument("--settle", type=float, default=45.0,
+                    help="warmup before the window (first completions "
+                         "need ~one task duration)")
+    ap.add_argument("--ramp-s", type=float, default=20.0,
+                    help="closed-loop fill ramp (chunked task injection; "
+                         "clamped to settle/2)")
+    ap.add_argument("--heartbeat-s", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--solver", choices=["native", "tpu"], default="native")
+    ap.add_argument("--spec", default=None,
+                    help="SLO spec JSON file (default: obs/slo.py "
+                         "rated-load spec)")
+    ap.add_argument("--saturate", default=None,
+                    help="comma list of agent counts: stepped-load "
+                         "search, stop at first SLO breach")
+    ap.add_argument("--saturate-ticks", default=None,
+                    help="comma list of tick periods (ms) at fixed "
+                         "--agents: rate-laddered search")
+    ap.add_argument("--keep-going", action="store_true",
+                    help="saturation search: run EVERY ladder rung even "
+                         "past the first breach (the committed-artifact "
+                         "mode: breached rungs stay in the record with "
+                         "their verdicts and breaching phases)")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--log-dir", default="/tmp/fleetsim_logs")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip JG_TRACE (phase-attribution SLOs read "
+                         "unknown)")
+    args = ap.parse_args(argv)
+
+    args.map_file = f"/tmp/fleetsim_{args.side}.map.txt"
+    Path(args.map_file).write_text(
+        "\n".join(["." * args.side] * args.side) + "\n")
+    spec = _slo.load_spec(args.spec)
+
+    rungs = []
+    saturation = None
+    if args.saturate or args.saturate_ticks:
+        if args.saturate:
+            ladder = [(int(n), args.tick_ms)
+                      for n in args.saturate.split(",")]
+        else:
+            ladder = [(args.agents, int(t))
+                      for t in args.saturate_ticks.split(",")]
+        last_pass = None
+        breach = None
+        for agents, tick_ms in ladder:
+            print(f"fleetsim: rung {agents} agents @ {tick_ms} ms tick",
+                  flush=True)
+            rung = run_rung(args, agents, tick_ms, spec)
+            rungs.append(rung)
+            print(json.dumps({k: rung[k] for k in
+                              ("agents", "tick_ms", "signals")}),
+                  flush=True)
+            print(_slo.render_line(rung["slo"]), flush=True)
+            if rung["slo"]["ok"]:
+                last_pass = rung
+            elif breach is None:
+                breach = rung
+                if not args.keep_going:
+                    break  # stepped-load search stops at the first breach
+        breaching_phase = None
+        if breach is not None:
+            for v in breach["slo"]["verdicts"]:
+                if v["status"] == "fail" and v.get("breaching_phase"):
+                    breaching_phase = v["breaching_phase"]
+                    break
+        saturation = {
+            "ladder": [{"agents": a, "tick_ms": t} for a, t in ladder],
+            "max_sustainable_tasks_per_s":
+                last_pass["signals"].get("fleet.tasks_per_s")
+                if last_pass else None,
+            "max_sustainable_agents":
+                last_pass["agents"] if last_pass else None,
+            "max_sustainable_tick_ms":
+                last_pass["tick_ms"] if last_pass else None,
+            "breached_at": (f"{breach['agents']} agents @ "
+                            f"{breach['tick_ms']} ms"
+                            if breach else None),
+            "breached_slos": (breach["slo"]["failed"]
+                              + breach["slo"]["unknown"])
+            if breach else [],
+            "breaching_phase": breaching_phase,
+        }
+    else:
+        rung = run_rung(args, args.agents, args.tick_ms, spec)
+        rungs.append(rung)
+        print(_slo.render_line(rung["slo"]), flush=True)
+
+    doc = {
+        "experiment": "fleetsim load rehearsal: simulated wire-faithful "
+                      "agent pool vs sharded bus + centralized manager",
+        "spec": spec,
+        "rungs": rungs,
+        "saturation": saturation,
+    }
+    print(json.dumps({"rungs": len(rungs),
+                      "ok": all(r["slo"]["ok"] for r in rungs),
+                      "saturation": saturation}), flush=True)
+    if args.out:
+        write_artifact(Path(args.out), doc)
+    if saturation is not None:
+        return 0 if saturation["max_sustainable_agents"] is not None else 1
+    return _slo.exit_code(rungs[0]["slo"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

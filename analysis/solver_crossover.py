@@ -48,7 +48,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 from p2p_distributed_tswap_tpu.obs.registry import hist_quantile  # noqa: E402
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402
 from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
-    BUILD_DIR, ensure_built)
+    BUILD_DIR, ensure_built, wait_for_log)
+# The sim-agent loop that used to live here (SimFleet) was generalized
+# into the reusable, shard-aware, pos1-speaking pool behind the fleetsim
+# load harness (ISSUE 7); this harness now drives the same pool.
+from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool  # noqa: E402,E501
 
 TICK_MS = 500.0
 
@@ -59,111 +63,6 @@ def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
-
-
-class SimFleet:
-    """N bus agents in one process: adopt Tasks, follow move_instructions,
-    heartbeat positions (+busy_task), publish done at the delivery —
-    the lightweight stand-in for N mapd_agent_centralized processes."""
-
-    def __init__(self, port: int, n: int, side: int, seed: int = 1):
-        import numpy as np
-
-        self.n = n
-        self.side = side
-        rng = np.random.default_rng(seed)
-        cells = rng.choice(side * side, size=n, replace=False)
-        # peer ids shaped like the real fleet's (bus.hpp random_peer_id:
-        # "12D3KooW" + 36 chars) — wire-byte numbers must not flatter
-        # either codec with unrealistically short names
-        alphabet = np.frombuffer(
-            b"123456789ABCDEFGHJKLMNPQRSTUVWXYZ"
-            b"abcdefghijkmnopqrstuvwxyz", np.uint8)
-        def peer_id(k):
-            tail = rng.choice(alphabet, size=28).tobytes().decode()
-            return f"12D3KooWsim{k:05d}{tail}"
-        self.pos = {peer_id(k): int(cells[k]) for k in range(n)}
-        self.task = {}   # peer -> task dict
-        self.picked = {}  # peer -> bool (pickup visited)
-        self.bus = BusClient(port=port, peer_id="simfleet", reconnect=True)
-        self.bus.subscribe("mapd")
-        self._hb_at = 0.0
-        self.done_count = 0
-
-    def _pt(self, c):
-        return [c % self.side, c // self.side]
-
-    def _cell(self, p):
-        return p[1] * self.side + p[0]
-
-    def heartbeat_all(self):
-        for peer, c in self.pos.items():
-            msg = {"type": "position_update", "peer_id": peer,
-                   "position": self._pt(c)}
-            t = self.task.get(peer)
-            if t is not None:
-                msg["busy_task"] = t["task_id"]
-            self.bus.publish("mapd", msg)
-
-    def _arrival(self, peer):
-        t = self.task.get(peer)
-        if t is None:
-            return
-        c = self.pos[peer]
-        if c == self._cell(t["pickup"]):
-            self.picked[peer] = True
-        if self.picked.get(peer) and c == self._cell(t["delivery"]):
-            self.bus.publish("mapd", {
-                "type": "task_metric_completed", "task_id": t["task_id"],
-                "peer_id": peer,
-                "timestamp_ms": int(time.time() * 1000)})
-            self.bus.publish("mapd", {"status": "done",
-                                      "task_id": t["task_id"],
-                                      "peer_id": peer})
-            self.task.pop(peer, None)
-            self.picked.pop(peer, None)
-            self.done_count += 1
-
-    def pump(self, budget_s: float):
-        """Process bus traffic for ``budget_s`` seconds."""
-        end = time.monotonic() + budget_s
-        while True:
-            now = time.monotonic()
-            if now >= end:
-                return
-            if now - self._hb_at >= 2.0:
-                self._hb_at = now
-                self.heartbeat_all()
-            f = self.bus.recv(timeout=min(0.05, end - now))
-            if not f or f.get("op") != "msg":
-                continue
-            d = f.get("data") or {}
-            typ = d.get("type")
-            if typ == "move_instruction":
-                peer = d.get("peer_id")
-                if peer in self.pos:
-                    self.pos[peer] = self._cell(d["next_pos"])
-                    self.bus.publish("mapd", {
-                        "type": "position_update", "peer_id": peer,
-                        "position": d["next_pos"],
-                        **({"busy_task": self.task[peer]["task_id"]}
-                           if peer in self.task else {})})
-                    self._arrival(peer)
-            elif typ == "task_withdrawn":
-                peer = d.get("peer_id")
-                if peer in self.task and \
-                        self.task[peer]["task_id"] == d.get("task_id"):
-                    self.task.pop(peer, None)
-                    self.picked.pop(peer, None)
-            elif typ is None and "pickup" in d and "delivery" in d:
-                peer = d.get("peer_id")
-                if peer in self.pos:
-                    self.task[peer] = d
-                    self.picked[peer] = False
-                    self._arrival(peer)  # degenerate: already at pickup
-
-    def close(self):
-        self.bus.close()
 
 
 class BeaconWatch:
@@ -251,14 +150,10 @@ def run_variant(variant: str, n: int, side: int, map_file: str,
                       "--warm", str(n)]
             if cpu:
                 sd_cmd.append("--cpu")
-            spawn("solverd", sd_cmd)
-            sd_log = Path(f"/tmp/crossover_solverd_{variant}_{n}.log")
-            deadline = time.monotonic() + 900
-            while time.monotonic() < deadline:
-                if "solverd up" in sd_log.read_text(errors="ignore"):
-                    break
-                time.sleep(0.5)
-            else:
+            sd_proc = spawn("solverd", sd_cmd)
+            if not wait_for_log(
+                    f"/tmp/crossover_solverd_{variant}_{n}.log",
+                    "solverd up", 900, proc=sd_proc):
                 raise RuntimeError("solverd never became ready")
         mgr_env = {"JG_PLAN_CODEC": "packed" if variant == "packed"
                    else "json"}
@@ -269,7 +164,7 @@ def run_variant(variant: str, n: int, side: int, map_file: str,
                      "--max-tracked-agents", str(n + 16)],
                     stdin=subprocess.PIPE, env=mgr_env)
         time.sleep(0.5)
-        sim = SimFleet(port, n, side)
+        sim = SimAgentPool(n, side, port=port)
         watch = BeaconWatch(port)
         sim.heartbeat_all()
         sim.pump(2.0)

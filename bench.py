@@ -63,6 +63,12 @@ artifacts carry real makespans), BENCH_TRIES=retries per rung (default 3),
 BENCH_NO_LB=1 to skip the lower-bound BFS, BENCH_SEEDS=comma list
 (default 0,1,2,3,4): headline rungs (MULTISEED_RUNGS) run every seed and
 report mean±spread; other rungs run seeds[0].
+
+Fleetsim axis (ISSUE 7): unless BENCH_FLEETSIM=0 (or the C++ runtime is
+unavailable), the headline also carries a ``fleetsim`` record — rated-load
+fleet tasks/s and the p99 dispatch->claim wire phase from a scaled-down
+``analysis/fleetsim.py`` run — so the BENCH trajectory tracks end-to-end
+fleet health next to ms/step.
 """
 
 from __future__ import annotations
@@ -580,6 +586,53 @@ MULTISEED_RUNGS = {"ref", "medium", "flagship",
                    "flagship_decent_stale"}
 
 
+def run_fleetsim_axis() -> dict:
+    """Scaled-down live-fleet SLO rung for the BENCH trajectory: rated
+    tasks/s + p99 dispatch->claim wire ms from a small closed-loop
+    fleetsim run (deterministic seed, relaxed scale).  Failures are
+    recorded, never fatal — the solver rungs stay the headline."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    if not (BUILD_DIR / "mapd_bus").exists() \
+            and (shutil.which("cmake") is None
+                 or shutil.which("ninja") is None):
+        return {"skipped": "C++ runtime unavailable"}
+    out = Path(tempfile.mkdtemp(prefix="jg-bench-fleetsim-")) / "fs.json"
+    cmd = [sys.executable, os.path.join(root, "analysis", "fleetsim.py"),
+           "--agents", "40", "--side", "24", "--tick-ms", "250",
+           "--settle", "12", "--window", "12", "--seed", "1",
+           "--out", str(out)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=420,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        return {"error": "fleetsim timeout"}
+    if not out.exists():
+        return {"error": (proc.stderr or proc.stdout or "no output")[-300:]}
+    try:
+        rung = json.loads(out.read_text())["rungs"][0]
+    except (json.JSONDecodeError, KeyError, IndexError) as e:
+        return {"error": f"artifact parse: {e}"}
+    sig = rung.get("signals") or {}
+    return {
+        "agents": rung.get("agents"),
+        "tick_ms": rung.get("tick_ms"),
+        "tasks_per_s": sig.get("fleet.tasks_per_s"),
+        "completion_ratio": sig.get("fleet.completion_ratio"),
+        "p99_dispatch_claim_wire_ms": sig.get("timeline.phase_p99_ms.wire"),
+        "claim_wire_p99_ms": sig.get("sim.claim_wire_p99_ms"),
+        "slo_ok": (rung.get("slo") or {}).get("ok"),
+        "slo_failed": (rung.get("slo") or {}).get("failed"),
+    }
+
+
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
         trace.configure(proc=f"bench-{sys.argv[2]}")
@@ -624,6 +677,8 @@ def main():
         head["flagship_makespan"] = results["flagship"].get("makespan")
         head["flagship_invariants_ok"] = results["flagship"].get(
             "invariants_ok")
+    if os.environ.get("BENCH_FLEETSIM", "1") != "0":
+        head["fleetsim"] = run_fleetsim_axis()
     print(json.dumps(head), flush=True)
 
 

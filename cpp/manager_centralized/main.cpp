@@ -251,6 +251,9 @@ int main(int argc, char** argv) {
       event_emit("task.dispatch", &t, static_cast<long long>(id), peer);
     }
     bus.publish("mapd", task);
+    // live dispatch counter: the fleet rollup derives tasks/s and the
+    // completion ratio from the dispatched/completed counter pair
+    metrics_count("manager.tasks_dispatched");
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
              peer.c_str());
   };
@@ -754,7 +757,11 @@ int main(int argc, char** argv) {
           if (type == "position_update" || type == "pos1") {
             // one heartbeat ingestion for both wires: flat JSON
             // position_update and the packed pos1 region beacon (which is
-            // addressed by the bus frame's own `from`)
+            // addressed by the bus frame's own `from`).  A MULTIPLEXED
+            // client (analysis/fleetsim.py simulates thousands of agents
+            // over one connection) puts the agent identity in an optional
+            // envelope `peer_id` instead — it wins over `from` when
+            // present; real per-process agents never set it.
             std::string peer;
             std::optional<Cell> p;
             bool has_busy = false;
@@ -762,7 +769,7 @@ int main(int argc, char** argv) {
             if (type == "pos1") {
               auto p1 = codec::decode_pos1_b64(d["data"].as_str());
               if (!p1) return;
-              peer = m.from;
+              peer = d.has("peer_id") ? d["peer_id"].as_str() : m.from;
               if (p1->pos >= 0 &&
                   p1->pos < static_cast<Cell>(grid.free.size()))
                 p = p1->pos;
@@ -856,7 +863,10 @@ int main(int argc, char** argv) {
             bus.publish("mapd",
                         flight_dump_answer("manager_centralized", my_id));
           } else if (d["status"].as_str() == "done") {
-            const std::string& peer = m.from;
+            // same multiplexed-client accommodation as the heartbeat
+            // path: an explicit payload peer_id outranks the frame from
+            const std::string peer =
+                d.has("peer_id") ? d["peer_id"].as_str() : m.from;
             const long long tid = d["task_id"].as_int();
             auto done_tc = tc_parse(d);
             if (done_tc) {
@@ -913,6 +923,9 @@ int main(int argc, char** argv) {
                   }
               }
               log_info("🎉 %s finished task %lld\n", peer.c_str(), tid);
+              // counted on the DEDUPED path only: a retransmitted or
+              // double-completed done never inflates the fleet tasks/s
+              metrics_count("manager.tasks_completed");
               // auto-reassign on completion (ref :908-950): queued tasks
               // (incl. ones re-queued from dead agents) drain before a fresh
               // task is generated, so orphans cannot starve behind auto-refill

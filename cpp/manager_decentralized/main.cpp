@@ -196,6 +196,9 @@ int main(int argc, char** argv) {
     inflight[static_cast<long long>(id)] = t;
     last_claimed[static_cast<long long>(id)] = mono_ms();
     bus.publish("mapd", t);
+    // live dispatch counter: the fleet rollup derives tasks/s and the
+    // completion ratio from the dispatched/completed counter pair
+    metrics_count("manager.tasks_dispatched");
     log_info("📤 Task %llu -> %s\n", static_cast<unsigned long long>(id),
              peer.c_str());
   };
@@ -576,6 +579,9 @@ int main(int argc, char** argv) {
               return;
             }
             completed_ids.insert(tid);
+            // deduped path only: retransmits/double-dones never inflate
+            // the fleet tasks/s the rollup derives from this counter
+            metrics_count("manager.tasks_completed");
             completed_order.push_back(tid);
             inflight.erase(tid);
             last_claimed.erase(tid);

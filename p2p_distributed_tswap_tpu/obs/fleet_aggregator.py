@@ -22,7 +22,14 @@ operator-facing rollup ``analysis/fleet_top.py`` renders:
   its ``shard`` index, and its rollup row gains a ``bus`` section —
   relay fanout rate, queued bytes, live peering links, and peering
   traffic — so fleet_top shows each shard's load and the peering tax
-  live.
+  live;
+- fleet task throughput (ISSUE 7): a manager beacon's
+  ``manager.tasks_dispatched`` / ``manager.tasks_completed`` counter
+  pair yields a per-manager ``mgr_tasks`` section (cumulative counts,
+  delta-rate ``tasks_per_s`` with the same counter-reset clamp as the
+  bandwidth rates, cumulative ``completion_ratio``) and fleet-level
+  ``tasks_per_s`` / ``completion_ratio`` — the signals the SLO engine
+  (obs/slo.py) judges.
 """
 
 from __future__ import annotations
@@ -102,6 +109,14 @@ class FleetAggregator:
         # cumulative counters; see _rates)
         self.counter_resets = 0
 
+    # cumulative counters watched for restarts (a shrink between two
+    # consecutive beacons of one peer = the process restarted with a
+    # fresh registry); detection happens HERE, once per beacon pair —
+    # counting it in the rate derivations would re-fire on every
+    # rollup() call until the next beacon arrived
+    _RESET_COUNTERS = ("bus.bytes_sent", "bus.bytes_received",
+                       "manager.tasks_completed", "bus.fanout_bytes")
+
     def ingest(self, payload: dict, now_ms: Optional[int] = None) -> bool:
         """Feed one bus message's data dict; non-beacons are ignored
         (returns False)."""
@@ -115,6 +130,13 @@ class FleetAggregator:
         else:
             st.prev_metrics = st.payload.get("metrics")
             st.prev_ts_ms = st.last_seen_ms
+        if st.prev_metrics is not None:
+            cur = payload.get("metrics") or {}
+            if any(counter_total(cur, c)
+                   < counter_total(st.prev_metrics, c)
+                   for c in self._RESET_COUNTERS):
+                self.counter_resets += 1
+                _reg.count("aggregator.counter_resets")
         st.payload = payload
         st.last_seen_ms = _now_ms() if now_ms is None else now_ms
         self.beacons_ingested += 1
@@ -136,9 +158,8 @@ class FleetAggregator:
                 # delta would render a negative B/s in fleet_top.  Treat
                 # the new snapshot as a fresh baseline: the restart-side
                 # totals ARE the traffic since the reset (bounded by the
-                # beacon gap), never a negative rate.
-                self.counter_resets += 1
-                _reg.count("aggregator.counter_resets")
+                # beacon gap), never a negative rate.  (The reset itself
+                # is COUNTED in ingest(), once per beacon pair.)
                 d_sent, d_recv = sent, recv
         else:  # single beacon so far: cumulative average over uptime
             # `or 0.0`: a foreign emitter can send "uptime_s": null, and
@@ -155,6 +176,37 @@ class FleetAggregator:
             "by_topic_sent_bytes": {
                 k: int(v) for k, v in
                 counters_by_label(cur, "bus.bytes_sent", "topic").items()},
+        }
+
+    def _mgr_tasks(self, st: _PeerState) -> Optional[dict]:
+        """Task-throughput derivation for a manager peer: cumulative
+        dispatched/completed, delta-rate tasks/s (counter-reset clamped
+        like the bandwidth rates), and the cumulative completion ratio.
+        None for peers without the counter pair."""
+        cur = st.payload.get("metrics") or {}
+        dispatched = counter_total(cur, "manager.tasks_dispatched")
+        completed = counter_total(cur, "manager.tasks_completed")
+        if not dispatched and not completed:
+            return None
+        if st.prev_metrics is not None and st.last_seen_ms > st.prev_ts_ms:
+            dt = (st.last_seen_ms - st.prev_ts_ms) / 1000.0
+            d_done = completed - counter_total(st.prev_metrics,
+                                               "manager.tasks_completed")
+            if d_done < 0:
+                # counter reset: a restarted manager's fresh totals ARE
+                # the completions since the reset (same clamp discipline
+                # as _rates — never a negative rate; the reset is
+                # counted once, in ingest())
+                d_done = completed
+        else:  # single beacon so far: cumulative average over uptime
+            dt = max(cur.get("uptime_s") or 0.0, 1e-9)
+            d_done = completed
+        return {
+            "dispatched": int(dispatched),
+            "completed": int(completed),
+            "tasks_per_s": round(max(0.0, d_done) / dt, 3),
+            "completion_ratio": (round(completed / dispatched, 4)
+                                 if dispatched else None),
         }
 
     def _peer_rollup(self, st: _PeerState, now_ms: int) -> dict:
@@ -184,6 +236,7 @@ class FleetAggregator:
             "tick": None,
             "cache": None,
             "tasks": None,
+            "mgr_tasks": self._mgr_tasks(st),
         }
         if p.get("proc") == "busd":
             # per-shard bus health: fanout rate (delta when a previous
@@ -213,6 +266,8 @@ class FleetAggregator:
                 "peer_tx_msgs": int(counter_total(m, "bus.peer_tx_msgs")),
                 "slow_consumer_drops": int(
                     counter_total(m, "bus.slow_consumer_drops")),
+                "slow_consumer_evictions": int(
+                    counter_total(m, "bus.slow_consumer_evictions")),
             }
         if tick_hist and tick_hist["count"]:
             out["tick"] = {
@@ -243,6 +298,12 @@ class FleetAggregator:
         peers = {peer: self._peer_rollup(st, now_ms)
                  for peer, st in sorted(self._peers.items())}
         ticks = [p["tick"] for p in peers.values() if p["tick"]]
+        # fleet task throughput: summed over every manager peer (one in
+        # centralized fleets; completion_ratio stays None until a
+        # dispatch counter arrives — absence must read unknown, not 0)
+        mgr = [p["mgr_tasks"] for p in peers.values() if p["mgr_tasks"]]
+        dispatched = sum(t["dispatched"] for t in mgr)
+        completed = sum(t["completed"] for t in mgr)
         return {
             "ts_ms": now_ms,
             "budget_ms": self.budget_ms,
@@ -258,5 +319,11 @@ class FleetAggregator:
                                       for p in peers.values()),
                 "ticks": sum(t["count"] for t in ticks),
                 "ticks_over_budget": sum(t["over_budget"] for t in ticks),
+                "tasks_dispatched": dispatched if mgr else None,
+                "tasks_completed": completed if mgr else None,
+                "tasks_per_s": (round(sum(t["tasks_per_s"] for t in mgr), 3)
+                                if mgr else None),
+                "completion_ratio": (round(completed / dispatched, 4)
+                                     if dispatched else None),
             },
         }

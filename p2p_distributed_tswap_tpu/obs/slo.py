@@ -1,0 +1,403 @@
+"""Declarative fleet SLOs evaluated against live observability signals.
+
+The stack up to PR 6 *produces* rich live signals — registry beacons
+merged into the fleet rollup (obs/fleet_aggregator.py) and causal task
+timelines with phase attribution (analysis/task_timeline.py) — but
+nothing *judges* them.  This module closes that loop: a small
+declarative spec (dict / JSON file) names the service-level objectives a
+fleet must meet, and the engine evaluates each one against a flat
+``signals`` mapping, producing a machine-readable verdict per SLO:
+
+- ``pass`` / ``fail`` — the observed value met / breached the threshold;
+- ``unknown`` — the signal is ABSENT from the inputs.  Missing telemetry
+  is never a silent pass: an SLO whose signal went dark is exactly the
+  regression the gate exists to catch, so ``unknown`` fails a strict
+  gate (exit 2, distinct from a threshold breach's exit 1).
+
+Spec format (JSON or dict)::
+
+    {"name": "rated-load",
+     "slos": [
+       {"name": "p99_dispatch_claim_wire_ms",
+        "signal": "timeline.phase_p99_ms.wire", "max": 500.0,
+        "phases": "timeline.fleet_phases_p99_ms"},
+       {"name": "completion_ratio",
+        "signal": "fleet.completion_ratio", "min": 0.99},
+       {"name": "tasks_per_s", "signal": "fleet.tasks_per_s", "min": 2.0},
+       {"name": "slow_consumer_evictions",
+        "signal": "bus.slow_consumer_evictions", "max": 0}]}
+
+Each SLO entry:
+
+- ``signal``: dotted path into the signals mapping (nested dicts);
+- ``min`` and/or ``max``: inclusive bounds — at least one is required
+  (``observed < min`` or ``observed > max`` breaches);
+- ``phases`` (optional, latency SLOs): dotted path to a ``{phase: ms}``
+  mapping; the verdict then carries ``breaching_phase`` — the phase with
+  the largest attributed latency — so a breached latency SLO names
+  WHERE the time went (queueing vs wire vs planning vs travel), not
+  just that it went somewhere.
+
+Signals come from two sources, flattened by the helpers below:
+
+- :func:`signals_from_rollup` — the fleet aggregator rollup (tasks/s,
+  completion ratio, bus health, per-manager tick percentiles);
+- :func:`signals_from_timeline` — a task_timeline summary (per-phase
+  p50/p95/p99, end-to-end percentiles, coverage).
+
+``analysis/fleetsim.py`` is the primary producer; ``analysis/
+fleet_top.py`` renders live verdicts from the same engine; the CLI
+(``python -m p2p_distributed_tswap_tpu.obs.slo --signals f --spec g``)
+re-judges a saved signals dump against any spec — the CI gate uses this
+to prove the gate trips on a known-breaching spec without a second
+fleet bring-up.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Union
+
+# The default spec: the rated-load objectives named by ROADMAP item 4.
+# One planning tick (500 ms) bounds the p99 dispatch->claim wire phase;
+# the bus must shed nothing at rated load; a task dispatched is a task
+# completed (99%: the in-flight tail of a live window is real, a
+# completion COLLAPSE is what the floor catches).
+DEFAULT_SPEC: dict = {
+    "name": "rated-load",
+    "slos": [
+        {"name": "p99_dispatch_claim_wire_ms",
+         "signal": "timeline.phase_p99_ms.wire", "max": 500.0,
+         "phases": "timeline.fleet_phases_p99_ms"},
+        {"name": "completion_ratio",
+         "signal": "fleet.completion_ratio", "min": 0.99},
+        {"name": "slow_consumer_evictions",
+         "signal": "bus.slow_consumer_evictions", "max": 0},
+        {"name": "tasks_per_s", "signal": "fleet.tasks_per_s", "min": 0.5},
+    ],
+}
+
+_STATUS_ORDER = {"pass": 0, "unknown": 1, "fail": 2}
+
+
+class SpecError(ValueError):
+    """Malformed SLO spec (bad shape, missing bounds, dup names)."""
+
+
+def load_spec(source: Union[dict, str, None]) -> dict:
+    """Normalize + validate a spec from a dict, a JSON file path, a JSON
+    string, or None (the default spec).  Raises :class:`SpecError` on a
+    malformed spec — a gate must never run against garbage silently."""
+    if source is None:
+        spec = json.loads(json.dumps(DEFAULT_SPEC))  # deep copy
+    elif isinstance(source, dict):
+        spec = source
+    elif isinstance(source, str):
+        text = source
+        if not source.lstrip().startswith("{"):
+            with open(source) as f:
+                text = f.read()
+        try:
+            spec = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise SpecError(f"spec is not valid JSON: {e}") from None
+    else:
+        raise SpecError(f"unsupported spec source {type(source).__name__}")
+    if not isinstance(spec, dict) or not isinstance(spec.get("slos"), list) \
+            or not spec["slos"]:
+        raise SpecError('spec must be {"name": ..., "slos": [non-empty]}')
+    seen = set()
+    for i, slo in enumerate(spec["slos"]):
+        if not isinstance(slo, dict):
+            raise SpecError(f"slos[{i}] is not an object")
+        name = slo.get("name") or slo.get("signal")
+        if not name:
+            raise SpecError(f"slos[{i}] has neither name nor signal")
+        slo["name"] = str(name)
+        if slo["name"] in seen:
+            raise SpecError(f"duplicate SLO name {slo['name']!r}")
+        seen.add(slo["name"])
+        if not isinstance(slo.get("signal"), str):
+            raise SpecError(f"slos[{i}] ({slo['name']}): missing signal path")
+        lo, hi = slo.get("min"), slo.get("max")
+        if lo is None and hi is None:
+            raise SpecError(
+                f"slos[{i}] ({slo['name']}): needs min and/or max")
+        for bound, v in (("min", lo), ("max", hi)):
+            if v is not None and not isinstance(v, (int, float)):
+                raise SpecError(
+                    f"slos[{i}] ({slo['name']}): {bound} must be a number")
+        if lo is not None and hi is not None and lo > hi:
+            raise SpecError(
+                f"slos[{i}] ({slo['name']}): min {lo} > max {hi}")
+    spec.setdefault("name", "unnamed")
+    return spec
+
+
+def lookup(signals: dict, path: str):
+    """Resolve a dotted path through nested dicts; None when any segment
+    is absent.  A LITERAL dotted key wins over nesting at each level
+    (signal producers use flat dotted names like ``bus.slow_consumer_
+    evictions``)."""
+    node = signals
+    while path:
+        if not isinstance(node, dict):
+            return None
+        if path in node:
+            return node[path]
+        head, dot, rest = path.partition(".")
+        # longest-literal-prefix match: "timeline.phase_p99_ms.wire" may
+        # be stored as {"timeline": {"phase_p99_ms": {"wire": v}}} or as
+        # {"timeline.phase_p99_ms": {"wire": v}}
+        match = None
+        probe = head
+        remainder = rest
+        while True:
+            if probe in node:
+                match = (probe, remainder)
+            if not remainder:
+                break
+            nxt, _, remainder2 = remainder.partition(".")
+            probe = probe + "." + nxt
+            remainder = remainder2
+        if match is None:
+            return None
+        node = node[match[0]]
+        path = match[1]
+    return node
+
+
+def _breaching_phase(signals: dict, phases_path: str) -> Optional[str]:
+    """The phase carrying the largest attributed latency — the answer to
+    'WHERE did the breached latency budget go'."""
+    phases = lookup(signals, phases_path)
+    if not isinstance(phases, dict) or not phases:
+        return None
+    best, best_v = None, None
+    for name, v in phases.items():
+        if isinstance(v, dict):  # {p50,p95,p99} shape: judge by p99
+            v = v.get("p99")
+        if not isinstance(v, (int, float)):
+            continue
+        if best_v is None or v > best_v:
+            best, best_v = name, v
+    return best
+
+
+def evaluate(spec: Union[dict, str, None], signals: dict) -> dict:
+    """Judge every SLO in ``spec`` against ``signals``.
+
+    Returns ``{"spec": name, "ok": bool, "failed": [...], "unknown":
+    [...], "verdicts": [{name, signal, observed, threshold, status,
+    breaching_phase?}]}`` with verdicts in spec order.  ``ok`` is True
+    only when EVERY SLO passed — unknown is not a pass."""
+    spec = load_spec(spec)
+    verdicts: List[dict] = []
+    for slo in spec["slos"]:
+        observed = lookup(signals, slo["signal"])
+        threshold = {k: slo[k] for k in ("min", "max") if slo.get(k)
+                     is not None}
+        v = {"name": slo["name"], "signal": slo["signal"],
+             "observed": observed, "threshold": threshold}
+        if not isinstance(observed, (int, float)) \
+                or isinstance(observed, bool):
+            v["observed"] = None if not isinstance(
+                observed, (int, float, str)) else observed
+            v["status"] = "unknown"
+        else:
+            breached = ((slo.get("min") is not None
+                         and observed < slo["min"])
+                        or (slo.get("max") is not None
+                            and observed > slo["max"]))
+            v["status"] = "fail" if breached else "pass"
+        if slo.get("phases"):
+            # attribution rides the verdict pass OR fail — a passing
+            # latency SLO's dominant phase is the headroom map
+            bp = _breaching_phase(signals, slo["phases"])
+            if bp is not None:
+                v["breaching_phase"] = bp
+        verdicts.append(v)
+    failed = [v["name"] for v in verdicts if v["status"] == "fail"]
+    unknown = [v["name"] for v in verdicts if v["status"] == "unknown"]
+    return {"spec": spec.get("name", "unnamed"),
+            "ok": not failed and not unknown,
+            "failed": failed, "unknown": unknown,
+            "verdicts": verdicts}
+
+
+def exit_code(result: dict) -> int:
+    """Gate exit status: 0 all pass, 1 any threshold breach, 2 no breach
+    but missing signals (telemetry went dark — still not a pass)."""
+    if result["failed"]:
+        return 1
+    if result["unknown"]:
+        return 2
+    return 0
+
+
+# -- signal extraction ------------------------------------------------------
+
+def signals_from_rollup(rollup: dict) -> dict:
+    """Flatten a fleet_aggregator rollup into SLO-addressable signals."""
+    out: Dict[str, object] = {}
+    fleet = rollup.get("fleet") or {}
+    for k in ("tasks_per_s", "completion_ratio", "tasks_dispatched",
+              "tasks_completed", "peers", "stale_peers", "counter_resets",
+              "ticks", "ticks_over_budget"):
+        if fleet.get(k) is not None:
+            out[f"fleet.{k}"] = fleet[k]
+    evictions = drops = 0
+    saw_bus = False
+    for p in (rollup.get("peers") or {}).values():
+        bus = p.get("bus")
+        if bus:
+            saw_bus = True
+            evictions += bus.get("slow_consumer_evictions") or 0
+            drops += bus.get("slow_consumer_drops") or 0
+        if p.get("proc", "").startswith("manager"):
+            # WORST manager wins each latency signal: a multi-manager
+            # fleet must not let the healthiest (or lexicographically
+            # last) peer mask a sick one
+            def _worst(key, value):
+                if value is None:
+                    return
+                prev = out.get(key)
+                if prev is None or value > prev:
+                    out[key] = value
+            if p.get("tick"):
+                _worst("manager.tick_p50_ms", p["tick"].get("p50_ms"))
+                _worst("manager.tick_p95_ms", p["tick"].get("p95_ms"))
+            if p.get("tasks"):
+                _worst("manager.task_latency_p95_ms",
+                       p["tasks"].get("latency_p95_ms"))
+    if saw_bus:
+        # only when a busd beacon was actually seen: zero-by-absence
+        # would let "no bus telemetry" pass a zero-evictions SLO
+        out["bus.slow_consumer_evictions"] = evictions
+        out["bus.slow_consumer_drops"] = drops
+    return out
+
+
+def signals_from_timeline(summary: dict) -> dict:
+    """Flatten a task_timeline summary (phase attribution percentiles)."""
+    out: Dict[str, object] = {}
+    phases = summary.get("fleet_phases_ms") or {}
+    p99_map: Dict[str, float] = {}
+    for phase, pcts in phases.items():
+        for q in ("p50", "p95", "p99"):
+            if pcts.get(q) is not None:
+                out[f"timeline.phase_{q}_ms.{phase}"] = pcts[q]
+        if pcts.get("p99") is not None:
+            p99_map[phase] = pcts["p99"]
+    if p99_map:
+        out["timeline.fleet_phases_p99_ms"] = p99_map
+    e2e = summary.get("end_to_end_ms") or {}
+    for q in ("p50", "p95", "p99"):
+        if e2e.get(q) is not None:
+            out[f"timeline.end_to_end_{q}_ms"] = e2e[q]
+    for k in ("coverage", "tasks_complete", "tasks_acked", "orphans",
+              "hop_violations"):
+        if summary.get(k) is not None:
+            out[f"timeline.{k}"] = summary[k]
+    return out
+
+
+# -- rendering --------------------------------------------------------------
+
+_MARK = {"pass": "✓", "fail": "✗", "unknown": "?"}
+_COLOR = {"pass": "\x1b[32m", "fail": "\x1b[31m", "unknown": "\x1b[33m"}
+
+
+def _fmt_threshold(t: dict) -> str:
+    parts = []
+    if "min" in t:
+        parts.append(f">= {t['min']:g}")
+    if "max" in t:
+        parts.append(f"<= {t['max']:g}")
+    return " and ".join(parts) or "-"
+
+
+def _fmt_observed(v) -> str:
+    if v is None:
+        return "missing"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def render_line(result: dict, color: bool = False) -> str:
+    """One status line per SLO, joined — the fleet_top live view shape."""
+    parts = []
+    for v in result["verdicts"]:
+        mark = _MARK[v["status"]]
+        body = (f"{mark} {v['name']} {_fmt_observed(v['observed'])} "
+                f"({_fmt_threshold(v['threshold'])})")
+        if v["status"] == "fail" and v.get("breaching_phase"):
+            body += f" [{v['breaching_phase']}]"
+        if color:
+            body = f"{_COLOR[v['status']]}{body}\x1b[0m"
+        parts.append(body)
+    head = "SLO[{}] ".format(result["spec"])
+    return head + " | ".join(parts)
+
+
+def render_md(result: dict) -> str:
+    """Markdown verdict table (the .md half of the committed artifact)."""
+    lines = [f"## SLO verdict — spec `{result['spec']}` — "
+             + ("**PASS**" if result["ok"] else
+                ("**FAIL**" if result["failed"] else "**UNKNOWN**")),
+             "",
+             "| SLO | signal | observed | threshold | status "
+             "| breaching phase |",
+             "|---|---|---|---|---|---|"]
+    for v in result["verdicts"]:
+        # the phase column names a BREACHING phase: attribution is only
+        # rendered on a failed SLO (passing verdicts keep the dominant
+        # phase in the JSON for headroom reading, but not here)
+        phase = v.get("breaching_phase", "-") if v["status"] == "fail" \
+            else "-"
+        lines.append(
+            f"| {v['name']} | `{v['signal']}` "
+            f"| {_fmt_observed(v['observed'])} "
+            f"| {_fmt_threshold(v['threshold'])} "
+            f"| {v['status'].upper()} "
+            f"| {phase} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    """Re-judge a saved signals dump against a spec (the CI breach
+    drill): ``python -m p2p_distributed_tswap_tpu.obs.slo --signals
+    out.json [--spec spec.json]``.  ``--signals`` accepts either a raw
+    signals dict or a fleetsim verdict artifact (its ``signals`` key)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--signals", required=True,
+                    help="JSON file: a signals dict, or an artifact "
+                         "with a 'signals' key")
+    ap.add_argument("--spec", default=None,
+                    help="SLO spec JSON file (default: built-in "
+                         "rated-load spec)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    with open(args.signals) as f:
+        payload = json.load(f)
+    signals = payload
+    if isinstance(payload, dict):
+        if isinstance(payload.get("signals"), dict):
+            signals = payload["signals"]
+        elif payload.get("rungs"):  # a fleetsim artifact: newest rung
+            signals = payload["rungs"][-1].get("signals") or {}
+    result = evaluate(args.spec, signals)
+    print(json.dumps(result, indent=2) if args.as_json
+          else render_line(result))
+    return exit_code(result)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -41,6 +41,31 @@ def ensure_built() -> Path:
     return BUILD_DIR
 
 
+def wait_for_log(path, needle: str, timeout_s: float,
+                 proc: Optional[subprocess.Popen] = None,
+                 tail_bytes: int = 65536) -> bool:
+    """Poll a child's log file for a readiness banner, reading only the
+    TAIL (a --warm solverd log grows; re-reading it whole 2x/s is wasted
+    I/O).  True on match; False on timeout or — when ``proc`` is given —
+    on the child exiting first.  Shared by the fleet runner and the
+    harnesses (solver_crossover, fleetsim), which each had their own
+    copy of this loop before."""
+    deadline = time.monotonic() + timeout_s
+    path = Path(path)
+    needle_b = needle.encode()
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False
+        if path.exists():
+            with open(path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - tail_bytes))
+                if needle_b in f.read():
+                    return True
+        time.sleep(0.5)
+    return False
+
+
 def build_single_tu(binary_name: str, source_rel: str) -> Optional[Path]:
     """Build one single-translation-unit runtime binary with a bare g++
     (every cpp/ binary is one TU, so no cmake/ninja needed) — the shared
@@ -138,18 +163,11 @@ class Fleet:
                              *(solverd_args or [])])
             # wait for the readiness banner (printed AFTER any --warm
             # pre-compile) so the manager never opens with a failover
-            # window; without logs fall back to a fixed headroom sleep
+            # window; a startup death just means the manager plans
+            # natively; without logs fall back to a fixed headroom sleep
             if self.log_dir:
-                sd_log = self.log_dir / "solverd.log"
-                deadline = time.monotonic() + 240
-                while time.monotonic() < deadline:
-                    if sd_proc.poll() is not None:
-                        break  # died at startup: manager will plan natively
-                    if (sd_log.exists()
-                            and "solverd up" in sd_log.read_text(
-                                errors="ignore")):
-                        break
-                    time.sleep(0.5)
+                wait_for_log(self.log_dir / "solverd.log", "solverd up",
+                             240, proc=sd_proc)
             else:
                 time.sleep(8)  # accelerator init headroom
         mgr_cmd = [str(build / f"mapd_manager_{mode}"), "--port", str(port),

@@ -1,0 +1,363 @@
+"""Multiplexed wire-faithful simulated agents: the fleetsim load pool.
+
+``analysis/solver_crossover.py`` proved the pattern — N bus agents in
+one process close the task/move loop over busd so the manager plans a
+genuinely churning fleet — but its SimFleet was a harness-private
+minimum: flat JSON heartbeats only, no trace context, no shard
+awareness, no done retransmit.  This module is the reusable
+generalization the load harness (``analysis/fleetsim.py``) drives to
+thousands of agents per process:
+
+- **wire-faithful**: each simulated agent mirrors the C++ centralized
+  agent's protocol — adopt a dispatched Task, obey ``move_instruction``
+  and re-broadcast position immediately, publish
+  ``task_metric_completed`` + ``done`` at the delivery, retransmit the
+  done until the manager's ``done_ack`` lands, drop a task on
+  ``task_withdrawn``;
+- **pos1/region-speaking**: with region gossip on (``JG_REGION_GOSSIP``,
+  default), heartbeats are packed ``pos1`` beacons published on the
+  agent's region topic ``mapd.pos.<rx>.<ry>`` — which the shard-aware
+  BusClient routes to the owning busd shard, so a pool run loads the
+  federated plane exactly like a real fleet.  A busy agent's beacon
+  carries its task's trace1 context like the C++ agent's does;
+- **trace-context-propagating**: the pool parses each task's ``tc``,
+  max-merges hops from ``move_instruction``, and emits the same
+  lifecycle events as the real agent (``task.claim`` / ``task.exec`` /
+  ``task.delivery`` / ``task.done_ack`` via obs/events.py), so
+  ``analysis/task_timeline.py`` attributes phases for simulated fleets
+  with no special casing;
+- **multiplexed identity**: thousands of agents share ONE BusClient
+  (one socket per bus shard).  Identity travels in-band: heartbeats and
+  dones carry an explicit ``peer_id`` payload field, which the
+  centralized manager prefers over the bus frame's ``from`` when
+  present (real per-process agents never set it — their wire is
+  unchanged).
+
+Heartbeats are staggered across the interval (agent index phase) so a
+thousand-agent pool beacons as a smooth stream, not a thundering herd.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from p2p_distributed_tswap_tpu.obs import events as _events
+from p2p_distributed_tswap_tpu.obs import registry as _reg
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+from p2p_distributed_tswap_tpu.runtime import region
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+
+DONE_RETRY_S = 2.0
+
+
+def _now_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class SimAgent:
+    """One simulated agent's protocol state (pure data; the pool drives
+    it)."""
+
+    __slots__ = ("peer_id", "pos", "task", "picked", "tc", "exec_emitted",
+                 "next_hb", "unacked_done", "unacked_metric", "unacked_tc",
+                 "done_next_retry")
+
+    def __init__(self, peer_id: str, pos: int):
+        self.peer_id = peer_id
+        self.pos = pos
+        self.task: Optional[dict] = None
+        self.picked = False
+        self.tc: Optional[pc.TraceCtx] = None
+        self.exec_emitted = False
+        self.next_hb = 0.0
+        self.unacked_done: Optional[dict] = None
+        self.unacked_metric: Optional[dict] = None
+        self.unacked_tc: Optional[pc.TraceCtx] = None
+        self.done_next_retry = 0.0
+
+
+class SimAgentPool:
+    """N wire-faithful agents multiplexed over one shard-aware client.
+
+    ``port``/``host`` name the home bus shard; a pool environment
+    (``JG_BUS_SHARD_PORTS``) makes the client shard-aware exactly like
+    every other fleet process.  ``region_gossip``/``region_cells``
+    default to the ``JG_REGION_GOSSIP``/``JG_REGION_CELLS`` environment
+    (matching the C++ agents' knobs).
+    """
+
+    def __init__(self, n: int, side: int, port: int = 7400,
+                 host: str = "127.0.0.1", seed: int = 1,
+                 heartbeat_s: float = 2.0,
+                 region_gossip: Optional[bool] = None,
+                 region_cells: Optional[int] = None,
+                 peer_id: str = "simfleet",
+                 echo_moves: bool = True):
+        import numpy as np
+
+        self.n = n
+        self.side = side
+        self.heartbeat_s = heartbeat_s
+        self.echo_moves = echo_moves
+        self.region_gossip = (
+            os.environ.get("JG_REGION_GOSSIP", "1") not in ("0", "false", "")
+            if region_gossip is None else region_gossip)
+        self.region_cells = int(
+            region_cells if region_cells is not None
+            else os.environ.get("JG_REGION_CELLS",
+                                str(region.DEFAULT_REGION_CELLS))
+            or region.DEFAULT_REGION_CELLS)
+        rng = np.random.default_rng(seed)
+        cells = rng.choice(side * side, size=n, replace=False)
+        # peer ids shaped like the real fleet's (bus.hpp random_peer_id:
+        # "12D3KooW" + 36 chars) — wire-byte realism (solver_crossover
+        # established the discipline: short names flatter the codecs)
+        alphabet = np.frombuffer(
+            b"123456789ABCDEFGHJKLMNPQRSTUVWXYZ"
+            b"abcdefghijkmnopqrstuvwxyz", np.uint8)
+
+        def _pid(k: int) -> str:
+            tail = rng.choice(alphabet, size=28).tobytes().decode()
+            return f"12D3KooWsim{k:05d}{tail}"
+
+        self.agents: Dict[str, SimAgent] = {}
+        now = time.monotonic()
+        for k in range(n):
+            a = SimAgent(_pid(k), int(cells[k]))
+            # stagger the first beat across the interval: smooth stream,
+            # not a thundering herd of n beacons per interval edge
+            a.next_hb = now + heartbeat_s * (k / max(1, n))
+            self.agents[a.peer_id] = a
+        self.bus = BusClient(host=host, port=port, peer_id=peer_id,
+                             reconnect=True)
+        self.bus.subscribe("mapd")
+        # counters the harness reads after (or during) a run
+        self.done_count = 0
+        self.adopted = 0
+        self.moves = 0
+        self.withdrawn = 0
+        self.acked = 0
+
+    # -- geometry ---------------------------------------------------------
+    def _pt(self, c: int) -> List[int]:
+        return [c % self.side, c // self.side]
+
+    def _cell(self, p) -> int:
+        return int(p[1]) * self.side + int(p[0])
+
+    # -- publishing -------------------------------------------------------
+    def _beacon(self, a: SimAgent) -> None:
+        """One heartbeat: packed pos1 on the agent's region topic (the
+        sharded-gossip wire) or flat JSON position_update — mirroring
+        cpp/agent_centralized broadcast_position, identity in-band."""
+        if self.region_gossip:
+            tc = None
+            if a.task is not None and a.tc is not None \
+                    and _events.ctx_enabled():
+                # current hop, fresh stamp: a repeated claim heartbeat
+                tc = pc.TraceCtx(a.tc.trace_id, a.tc.hop, _now_ms())
+            msg = {"type": "pos1", "peer_id": a.peer_id,
+                   "data": pc.encode_pos1_b64(
+                       a.pos, a.pos,
+                       int(a.task["task_id"]) if a.task else None, tc)}
+            topic = region.topic_for(a.pos % self.side, a.pos // self.side,
+                                     self.region_cells)
+            self.bus.publish(topic, msg)
+            return
+        msg = {"type": "position_update", "peer_id": a.peer_id,
+               "position": self._pt(a.pos)}
+        if a.task is not None:
+            msg["busy_task"] = a.task["task_id"]
+            if a.tc is not None and _events.ctx_enabled():
+                msg["tc"] = [a.tc.trace_id, a.tc.hop, _now_ms()]
+        self.bus.publish("mapd", msg)
+
+    def _publish_done(self, a: SimAgent, now: float,
+                      retransmit: bool = False) -> None:
+        assert a.unacked_done is not None
+        if retransmit and a.unacked_tc is not None:
+            # retransmits carry a FRESH context stamp, hop advanced —
+            # each retransmit is a new wire crossing (mirrors the C++
+            # agent's refresh_unacked_tc); without this the retry delay
+            # would read as multi-second wire latency in the timeline
+            a.unacked_tc = pc.TraceCtx(a.unacked_tc.trace_id,
+                                       a.unacked_tc.hop + 1, _now_ms())
+            a.unacked_done["tc"] = [a.unacked_tc.trace_id,
+                                    a.unacked_tc.hop,
+                                    a.unacked_tc.send_ms]
+        if a.unacked_metric is not None:
+            self.bus.publish("mapd", a.unacked_metric)
+        self.bus.publish("mapd", a.unacked_done)
+        a.done_next_retry = now + DONE_RETRY_S
+
+    def _arrival(self, a: SimAgent, now: float) -> None:
+        t = a.task
+        if t is None:
+            return
+        if a.pos == self._cell(t["pickup"]):
+            a.picked = True  # stats only — see below
+        # done detection is PURELY POSITIONAL, like the reference and the
+        # C++ agent (completion_check: pos == delivery, no pickup gate).
+        # This matters under TSWAP goal exchanges: a ToDelivery task
+        # re-assigned mid-flight must complete when its NEW holder reaches
+        # the delivery — gating on pickup-visited strands every exchanged
+        # task and the fleet decays into exchange thrash (found by the
+        # fleetsim SLO gate, tasks/s collapsing 121/min -> 2/min).
+        if a.pos == self._cell(t["delivery"]):
+            tid = int(t["task_id"])
+            if a.tc is not None:
+                _events.emit("task.delivery", trace_id=a.tc.trace_id,
+                             hop=a.tc.hop, task_id=tid, peer=a.peer_id)
+            done = {"status": "done", "task_id": tid, "peer_id": a.peer_id}
+            if a.tc is not None and _events.ctx_enabled():
+                a.tc = pc.TraceCtx(a.tc.trace_id, a.tc.hop + 1, _now_ms())
+                done["tc"] = [a.tc.trace_id, a.tc.hop, a.tc.send_ms]
+            a.unacked_done = done
+            a.unacked_metric = {
+                "type": "task_metric_completed", "task_id": tid,
+                "peer_id": a.peer_id, "timestamp_ms": _now_ms()}
+            a.unacked_tc = a.tc
+            self._publish_done(a, now)
+            a.task = None
+            a.picked = False
+            a.tc = None
+            a.exec_emitted = False
+            self.done_count += 1
+            _reg.count("sim.tasks_done")
+
+    # -- inbound ----------------------------------------------------------
+    def _on_move(self, d: dict, now: float) -> None:
+        a = self.agents.get(d.get("peer_id"))
+        if a is None:
+            return
+        tc = _events.parse_tc(d)
+        if tc is not None and a.tc is not None \
+                and tc[0] == a.tc.trace_id:
+            if tc[1] > a.tc.hop:  # max-merge semantics
+                a.tc = pc.TraceCtx(a.tc.trace_id, tc[1], a.tc.send_ms)
+            if not a.exec_emitted and a.task is not None:
+                # first obeyed instruction: the planning wait has ended
+                a.exec_emitted = True
+                _events.emit("task.exec", trace_id=tc[0], hop=tc[1],
+                             task_id=int(a.task["task_id"]),
+                             peer=a.peer_id, send_ms=tc[2])
+        a.pos = self._cell(d["next_pos"])
+        self.moves += 1
+        if self.echo_moves:
+            # obey and re-broadcast immediately, like the real agent —
+            # this echo IS the position load that saturates the bus
+            self._beacon(a)
+            a.next_hb = now + self.heartbeat_s
+        self._arrival(a, now)
+
+    def _on_task(self, d: dict, now: float) -> None:
+        a = self.agents.get(d.get("peer_id"))
+        if a is None:
+            return
+        tid = int(d["task_id"])
+        if a.unacked_done is not None \
+                and int(a.unacked_done["task_id"]) == tid:
+            # the manager re-sent a task we already completed (its done
+            # was lost): refuse the duplicate, heal by retransmitting
+            self._publish_done(a, now, retransmit=True)
+            return
+        if a.task is not None and int(a.task["task_id"]) == tid:
+            return  # duplicate delivery of the task in progress
+        a.task = d
+        a.picked = False
+        a.exec_emitted = False
+        tc = _events.parse_tc(d)
+        a.tc = pc.TraceCtx(*tc) if tc is not None else None
+        self.adopted += 1
+        _reg.count("sim.tasks_adopted")
+        if tc is not None:
+            _events.emit("task.claim", trace_id=tc[0], hop=tc[1],
+                         task_id=tid, peer=a.peer_id, send_ms=tc[2])
+        self._beacon(a)
+        a.next_hb = now + self.heartbeat_s
+        self._arrival(a, now)  # degenerate: already at the delivery
+
+    def _on_msg(self, d: dict, now: float) -> None:
+        typ = d.get("type")
+        if typ == "move_instruction":
+            self._on_move(d, now)
+        elif typ == "done_ack":
+            a = self.agents.get(d.get("peer_id"))
+            if a is not None and a.unacked_done is not None \
+                    and int(a.unacked_done["task_id"]) == d.get("task_id"):
+                tc = _events.parse_tc(d)
+                if tc is not None:
+                    _events.emit("task.done_ack", trace_id=tc[0], hop=tc[1],
+                                 task_id=int(d["task_id"]), peer=a.peer_id,
+                                 send_ms=tc[2])
+                a.unacked_done = None
+                a.unacked_metric = None
+                a.unacked_tc = None
+                self.acked += 1
+        elif typ == "task_withdrawn":
+            a = self.agents.get(d.get("peer_id"))
+            if a is not None and a.task is not None \
+                    and int(a.task["task_id"]) == d.get("task_id"):
+                a.task = None
+                a.picked = False
+                a.tc = None
+                self.withdrawn += 1
+        elif typ is None and "pickup" in d and "delivery" in d:
+            self._on_task(d, now)
+
+    # -- the loop ---------------------------------------------------------
+    def _due(self, now: float) -> None:
+        """Heartbeats due this slice + done retransmits past their retry."""
+        for a in self.agents.values():
+            if now >= a.next_hb:
+                self._beacon(a)
+                a.next_hb = now + self.heartbeat_s
+            if a.unacked_done is not None and now >= a.done_next_retry:
+                self._publish_done(a, now, retransmit=True)
+
+    def pump(self, budget_s: float) -> None:
+        """Drive the pool for ``budget_s`` seconds: deliver inbound
+        traffic, beat due heartbeats, retransmit unacked dones."""
+        end = time.monotonic() + budget_s
+        while True:
+            now = time.monotonic()
+            if now >= end:
+                return
+            self._due(now)
+            f = self.bus.recv(timeout=min(0.05, end - now))
+            drained = 0
+            while f is not None:
+                if f.get("op") == "msg":
+                    self._on_msg(f.get("data") or {}, time.monotonic())
+                drained += 1
+                # drain what is buffered before re-checking clocks (at
+                # thousands of agents the move stream outpaces a strict
+                # one-frame-per-recv loop) — but BOUNDED: a sustained
+                # burst must not starve heartbeats/retransmits (_due) or
+                # overshoot the caller's budget
+                if drained >= 512 or time.monotonic() >= end:
+                    break
+                f = self.bus.recv(timeout=0.0)
+
+    def heartbeat_all(self) -> None:
+        """Force one immediate beacon per agent (pool startup: make the
+        whole roster known to the manager before tasks are injected)."""
+        now = time.monotonic()
+        for k, a in enumerate(self.agents.values()):
+            self._beacon(a)
+            # re-stagger: the next regular beat keeps the smooth phase
+            a.next_hb = now + self.heartbeat_s * (1 + k / max(1, self.n))
+
+    def busy(self) -> int:
+        return sum(1 for a in self.agents.values() if a.task is not None)
+
+    def stats(self) -> dict:
+        return {"agents": self.n, "adopted": self.adopted,
+                "done": self.done_count, "acked": self.acked,
+                "moves": self.moves, "withdrawn": self.withdrawn,
+                "busy": self.busy()}
+
+    def close(self) -> None:
+        self.bus.close()

@@ -31,6 +31,9 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/runtime/region.py \
     p2p_distributed_tswap_tpu/runtime/shardmap.py \
     p2p_distributed_tswap_tpu/runtime/buspool.py \
+    p2p_distributed_tswap_tpu/runtime/simagent.py \
+    p2p_distributed_tswap_tpu/obs/slo.py \
+    analysis/fleetsim.py \
     scripts/bus_smoke.py \
     scripts/trace_smoke.py \
     bench.py
@@ -62,6 +65,36 @@ echo "== trace smoke =="
 # reconstruct >= 1 fully-attributed task timeline (task_timeline.py
 # --once --json) — proof the trace context propagates on the real wire
 JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
+echo "== fleetsim SLO gate =="
+# ISSUE 7: scaled-down production-load rehearsal — a tiny wire-faithful
+# sim fleet over a live 2-shard busd pool + centralized manager, judged
+# against the relaxed CI spec (deterministic seed).  Any SLO breach OR a
+# signal gone dark (exit 2) fails CI.  The breach drill then re-judges
+# the SAME measured signals against a known-breaching spec and demands
+# exit 1 — proof the gate can actually trip, every run.
+if [[ -x cpp/build/mapd_bus ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    JAX_PLATFORMS=cpu python analysis/fleetsim.py \
+        --agents 24 --side 24 --tick-ms 250 --shards 2 \
+        --settle 14 --window 12 --seed 1 \
+        --spec scripts/fleetsim_ci.spec.json \
+        --out /tmp/jg_fleetsim_ci.json \
+        --log-dir /tmp/jg_fleetsim_ci_logs
+    drill=0
+    JAX_PLATFORMS=cpu python -m p2p_distributed_tswap_tpu.obs.slo \
+        --signals /tmp/jg_fleetsim_ci.json \
+        --spec scripts/fleetsim_ci.breach.json >/dev/null || drill=$?
+    if [[ "$drill" != 1 ]]; then
+        echo "fleetsim gate did not trip on the breaching spec" \
+             "(exit $drill)" >&2
+        exit 1
+    fi
+    echo "fleetsim gate OK (breach drill tripped as expected)"
+else
+    echo "fleetsim gate SKIPPED (no C++ toolchain / binaries)"
+fi
 
 echo "== tier-1 suite =="
 rm -f /tmp/_t1.log

@@ -536,3 +536,79 @@ def test_aggregator_counter_reset_clamps_to_fresh_baseline():
     assert r["peers"]["p1"]["bandwidth"]["sent_kbps"] == \
         pytest.approx(2000 * 8 / 2 / 1000, rel=1e-3)
     assert agg.counter_resets == 1
+
+
+def test_aggregator_tasks_per_s_and_completion_ratio():
+    """ISSUE 7 satellite: a manager beacon's tasks_dispatched/completed
+    counter pair must yield a per-manager mgr_tasks section (delta-rate
+    tasks/s, cumulative completion ratio) and fleet-level rollup fields
+    the SLO engine reads."""
+    agg = FleetAggregator()
+    beacon = {"type": "metrics_beacon", "peer_id": "mgr",
+              "proc": "manager_centralized", "pid": 1, "interval_s": 2.0}
+
+    def metrics(uptime, dispatched, completed):
+        return {"uptime_s": uptime,
+                "counters": {"manager.tasks_dispatched": dispatched,
+                             "manager.tasks_completed": completed},
+                "gauges": {}, "hists": {}}
+
+    # single beacon: cumulative average over uptime
+    agg.ingest({**beacon, "metrics": metrics(10.0, 100, 40)},
+               now_ms=10_000)
+    r = agg.rollup(now_ms=10_000)
+    mt = r["peers"]["mgr"]["mgr_tasks"]
+    assert mt["dispatched"] == 100 and mt["completed"] == 40
+    assert mt["tasks_per_s"] == pytest.approx(4.0, rel=1e-3)
+    assert mt["completion_ratio"] == pytest.approx(0.4, rel=1e-3)
+    # second beacon 2 s later: delta rate, not cumulative average
+    agg.ingest({**beacon, "metrics": metrics(12.0, 120, 60)},
+               now_ms=12_000)
+    r = agg.rollup(now_ms=12_000)
+    mt = r["peers"]["mgr"]["mgr_tasks"]
+    assert mt["tasks_per_s"] == pytest.approx(10.0, rel=1e-3)  # 20 in 2 s
+    assert mt["completion_ratio"] == pytest.approx(0.5, rel=1e-3)
+    f = r["fleet"]
+    assert f["tasks_dispatched"] == 120
+    assert f["tasks_completed"] == 60
+    assert f["tasks_per_s"] == pytest.approx(10.0, rel=1e-3)
+    assert f["completion_ratio"] == pytest.approx(0.5, rel=1e-3)
+
+
+def test_aggregator_tasks_counter_reset_clamps():
+    """A restarted manager's shrinking task counters must clamp to the
+    fresh-baseline rate (never negative) and count the reset."""
+    agg = FleetAggregator()
+    beacon = {"type": "metrics_beacon", "peer_id": "mgr",
+              "proc": "manager_centralized", "pid": 1, "interval_s": 2.0}
+    before = {"uptime_s": 50.0,
+              "counters": {"manager.tasks_dispatched": 500,
+                           "manager.tasks_completed": 480},
+              "gauges": {}, "hists": {}}
+    after = {"uptime_s": 1.0,  # restart: fresh registry
+             "counters": {"manager.tasks_dispatched": 8,
+                          "manager.tasks_completed": 4},
+             "gauges": {}, "hists": {}}
+    agg.ingest({**beacon, "metrics": before}, now_ms=10_000)
+    agg.ingest({**beacon, "metrics": after}, now_ms=12_000)
+    r = agg.rollup(now_ms=12_000)
+    mt = r["peers"]["mgr"]["mgr_tasks"]
+    assert mt["tasks_per_s"] == pytest.approx(4 / 2.0, rel=1e-3)
+    assert mt["tasks_per_s"] >= 0
+    assert agg.counter_resets >= 1
+
+
+def test_aggregator_no_manager_counters_reads_none():
+    """Without the manager counter pair the fleet fields must be None —
+    'no telemetry' reads unknown downstream, never a silent 0/0 pass."""
+    agg = FleetAggregator()
+    agg.ingest({"type": "metrics_beacon", "peer_id": "a", "proc": "agent",
+                "pid": 2, "interval_s": 2.0,
+                "metrics": {"uptime_s": 5.0, "counters": {}, "gauges": {},
+                            "hists": {}}}, now_ms=10_000)
+    r = agg.rollup(now_ms=10_000)
+    assert r["peers"]["a"]["mgr_tasks"] is None
+    f = r["fleet"]
+    assert f["tasks_per_s"] is None
+    assert f["completion_ratio"] is None
+    assert f["tasks_dispatched"] is None

@@ -1,0 +1,267 @@
+"""Fleetsim load harness (ISSUE 7): sim-agent pool protocol contract
+over a fake bus (fast, Python-only) + a live-fleet smoke of the whole
+harness (slow, real busd pool + manager).
+
+The fake-bus tests pin the pool's wire faithfulness — adopt/claim,
+move-obedience with immediate re-broadcast, positional done with
+in-band identity, done-retransmit-until-ack, pos1 region beacons with
+the multiplexed peer_id envelope.  The slow test runs the real
+analysis/fleetsim.py gate end to end against a 2-shard busd pool and
+asserts every SLO evaluated (no unknowns) and passed at the relaxed
+rung.
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.obs.registry import Registry
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool
+
+from tests.test_fleet_metrics import FakeBusd  # noqa: F401 (fixture dep)
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture()
+def bus():
+    b = FakeBusd()
+    b.start()
+    yield b
+    b.stop()
+
+
+def _mgr_client(bus, topics=("mapd",)):
+    mgr = BusClient(port=bus.port, peer_id="fake-mgr", registry=Registry())
+    for t in topics:
+        mgr.subscribe(t)
+    time.sleep(0.15)
+    return mgr
+
+
+def _drain(mgr, pool, seconds=1.0, want=None):
+    """Pump both sides; collect mgr-visible messages (optionally until a
+    predicate matches)."""
+    out = []
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        pool.pump(0.05)
+        f = mgr.recv(timeout=0.05)
+        while f is not None:
+            if f.get("op") == "msg":
+                out.append(f)
+                if want is not None and want(f):
+                    return out
+            f = mgr.recv(timeout=0.0)
+    return out
+
+
+def test_pool_adopts_walks_and_dones_with_inband_identity(bus):
+    pool = SimAgentPool(3, side=8, port=bus.port, seed=3,
+                        region_gossip=False)
+    try:
+        mgr = _mgr_client(bus)
+        pool.heartbeat_all()
+        frames = _drain(mgr, pool, 1.0)
+        hb = [f for f in frames
+              if f["data"].get("type") == "position_update"]
+        assert len(hb) >= 3
+        # every heartbeat carries in-band identity (the pool multiplexes)
+        peers = {f["data"]["peer_id"] for f in hb}
+        assert len(peers) == 3
+        target = sorted(peers)[0]
+        a = pool.agents[target]
+        # dispatch a task whose pickup is the agent's own cell: adoption
+        # must mark pickup immediately (degenerate-arrival path)
+        pickup = [a.pos % 8, a.pos // 8]
+        delivery = [(a.pos % 8 + 1) % 8, a.pos // 8]
+        task = {"task_id": 42, "peer_id": target, "pickup": pickup,
+                "delivery": delivery, "tc": [90042, 1, 1_000]}
+        mgr.publish("mapd", task)
+        _drain(mgr, pool, 1.0)
+        assert pool.adopted == 1
+        assert pool.agents[target].task is not None
+        assert pool.agents[target].picked is True
+        # busy heartbeats carry the busy_task id
+        pool.heartbeat_all()
+        busy = _drain(
+            mgr, pool, 1.0,
+            want=lambda f: f["data"].get("peer_id") == target
+            and "busy_task" in f["data"])
+        assert busy[-1]["data"]["busy_task"] == 42
+        # move instruction to the delivery cell -> positional done with
+        # peer_id identity, echoed position, and the metric
+        mgr.publish("mapd", {"type": "move_instruction", "peer_id": target,
+                             "next_pos": delivery, "tc": [90042, 2, 1_001]})
+        frames = _drain(mgr, pool, 1.5,
+                        want=lambda f: f["data"].get("status") == "done")
+        done = [f for f in frames if f["data"].get("status") == "done"]
+        assert done and done[0]["data"]["peer_id"] == target
+        assert done[0]["data"]["task_id"] == 42
+        metrics = [f for f in frames
+                   if f["data"].get("type") == "task_metric_completed"]
+        assert metrics and metrics[0]["data"]["peer_id"] == target
+        assert pool.done_count == 1
+        assert pool.agents[target].task is None
+    finally:
+        pool.close()
+
+
+def test_pool_retransmits_done_until_acked(bus):
+    pool = SimAgentPool(1, side=8, port=bus.port, seed=5,
+                        region_gossip=False)
+    try:
+        mgr = _mgr_client(bus)
+        target = next(iter(pool.agents))
+        a = pool.agents[target]
+        here = [a.pos % 8, a.pos // 8]
+        mgr.publish("mapd", {"task_id": 7, "peer_id": target,
+                             "pickup": here, "delivery": here,
+                             "tc": [70007, 1, 1_000]})
+        # degenerate task: done fires on adoption; no ack -> retransmit
+        frames = _drain(mgr, pool, 2.8)
+        dones = [f for f in frames if f["data"].get("status") == "done"]
+        assert len(dones) >= 2, "unacked done must retransmit"
+        # each retransmit is a new wire crossing: fresh stamp, hop
+        # advanced (mirrors the C++ agent's refresh_unacked_tc) — a
+        # stale stamp would read as seconds of wire latency
+        hops = [f["data"]["tc"][1] for f in dones]
+        assert hops == sorted(hops) and hops[-1] > hops[0], hops
+        stamps = [f["data"]["tc"][2] for f in dones]
+        assert stamps[-1] > stamps[0]
+        assert pool.acked == 0
+        mgr.publish("mapd", {"type": "done_ack", "peer_id": target,
+                             "task_id": 7})
+        _drain(mgr, pool, 0.8)
+        assert pool.acked == 1
+        before = pool.done_count
+        _drain(mgr, pool, 2.2)
+        more = sum(1 for f in _drain(mgr, pool, 0.3)
+                   if f["data"].get("status") == "done")
+        assert more == 0, "acked done must stop retransmitting"
+        assert pool.done_count == before
+    finally:
+        pool.close()
+
+
+def test_pool_pos1_region_beacons_carry_envelope_identity(bus):
+    # side 8 < one region (32 cells): every beacon lands on mapd.pos.0.0
+    pool = SimAgentPool(2, side=8, port=bus.port, seed=7,
+                        region_gossip=True, region_cells=32)
+    try:
+        mgr = _mgr_client(bus, topics=("mapd", "mapd.pos.0.0"))
+        pool.heartbeat_all()
+        frames = _drain(mgr, pool, 1.0)
+        beacons = [f for f in frames if f["data"].get("type") == "pos1"]
+        assert len(beacons) >= 2
+        peers = set()
+        for f in beacons:
+            assert f["topic"] == "mapd.pos.0.0"
+            # the multiplexed pool puts identity in the envelope (the
+            # packed payload itself stays byte-identical to the real
+            # agents' — no name inside)
+            peers.add(f["data"]["peer_id"])
+            pos, goal, tid = pc.decode_pos1_b64(f["data"]["data"])
+            assert tid is None
+            assert pos == pool.agents[f["data"]["peer_id"]].pos
+        assert len(peers) == 2
+        # a busy agent's pos1 carries its task id
+        target = sorted(peers)[0]
+        a = pool.agents[target]
+        far = [(a.pos % 8 + 2) % 8, (a.pos // 8 + 2) % 8]
+        mgr.publish("mapd", {"task_id": 9, "peer_id": target,
+                             "pickup": far, "delivery": [0, 0]})
+        pool.pump(0.3)
+        pool.heartbeat_all()
+        busy = _drain(
+            mgr, pool, 1.0,
+            want=lambda f: f["data"].get("type") == "pos1"
+            and f["data"].get("peer_id") == target
+            and pc.decode_pos1_b64(f["data"]["data"])[2] == 9)
+        assert busy, "busy pos1 beacon must carry the task id"
+    finally:
+        pool.close()
+
+
+def test_pool_withdrawn_drops_task(bus):
+    pool = SimAgentPool(1, side=8, port=bus.port, seed=9,
+                        region_gossip=False)
+    try:
+        mgr = _mgr_client(bus)
+        target = next(iter(pool.agents))
+        a = pool.agents[target]
+        far = [(a.pos % 8 + 3) % 8, a.pos // 8]
+        mgr.publish("mapd", {"task_id": 11, "peer_id": target,
+                             "pickup": far, "delivery": [0, 0]})
+        _drain(mgr, pool, 0.6)
+        assert pool.agents[target].task is not None
+        mgr.publish("mapd", {"type": "task_withdrawn", "peer_id": target,
+                             "task_id": 11})
+        _drain(mgr, pool, 0.6)
+        assert pool.agents[target].task is None
+        assert pool.withdrawn == 1
+    finally:
+        pool.close()
+
+
+# -- live harness smoke (slow) ---------------------------------------------
+
+pytestmark_live = pytest.mark.skipif(
+    not (ROOT / "cpp" / "build" / "mapd_bus").exists()
+    and (shutil.which("cmake") is None or shutil.which("ninja") is None),
+    reason="C++ toolchain unavailable")
+
+
+@pytest.mark.slow
+@pytestmark_live
+def test_fleetsim_gate_live(tmp_path):
+    """The scaled-down CI rung for real: small pool over a live 2-shard
+    busd pool + centralized manager; every SLO must be EVALUATED (no
+    unknowns) and pass at relaxed thresholds; the breach drill must trip
+    exit 1 on the same signals."""
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({
+        "name": "smoke", "slos": [
+            {"name": "wire_p99", "signal": "timeline.phase_p99_ms.wire",
+             "max": 2000.0, "phases": "timeline.fleet_phases_p99_ms"},
+            {"name": "completion", "signal": "fleet.completion_ratio",
+             "min": 0.2},
+            {"name": "evictions", "signal": "bus.slow_consumer_evictions",
+             "max": 0},
+            {"name": "tasks_per_s", "signal": "fleet.tasks_per_s",
+             "min": 0.1}]}))
+    out = tmp_path / "fleetsim.json"
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "analysis" / "fleetsim.py"),
+         "--agents", "24", "--side", "24", "--tick-ms", "250",
+         "--shards", "2", "--settle", "14", "--window", "12",
+         "--spec", str(spec), "--out", str(out),
+         "--log-dir", str(tmp_path / "logs")],
+        capture_output=True, text=True, timeout=600, cwd=str(ROOT))
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    rung = doc["rungs"][0]
+    assert rung["shards"] == 2
+    statuses = {v["name"]: v["status"]
+                for v in rung["slo"]["verdicts"]}
+    assert all(s == "pass" for s in statuses.values()), statuses
+    assert rung["sim"]["done"] > 0
+    assert out.with_name(out.name + ".md").exists()
+    # breach drill: same signals, impossible spec, exit 1
+    breach = tmp_path / "breach.json"
+    breach.write_text(json.dumps({
+        "name": "breach", "slos": [
+            {"name": "tasks_per_s", "signal": "fleet.tasks_per_s",
+             "min": 100000.0}]}))
+    judged = subprocess.run(
+        [sys.executable, "-m", "p2p_distributed_tswap_tpu.obs.slo",
+         "--signals", str(out), "--spec", str(breach)],
+        capture_output=True, text=True, timeout=60, cwd=str(ROOT))
+    assert judged.returncode == 1, judged.stdout + judged.stderr

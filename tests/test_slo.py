@@ -1,0 +1,264 @@
+"""SLO spec + evaluation engine (ISSUE 7 tentpole, obs/slo.py).
+
+Covers the contract the CI gate leans on: spec parsing rejects garbage
+loudly, inclusive threshold edges, missing signals become an explicit
+``unknown`` (exit 2) — never a silent pass — and breached latency SLOs
+name the breaching phase.  The signal extractors are tested against
+synthetic rollup/timeline shapes; the live-fleet path rides
+tests/test_fleetsim.py and the ci.sh gate.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.obs import slo
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+# -- spec parsing -----------------------------------------------------------
+
+def test_default_spec_loads_and_is_valid():
+    spec = slo.load_spec(None)
+    assert spec["name"] == "rated-load"
+    assert len(spec["slos"]) >= 3
+    names = [s["name"] for s in spec["slos"]]
+    assert len(names) == len(set(names))
+
+
+def test_spec_from_file_and_inline_json(tmp_path):
+    doc = {"name": "t", "slos": [{"name": "a", "signal": "x", "min": 1}]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(doc))
+    assert slo.load_spec(str(p))["name"] == "t"
+    assert slo.load_spec(json.dumps(doc))["name"] == "t"
+    assert slo.load_spec(doc)["name"] == "t"
+
+
+@pytest.mark.parametrize("bad", [
+    {},                                           # no slos
+    {"slos": []},                                 # empty slos
+    {"slos": [{"name": "a"}]},                    # no signal
+    {"slos": [{"name": "a", "signal": "x"}]},     # no bounds
+    {"slos": [{"name": "a", "signal": "x", "min": "1"}]},  # bound not num
+    {"slos": [{"name": "a", "signal": "x", "min": 2, "max": 1}]},
+    {"slos": [{"name": "a", "signal": "x", "min": 1},
+              {"name": "a", "signal": "y", "min": 1}]},    # dup name
+])
+def test_malformed_specs_raise(bad):
+    with pytest.raises(slo.SpecError):
+        slo.load_spec(bad)
+
+
+def test_non_json_spec_raises(tmp_path):
+    p = tmp_path / "junk.json"
+    p.write_text("not json {")
+    with pytest.raises(slo.SpecError):
+        slo.load_spec(str(p))
+
+
+# -- lookup -----------------------------------------------------------------
+
+def test_lookup_nested_flat_and_mixed():
+    sig = {"a": {"b": {"c": 1}},
+           "x.y": 2,
+           "timeline.phase_p99_ms": {"wire": 3}}
+    assert slo.lookup(sig, "a.b.c") == 1
+    assert slo.lookup(sig, "x.y") == 2
+    assert slo.lookup(sig, "timeline.phase_p99_ms.wire") == 3
+    assert slo.lookup(sig, "a.b.missing") is None
+    assert slo.lookup(sig, "nope") is None
+    assert slo.lookup(sig, "a.b.c.too.deep") is None
+
+
+# -- evaluation -------------------------------------------------------------
+
+def _one(signal_value, **bounds):
+    spec = {"name": "t", "slos": [{"name": "s", "signal": "v", **bounds}]}
+    return slo.evaluate(spec, {"v": signal_value})["verdicts"][0]
+
+
+def test_threshold_edges_are_inclusive():
+    # bounds are inclusive: observed == threshold passes
+    assert _one(5, max=5)["status"] == "pass"
+    assert _one(5.0001, max=5)["status"] == "fail"
+    assert _one(5, min=5)["status"] == "pass"
+    assert _one(4.9999, min=5)["status"] == "fail"
+    assert _one(0, max=0)["status"] == "pass"
+    assert _one(1, max=0)["status"] == "fail"
+    # range
+    assert _one(3, min=1, max=5)["status"] == "pass"
+    assert _one(0, min=1, max=5)["status"] == "fail"
+    assert _one(6, min=1, max=5)["status"] == "fail"
+
+
+def test_missing_signal_is_unknown_not_pass():
+    spec = {"name": "t", "slos": [{"name": "gone", "signal": "absent.sig",
+                                   "max": 1}]}
+    result = slo.evaluate(spec, {"other": 0})
+    v = result["verdicts"][0]
+    assert v["status"] == "unknown"
+    assert v["observed"] is None
+    assert result["ok"] is False          # unknown is NOT ok
+    assert result["unknown"] == ["gone"]
+    assert result["failed"] == []
+    assert slo.exit_code(result) == 2     # distinct from a breach (1)
+
+
+def test_non_numeric_signal_is_unknown():
+    assert _one("fast", max=1)["status"] == "unknown"
+    assert _one({"p99": 3}, max=1)["status"] == "unknown"
+    assert _one(True, max=1)["status"] == "unknown"  # bools are not rates
+
+
+def test_exit_codes():
+    spec = {"name": "t", "slos": [{"name": "a", "signal": "x", "max": 1}]}
+    assert slo.exit_code(slo.evaluate(spec, {"x": 0})) == 0
+    assert slo.exit_code(slo.evaluate(spec, {"x": 2})) == 1
+    assert slo.exit_code(slo.evaluate(spec, {})) == 2
+    # fail wins over unknown in the exit code
+    spec2 = {"name": "t", "slos": [
+        {"name": "a", "signal": "x", "max": 1},
+        {"name": "b", "signal": "gone", "max": 1}]}
+    assert slo.exit_code(slo.evaluate(spec2, {"x": 5})) == 1
+
+
+def test_breaching_phase_attribution():
+    spec = {"name": "t", "slos": [
+        {"name": "e2e_p99", "signal": "timeline.end_to_end_p99_ms",
+         "max": 100, "phases": "timeline.fleet_phases_p99_ms"}]}
+    signals = {"timeline.end_to_end_p99_ms": 900,
+               "timeline.fleet_phases_p99_ms": {
+                   "queueing": 5, "wire": 20, "planning": 700,
+                   "to_delivery": 175}}
+    v = slo.evaluate(spec, signals)["verdicts"][0]
+    assert v["status"] == "fail"
+    assert v["breaching_phase"] == "planning"
+    # the {p50,p95,p99} nested shape is judged by p99
+    signals2 = {"timeline.end_to_end_p99_ms": 900,
+                "timeline.fleet_phases_p99_ms": {
+                    "wire": {"p99": 20}, "to_pickup": {"p99": 800}}}
+    v2 = slo.evaluate(spec, signals2)["verdicts"][0]
+    assert v2["breaching_phase"] == "to_pickup"
+
+
+# -- signal extraction ------------------------------------------------------
+
+def test_signals_from_rollup():
+    rollup = {
+        "fleet": {"tasks_per_s": 9.5, "completion_ratio": 0.98,
+                  "tasks_dispatched": 200, "tasks_completed": 196,
+                  "peers": 4, "stale_peers": 0, "counter_resets": 0,
+                  "ticks": 100, "ticks_over_budget": 2},
+        "peers": {
+            "busd0": {"proc": "busd",
+                      "bus": {"slow_consumer_evictions": 1,
+                              "slow_consumer_drops": 3}},
+            "busd1": {"proc": "busd",
+                      "bus": {"slow_consumer_evictions": 2,
+                              "slow_consumer_drops": 0}},
+            "mgr": {"proc": "manager_centralized",
+                    "tick": {"p50_ms": 4.0, "p95_ms": 12.0},
+                    "tasks": {"latency_p95_ms": 800.0}},
+        },
+    }
+    sig = slo.signals_from_rollup(rollup)
+    assert sig["fleet.tasks_per_s"] == 9.5
+    assert sig["fleet.completion_ratio"] == 0.98
+    assert sig["bus.slow_consumer_evictions"] == 3  # summed over shards
+    assert sig["bus.slow_consumer_drops"] == 3
+    assert sig["manager.tick_p95_ms"] == 12.0
+    assert sig["manager.task_latency_p95_ms"] == 800.0
+
+
+def test_signals_from_rollup_worst_manager_wins():
+    # multi-manager fleets: the sickest peer defines the latency signal
+    sig = slo.signals_from_rollup({"fleet": {}, "peers": {
+        "mgr_a": {"proc": "manager_decentralized",
+                  "tick": {"p50_ms": 2.0, "p95_ms": 5000.0}},
+        "mgr_b": {"proc": "manager_decentralized",
+                  "tick": {"p50_ms": 4.0, "p95_ms": 12.0}}}})
+    assert sig["manager.tick_p95_ms"] == 5000.0
+    assert sig["manager.tick_p50_ms"] == 4.0
+
+
+def test_signals_from_rollup_without_busd_has_no_bus_signals():
+    # zero-by-absence would let "no bus telemetry" pass an evictions SLO
+    sig = slo.signals_from_rollup({"fleet": {}, "peers": {
+        "mgr": {"proc": "manager_centralized"}}})
+    assert "bus.slow_consumer_evictions" not in sig
+    result = slo.evaluate(
+        {"name": "t", "slos": [{"name": "ev",
+                                "signal": "bus.slow_consumer_evictions",
+                                "max": 0}]}, sig)
+    assert result["verdicts"][0]["status"] == "unknown"
+
+
+def test_signals_from_timeline():
+    summary = {
+        "fleet_phases_ms": {
+            "wire": {"p50": 10, "p95": 30, "p99": 55},
+            "planning": {"p50": 40, "p95": 200, "p99": 380}},
+        "end_to_end_ms": {"p50": 5000, "p95": 9000, "p99": 12000},
+        "coverage": 0.98, "tasks_complete": 50, "tasks_acked": 51,
+        "orphans": 0, "hop_violations": 0,
+    }
+    sig = slo.signals_from_timeline(summary)
+    assert sig["timeline.phase_p99_ms.wire"] == 55
+    assert sig["timeline.phase_p50_ms.planning"] == 40
+    assert sig["timeline.end_to_end_p99_ms"] == 12000
+    assert sig["timeline.coverage"] == 0.98
+    assert sig["timeline.fleet_phases_p99_ms"] == {"wire": 55,
+                                                   "planning": 380}
+
+
+# -- rendering + CLI --------------------------------------------------------
+
+def test_render_line_and_md_cover_all_statuses():
+    spec = {"name": "t", "slos": [
+        {"name": "ok", "signal": "a", "max": 10},
+        {"name": "bad", "signal": "b", "max": 1,
+         "phases": "phases"},
+        {"name": "dark", "signal": "c", "min": 1}]}
+    result = slo.evaluate(spec, {"a": 5, "b": 9,
+                                 "phases": {"planning": 8, "wire": 1}})
+    line = slo.render_line(result)
+    assert "✓ ok" in line and "✗ bad" in line and "? dark" in line
+    assert "[planning]" in line  # breaching phase on the failed SLO
+    md = slo.render_md(result)
+    assert "**FAIL**" in md
+    assert "| planning |" in md
+    assert "missing" in md
+
+
+def test_cli_re_evaluates_signals_against_spec(tmp_path):
+    """The CI breach drill: the same saved signals judged by a rated
+    spec (pass) and a breaching spec (exit 1) without a fleet rerun."""
+    signals = {"fleet": {"tasks_per_s": 5.0}}
+    artifact = tmp_path / "art.json"
+    artifact.write_text(json.dumps({"signals": signals, "other": 1}))
+    rated = tmp_path / "rated.json"
+    rated.write_text(json.dumps(
+        {"name": "rated", "slos": [{"name": "tps",
+                                    "signal": "fleet.tasks_per_s",
+                                    "min": 1.0}]}))
+    breach = tmp_path / "breach.json"
+    breach.write_text(json.dumps(
+        {"name": "breach", "slos": [{"name": "tps",
+                                     "signal": "fleet.tasks_per_s",
+                                     "min": 10_000.0}]}))
+    cmd = [sys.executable, "-m", "p2p_distributed_tswap_tpu.obs.slo",
+           "--signals", str(artifact)]
+    ok = subprocess.run(cmd + ["--spec", str(rated)], cwd=str(ROOT),
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(cmd + ["--spec", str(breach), "--json"],
+                         cwd=str(ROOT), capture_output=True, text=True,
+                         timeout=60)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    out = json.loads(bad.stdout)
+    assert out["failed"] == ["tps"]
