@@ -184,6 +184,10 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int,
     env["JG_REGION_CELLS"] = str(args.region_cells)
     if shards > 1:
         env["JG_BUS_SHARDS"] = str(shards)
+    if args.cpu_affinity:
+        # per-shard relay pinning (ISSUE 8 satellite / ROADMAP item 1
+        # headroom): each busd shard owns a core on many-core hosts
+        env["JG_BUS_CPU_AFFINITY"] = args.cpu_affinity
     cfg = RuntimeConfig(decision_interval_ms=tick_ms)
     log_dir = Path(args.log_dir) \
         / f"{variant}_s{shards}_{args.agents}_{tick_ms}"
@@ -332,6 +336,10 @@ def main():
                     help="busd pool sizes to sweep on the region variant "
                          "(comma list, e.g. 1,3); the flat variants always "
                          "run the single hub")
+    ap.add_argument("--cpu-affinity", default="",
+                    help="pin busd shard i to cpu list[i %% len] "
+                         "('0,1,2' or 'auto'; needs a many-core host "
+                         "to show the pool's aggregate CPU win)")
     ap.add_argument("--settle", type=float, default=8.0)
     ap.add_argument("--window", type=float, default=20.0)
     ap.add_argument("--log-dir", default="/tmp/bus_scaling_logs")

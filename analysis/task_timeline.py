@@ -77,6 +77,15 @@ PHASE_OF_SEGMENT = {
 PHASES = ("queueing", "wire", "planning", "to_pickup", "to_delivery",
           "done_wire", "ack")
 
+# Hop "violations" (a later event carrying a SMALLER hop) are usually not
+# propagation bugs: when the receiver's inbound queue backs up, an event
+# stamped early drains late and lands behind a fresher, higher-hop one —
+# SCALING.md finding 2 (hop inversions as receiver lag).  The tell is
+# co-occurrence with a dispatch->claim wire tail breach: both are the same
+# backlog.  Above this claim-wire p99 the summary labels them
+# receiver_backlog so SLO artifacts stop reading them as protocol faults.
+WIRE_TAIL_BREACH_MS = 1000.0
+
 
 def load_events(directory: Path) -> list:
     events = []
@@ -198,7 +207,8 @@ def percentile(values: list, q: float) -> float:
     return float(vs[idx])
 
 
-def summarize(directory: Path) -> dict:
+def summarize(directory: Path,
+              wire_tail_ms: float = WIRE_TAIL_BREACH_MS) -> dict:
     events = load_events(directory)
     tasks = group_tasks(events)
     records = [reconstruct(evs) for evs in tasks.values()]
@@ -254,6 +264,23 @@ def summarize(directory: Path) -> dict:
             "p99": round(percentile(e2e, 0.99), 1)}
         summary["swap_ms_total"] = round(
             sum(r["swap_ms"] for r in complete), 1)
+    # receiver-backlog attribution (ISSUE 8 satellite; SCALING finding 2):
+    # hop inversions co-occurring with a claim-wire tail breach are the
+    # receive queue draining late, not a propagation bug — label them so
+    # downstream SLO artifacts read the signal correctly
+    if summary["hop_violations"]:
+        wire_p99 = (summary.get("fleet_phases_ms", {})
+                    .get("wire", {}).get("p99"))
+        backlog = wire_p99 is not None and wire_p99 >= wire_tail_ms
+        summary["hop_violations_indicator"] = (
+            "receiver_backlog" if backlog else "unexplained")
+        summary["hop_violations_note"] = (
+            f"co-occurs with dispatch->claim wire p99 {wire_p99} ms >= "
+            f"{wire_tail_ms} ms: inversions are the receiver's inbound "
+            "queue draining late (SCALING.md finding 2), not a "
+            "propagation bug" if backlog else
+            "no claim-wire tail breach in this window: inversions are "
+            "NOT explained by receiver backlog — investigate propagation")
     summary["tasks"] = records
     return summary
 
@@ -270,7 +297,9 @@ def render(summary: dict) -> str:
                f"  coverage {'-' if cov is None else f'{cov:.1%}'}"
                f"  pending {summary['pending']}"
                f"  orphans {summary['orphans']}"
-               f"  hop-violations {summary['hop_violations']}")
+               f"  hop-violations {summary['hop_violations']}"
+               + (f" ({summary['hop_violations_indicator']})"
+                  if "hop_violations_indicator" in summary else ""))
     if "fleet_phases_ms" in summary:
         out.append(f"  end-to-end ms  p50 {summary['end_to_end_ms']['p50']}"
                    f"  p95 {summary['end_to_end_ms']['p95']}"
@@ -307,11 +336,15 @@ def main(argv=None) -> int:
                     help="one shot (default: refresh every --interval)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--wire-tail-ms", type=float,
+                    default=WIRE_TAIL_BREACH_MS,
+                    help="claim-wire p99 above which hop inversions are "
+                         "labeled receiver_backlog (SCALING finding 2)")
     args = ap.parse_args(argv)
 
     directory = Path(args.dir)
     while True:
-        summary = summarize(directory)
+        summary = summarize(directory, wire_tail_ms=args.wire_tail_ms)
         if args.as_json:
             print(json.dumps(summary))
         else:

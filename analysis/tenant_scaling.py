@@ -1,0 +1,386 @@
+#!/usr/bin/env python
+"""Tenants x agents throughput of ONE multi-tenant solverd (ISSUE 8).
+
+``analysis/solver_crossover.py`` measures one fleet against one solverd;
+this harness measures MANY fleets against one: N tenants — each a whole
+namespaced fleet (C++ centralized manager with ``JG_BUS_NS=t<i>``
+``--solver tpu`` on the packed wire + a wire-faithful SimAgentPool in
+its namespace) — share one busd pool and ONE solverd whose
+device-resident state batches every tenant's lanes into a single
+[T, L] super-batch (runtime/solverd.py TenantSlab).
+
+Per variant the harness reports, from the fleets' own ``mapd.metrics``
+beacons (window-exact counter deltas, no harness instrumentation):
+
+- per-tenant tasks/s + completion ratio
+  (``manager.tasks_dispatched`` / ``manager.tasks_completed``);
+- aggregate tasks/s across tenants — the "N fleets per chip" headline;
+- solverd ms/tick-per-superbatch (its ``tick_ms`` histogram: one tick =
+  one vmapped step answering every tenant that asked that burst) and
+  ``solverd.superbatch_lanes``/``solverd.tenants``.
+
+The committed artifact (``results/tenant_scaling_r10.json``) runs the
+single-tenant BASELINE first, then the multi-tenant rung, and embeds
+the acceptance checks: aggregate tasks/s >= 4x the single tenant's and
+min per-tenant completion ratio >= the baseline's.
+
+Usage:
+  python analysis/tenant_scaling.py --tenants 8 --agents 6 \\
+      --out results/tenant_scaling_r10.json
+  python analysis/tenant_scaling.py --smoke      # the CI gate (fast)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from p2p_distributed_tswap_tpu.obs.registry import hist_quantile  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import busns  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import buspool  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402,E501
+from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
+    BUILD_DIR, ensure_built, wait_for_log)
+from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool  # noqa: E402,E501
+
+
+def _counter(m, name):
+    total = 0.0
+    for key, v in (m.get("counters") or {}).items():
+        if key == name or key.startswith(name + "{"):
+            total += v
+    return total
+
+
+def _hist_delta(first, last, name):
+    h0 = (first.get("hists") or {}).get(name)
+    h1 = (last.get("hists") or {}).get(name)
+    if h1 is None:
+        return None
+    if h0 is None:
+        h0 = {"buckets": h1["buckets"], "counts": [0] * len(h1["counts"]),
+              "sum": 0.0, "count": 0}
+    counts = [b - a for a, b in zip(h0["counts"], h1["counts"])]
+    return {"buckets": h1["buckets"], "counts": counts,
+            "sum": h1["sum"] - h0["sum"], "count": h1["count"] - h0["count"]}
+
+
+class TenantWatch:
+    """Beacon windows per (tenant ns, proc) over one un-namespaced
+    client: tenant managers beacon on ``<ns>:mapd.metrics`` (their
+    namespaced wire), solverd on the raw ``mapd.metrics``."""
+
+    def __init__(self, port: int, tenants):
+        self.bus = BusClient(port=port, peer_id="tenantwatch")
+        self.bus.subscribe("mapd.metrics")
+        for ns in tenants:
+            self.bus.subscribe(busns.wire_topic(ns, "mapd.metrics"),
+                               raw=True)
+        self.samples = {}  # (ns, proc) -> [(mono_t, metrics)]
+
+    def pump(self, budget_s: float) -> None:
+        end = time.monotonic() + budget_s
+        while True:
+            now = time.monotonic()
+            if now >= end:
+                return
+            f = self.bus.recv(timeout=min(0.2, end - now))
+            if not f or f.get("op") != "msg":
+                continue
+            d = f.get("data") or {}
+            if d.get("type") != "metrics_beacon":
+                continue
+            ns, _ = busns.split_ns(f.get("topic") or "")
+            self.samples.setdefault((ns, d.get("proc")), []).append(
+                (time.monotonic(), d.get("metrics") or {}))
+
+    def reset(self) -> None:
+        self.samples.clear()
+
+    def window(self, ns: str, proc: str):
+        s = self.samples.get((ns, proc)) or []
+        if len(s) < 2:
+            return None
+        return s[0], s[-1]
+
+    def close(self) -> None:
+        self.bus.close()
+
+
+def run_variant(args, n_tenants: int) -> dict:
+    """One measured rung: ``n_tenants`` namespaced fleets on one busd
+    pool + ONE multi-tenant solverd."""
+    tenants = [f"t{i}" for i in range(n_tenants)]
+    port = buspool.free_port()
+    procs, logs = [], []
+    log_dir = Path(args.log_dir) / f"tenants{n_tenants}"
+    log_dir.mkdir(parents=True, exist_ok=True)
+
+    def spawn(name, cmd, stdin=None, env=None):
+        log = open(log_dir / f"{name}.log", "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, stdin=stdin, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             env=dict(os.environ, **(env or {})))
+        procs.append(p)
+        return p
+
+    pool = watch = None
+    pools = {}
+    try:
+        pool = buspool.BusPool(BUILD_DIR / "mapd_bus",
+                               num_shards=args.shards, home_port=port,
+                               spawn=spawn)
+        os.environ.update(pool.env())
+        time.sleep(0.3)
+        sd = spawn("solverd",
+                   [sys.executable, "-m",
+                    "p2p_distributed_tswap_tpu.runtime.solverd",
+                    "--port", str(port), "--map", args.map_file, "--cpu",
+                    "--tenants", ",".join(tenants),
+                    "--max-tenants", str(max(n_tenants, 1))])
+        if not wait_for_log(log_dir / "solverd.log", "solverd up", 600,
+                            proc=sd):
+            raise RuntimeError("solverd never became ready")
+        mgrs = {}
+        for ns in tenants:
+            mgrs[ns] = spawn(
+                f"manager_{ns}",
+                [str(BUILD_DIR / "mapd_manager_centralized"),
+                 "--port", str(port), "--map", args.map_file,
+                 "--solver", "tpu",
+                 "--max-tracked-agents", str(args.agents + 8)],
+                stdin=subprocess.PIPE, env={"JG_BUS_NS": ns})
+        time.sleep(0.5)
+        for i, ns in enumerate(tenants):
+            pools[ns] = SimAgentPool(args.agents, args.side, port=port,
+                                     seed=i + 1, peer_id=f"sim-{ns}",
+                                     namespace=ns)
+        watch = TenantWatch(port, tenants)
+
+        def pump_all(budget_s: float) -> None:
+            end = time.monotonic() + budget_s
+            while time.monotonic() < end:
+                for p in pools.values():
+                    p.pump(0.05)
+                watch.pump(0.02)
+
+        for p in pools.values():
+            p.heartbeat_all()
+        pump_all(2.0)
+        for m in mgrs.values():
+            m.stdin.write(f"tasks {args.agents}\n".encode())
+            m.stdin.flush()
+        pump_all(args.settle)
+        watch.reset()
+        done0 = {ns: p.done_count for ns, p in pools.items()}
+        t0 = time.monotonic()
+        pump_all(args.window)
+        wall = time.monotonic() - t0
+        pump_all(2.5)  # one more beacon interval: final counters land
+
+        per_tenant = {}
+        for ns in tenants:
+            win = watch.window(ns, "manager_centralized")
+            row = {"sim_done_in_window": pools[ns].done_count - done0[ns],
+                   "sim": pools[ns].stats()}
+            if win is not None:
+                (ft, first), (lt, last) = win
+                span = max(lt - ft, 1e-9)
+                disp = _counter(last, "manager.tasks_dispatched") \
+                    - _counter(first, "manager.tasks_dispatched")
+                done = _counter(last, "manager.tasks_completed") \
+                    - _counter(first, "manager.tasks_completed")
+                row.update({
+                    "tasks_dispatched": int(disp),
+                    "tasks_completed": int(done),
+                    "tasks_per_s": round(done / span, 3),
+                    "completion_ratio": round(min(1.0, done / disp), 4)
+                    if disp > 0 else (1.0 if done > 0 else None),
+                    "beacon_span_s": round(span, 1),
+                })
+            per_tenant[ns] = row
+        rates = [r["tasks_per_s"] for r in per_tenant.values()
+                 if r.get("tasks_per_s") is not None]
+        ratios = [r["completion_ratio"] for r in per_tenant.values()
+                  if r.get("completion_ratio") is not None]
+        variant = {
+            "tenants": n_tenants,
+            "agents_per_tenant": args.agents,
+            "total_agents": n_tenants * args.agents,
+            "window_s": round(wall, 1),
+            "per_tenant": per_tenant,
+            "aggregate_tasks_per_s": round(sum(rates), 3) if rates else None,
+            "min_tenant_tasks_per_s": round(min(rates), 3)
+            if rates else None,
+            "min_completion_ratio": round(min(ratios), 4)
+            if ratios else None,
+        }
+        sd_win = watch.window("", "solverd")
+        if sd_win is not None:
+            (ft, first), (lt, last) = sd_win
+            tick = _hist_delta(first, last, "tick_ms")
+            sd = {"superbatch_ticks": int(tick["count"]) if tick else 0}
+            if tick and tick["count"] > 0:
+                sd["ms_per_superbatch_p50"] = round(
+                    hist_quantile(tick, 0.5), 2)
+                sd["ms_per_superbatch_p95"] = round(
+                    hist_quantile(tick, 0.95), 2)
+            g = (last.get("gauges") or {})
+            for k in ("solverd.tenants", "solverd.superbatch_lanes",
+                      "solverd.superbatch_tenants", "solverd.slab_lanes"):
+                if k in g:
+                    sd[k.split(".", 1)[1]] = g[k]
+            for k in ("solverd.tenant_admissions",
+                      "solverd.tenant_evictions",
+                      "solverd.tenant_resyncs", "solverd.seq_gaps"):
+                v = _counter(last, k)
+                if v:
+                    sd[k.split(".", 1)[1]] = int(v)
+            variant["solverd"] = sd
+        return variant
+    finally:
+        for p in pools.values():
+            p.close()
+        if watch is not None:
+            watch.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if pool is not None:
+            pool.close()
+        for log in logs:
+            log.close()
+        os.environ.pop(buspool.SHARD_PORTS_ENV, None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tenants", type=int, default=8)
+    ap.add_argument("--agents", type=int, default=6,
+                    help="agents per tenant (the 'most scenarios are "
+                         "small' regime)")
+    ap.add_argument("--side", type=int, default=32)
+    ap.add_argument("--shards", type=int,
+                    default=int(os.environ.get("JG_BUS_SHARDS", "1") or 1))
+    ap.add_argument("--window", type=float, default=30.0)
+    ap.add_argument("--settle", type=float, default=20.0)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the single-tenant baseline variant")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--log-dir", default="/tmp/tenant_scaling_logs")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: 2 tenants, short windows; asserts "
+                         "both tenants complete tasks on one solverd "
+                         "with zero cross-tenant resyncs/evictions")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.tenants, args.agents, args.side = 2, 4, 24
+        args.window, args.settle = 10.0, 8.0
+        args.no_baseline = True
+    ensure_built()
+    args.map_file = f"/tmp/tenant_scaling_{args.side}.map.txt"
+    Path(args.map_file).write_text(
+        "\n".join(["." * args.side] * args.side) + "\n")
+
+    variants = []
+    if not args.no_baseline:
+        print("tenant_scaling: single-tenant baseline", flush=True)
+        variants.append(run_variant(args, 1))
+        print(json.dumps(variants[-1]), flush=True)
+    print(f"tenant_scaling: {args.tenants} tenants", flush=True)
+    variants.append(run_variant(args, args.tenants))
+    print(json.dumps(variants[-1]), flush=True)
+
+    multi = variants[-1]
+    base = variants[0] if len(variants) > 1 else None
+    accept = {}
+    if base is not None:
+        base_rate = base.get("aggregate_tasks_per_s") or 0.0
+        base_ratio = base.get("min_completion_ratio")
+        agg = multi.get("aggregate_tasks_per_s") or 0.0
+        accept = {
+            "single_tenant_tasks_per_s": base_rate,
+            "aggregate_tasks_per_s": agg,
+            "speedup_vs_single": round(agg / base_rate, 2)
+            if base_rate else None,
+            "aggregate_ge_4x_single": bool(base_rate
+                                           and agg >= 4.0 * base_rate),
+            "single_tenant_completion_ratio": base_ratio,
+            "min_tenant_completion_ratio": multi.get(
+                "min_completion_ratio"),
+            "per_tenant_completion_ge_baseline": bool(
+                base_ratio is not None
+                and multi.get("min_completion_ratio") is not None
+                and multi["min_completion_ratio"] >= base_ratio),
+        }
+    doc = {
+        "experiment": "tenants x agents throughput of one multi-tenant "
+                      "solverd (namespaced fleets, shared device "
+                      "super-batch)",
+        "map": f"{args.side}x{args.side} empty",
+        "solverd_backend": "cpu",
+        "note": "each tenant = C++ centralized manager (JG_BUS_NS, "
+                "--solver tpu, packed wire) + wire-faithful sim pool in "
+                "its namespace; ONE solverd plans every tenant per tick "
+                "via a [T,L] vmapped super-batch with a shared "
+                "direction-field cache.",
+        "variants": variants,
+        "acceptance": accept,
+    }
+    print(json.dumps({"acceptance": accept}), flush=True)
+    if args.out:
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(doc, indent=2) + "\n")
+        md = ["# tenant_scaling — N fleets per chip", "",
+              "| variant | tenants | agents | aggregate tasks/s | "
+              "min tenant tasks/s | min completion | solverd ms/superbatch "
+              "p50 |", "|---|---|---|---|---|---|---|"]
+        for v in variants:
+            sd = v.get("solverd") or {}
+            md.append(
+                f"| {'baseline' if v['tenants'] == 1 else 'multi'} "
+                f"| {v['tenants']} | {v['total_agents']} "
+                f"| {v.get('aggregate_tasks_per_s')} "
+                f"| {v.get('min_tenant_tasks_per_s')} "
+                f"| {v.get('min_completion_ratio')} "
+                f"| {sd.get('ms_per_superbatch_p50')} |")
+        if accept:
+            md += ["",
+                   f"- aggregate vs single-tenant: "
+                   f"**{accept.get('speedup_vs_single')}x** "
+                   f"(>=4x: {accept.get('aggregate_ge_4x_single')})",
+                   f"- min per-tenant completion ratio "
+                   f"{accept.get('min_tenant_completion_ratio')} vs "
+                   f"baseline "
+                   f"{accept.get('single_tenant_completion_ratio')} "
+                   f"(>=: "
+                   f"{accept.get('per_tenant_completion_ge_baseline')})"]
+        out.with_name(out.name + ".md").write_text("\n".join(md) + "\n")
+    if args.smoke:
+        sd = multi.get("solverd") or {}
+        ok = all((r.get("tasks_completed") or 0) >= 1
+                 for r in multi["per_tenant"].values()) \
+            and sd.get("tenants") == 2 \
+            and not sd.get("tenant_evictions") \
+            and not sd.get("seq_gaps")
+        print(f"tenant smoke {'OK' if ok else 'FAILED'}: "
+              f"{json.dumps(multi['per_tenant'])}", flush=True)
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

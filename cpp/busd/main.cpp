@@ -128,8 +128,11 @@ void handle_stop(int) { g_stop = 1; }
 
 // Position beacons, metrics beacons, and per-decision path-metric
 // samples are periodic/sampled streams a consumer can afford to lose —
-// the only frames the slow-consumer policy may shed.
-bool droppable_topic(const std::string& topic) {
+// the only frames the slow-consumer policy may shed.  Classified by the
+// LOGICAL topic (shardmap::strip_ns): a tenant's beacons shed like the
+// un-namespaced fleet's (ISSUE 8) — busd stays otherwise topic-opaque.
+bool droppable_topic(const std::string& wire_topic) {
+  const std::string topic = shardmap::strip_ns(wire_topic);
   return topic.compare(0, strlen(kPosTopicPrefix), kPosTopicPrefix) == 0 ||
          topic == "mapd.metrics" || topic == "mapd.path";
 }

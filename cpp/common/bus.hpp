@@ -90,9 +90,27 @@ inline bool fastframe_enabled() {
 // Control-plane topics are everything busd itself refuses to shed under
 // backpressure: not position beacons, not metrics, not path samples.
 // These are the frames the replay outbox preserves across an outage.
+// Judged on the LOGICAL topic — a tenant's beacons shed like anyone's.
 inline bool bus_control_topic(const std::string& topic) {
-  return topic.compare(0, 9, "mapd.pos.") != 0 &&
-         topic != "mapd.metrics" && topic != "mapd.path";
+  const std::string logical = shardmap::strip_ns(topic);
+  return logical.compare(0, 9, "mapd.pos.") != 0 &&
+         logical != "mapd.metrics" && logical != "mapd.path";
+}
+
+// Tenant namespace (ISSUE 8, runtime/busns.py mirror): JG_BUS_NS
+// prefixes every logical topic "<ns>:" on the wire; empty = the
+// byte-identical legacy wire.  Separators that would corrupt framing
+// are fatal — a half-applied namespace must never leak cross-tenant.
+inline std::string bus_namespace_from_env() {
+  const char* v = getenv("JG_BUS_NS");
+  std::string ns = v ? v : "";
+  if (ns.find(':') != std::string::npos ||
+      ns.find(' ') != std::string::npos ||
+      ns.find('\n') != std::string::npos) {
+    fprintf(stderr, "bus: invalid JG_BUS_NS \"%s\"\n", ns.c_str());
+    exit(2);
+  }
+  return ns;
 }
 
 // Random peer id, shaped like a libp2p PeerId for log familiarity.
@@ -123,6 +141,8 @@ class BusClient {
                const std::string& peer_id) {
     host_ = host;
     peer_id_ = peer_id;
+    ns_ = bus_namespace_from_env();
+    ns_prefix_ = ns_.empty() ? "" : ns_ + ":";
     auto ports = shardmap::shard_ports_from_env(port);
     links_.clear();
     links_.resize(ports.size());
@@ -192,25 +212,33 @@ class BusClient {
     next_beacon_ms_ = 0;  // first pump publishes immediately
   }
 
-  void subscribe(const std::string& topic) {
-    for (int s : shardmap::shards_for_subscription(topic, n_)) {
+  // The on-the-wire topic: namespaced unless `raw` (cross-tenant
+  // infrastructure addressing wire topics directly).
+  std::string wire_topic(const std::string& topic, bool raw = false) const {
+    return (raw || ns_prefix_.empty()) ? topic : ns_prefix_ + topic;
+  }
+
+  void subscribe(const std::string& topic, bool raw = false) {
+    const std::string wt = wire_topic(topic, raw);
+    for (int s : shardmap::shards_for_subscription(wt, n_)) {
       Link& l = ensure_link(s);
-      l.topics.insert(topic);
+      l.topics.insert(wt);
       if (l.conn.valid()) {
         Json j;
-        j.set("op", "sub").set("topic", topic);
+        j.set("op", "sub").set("topic", wt);
         l.conn.send_line(j.dump());
       }
     }
   }
 
-  void unsubscribe(const std::string& topic) {
-    for (int s : shardmap::shards_for_subscription(topic, n_)) {
+  void unsubscribe(const std::string& topic, bool raw = false) {
+    const std::string wt = wire_topic(topic, raw);
+    for (int s : shardmap::shards_for_subscription(wt, n_)) {
       Link& l = links_[static_cast<size_t>(s)];
-      l.topics.erase(topic);
+      l.topics.erase(wt);
       if (l.conn.valid()) {
         Json j;
-        j.set("op", "unsub").set("topic", topic);
+        j.set("op", "unsub").set("topic", wt);
         l.conn.send_line(j.dump());
       }
     }
@@ -221,22 +249,24 @@ class BusClient {
   // (Per-link state in a pool; this reports the home shard.)
   bool fast_hub() const { return home().fast_hub; }
 
-  void publish(const std::string& topic, const Json& data) {
-    Link& l = ensure_link(shardmap::shard_of(topic, n_));
+  void publish(const std::string& topic, const Json& data,
+               bool raw = false) {
+    const std::string wt = wire_topic(topic, raw);
+    Link& l = ensure_link(shardmap::shard_of(wt, n_));
     if (!l.conn.valid()) {
       // disconnected: the drop is COUNTED, and control-plane frames ride
       // the bounded replay outbox for the shard's return
       metrics_count("bus.pub_dropped_disconnected", 1,
-                    "topic=\"" + topic + "\"");
-      outbox_maybe(topic, data.dump());
+                    "topic=\"" + wt + "\"");
+      outbox_maybe(wt, data.dump());
       return;
     }
-    publish_on(l, topic, data.dump());
+    publish_on(l, wt, data.dump());
   }
 
-  void query_peers(const std::string& topic) {
+  void query_peers(const std::string& topic, bool raw = false) {
     Json j;
-    j.set("op", "peers").set("topic", topic);
+    j.set("op", "peers").set("topic", wire_topic(topic, raw));
     send_control(j);
   }
 
@@ -310,6 +340,8 @@ class BusClient {
     // rides only on a real pool — the single-hub hello (and the
     // JG_BUS_SHARDS=1 kill switch) stays byte-identical.
     if (n_ > 1) caps.push_back(Json("shard1"));
+    // namespaced tenant client (ISSUE 8); absent = legacy wire
+    if (!ns_.empty()) caps.push_back(Json("ns1"));
     if (!caps.is_null()) hello.set("caps", caps);
     l.conn.send_line(hello.dump());
   }
@@ -386,6 +418,16 @@ class BusClient {
     outbox_ = std::move(keep);
   }
 
+  // Strip THIS client's namespace off a delivered wire topic, so role
+  // code sees the logical topic it subscribed (un-namespaced clients —
+  // e.g. cross-tenant infrastructure — see wire topics verbatim).
+  std::string deliver_topic(const std::string& topic) const {
+    if (!ns_prefix_.empty() &&
+        topic.compare(0, ns_prefix_.size(), ns_prefix_) == 0)
+      return topic.substr(ns_prefix_.size());
+    return topic;
+  }
+
   void handle_line(Link& l, const std::string& line,
                    const std::function<void(const Msg&)>& on_msg,
                    const std::function<void(const Json&)>& on_event) {
@@ -403,7 +445,8 @@ class BusClient {
                     static_cast<double>(line.size() + 1),
                     "topic=\"" + topic + "\"");
       if (on_msg)
-        on_msg(Msg{topic, line.substr(s1 + 1, s2 - s1 - 1), *data});
+        on_msg(Msg{deliver_topic(topic), line.substr(s1 + 1, s2 - s1 - 1),
+                   *data});
       return;
     }
     auto parsed = Json::parse(line);
@@ -417,7 +460,8 @@ class BusClient {
       metrics_count("bus.bytes_received",
                     static_cast<double>(line.size() + 1),
                     "topic=\"" + topic + "\"");
-      if (on_msg) on_msg(Msg{topic, j["from"].as_str(), j["data"]});
+      if (on_msg)
+        on_msg(Msg{deliver_topic(topic), j["from"].as_str(), j["data"]});
     } else {
       if (op == "welcome") {
         // caps negotiation: switch publishes to the fast framing only
@@ -505,6 +549,8 @@ class BusClient {
   int n_ = 1;
   std::string peer_id_;
   std::string host_;
+  std::string ns_;         // tenant namespace (JG_BUS_NS; empty = legacy)
+  std::string ns_prefix_;  // "<ns>:" or ""
   bool reconnect_ = false;
   std::function<void()> on_reconnect_;
   std::deque<std::pair<std::string, std::string>> outbox_;

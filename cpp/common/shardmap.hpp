@@ -32,6 +32,18 @@ namespace shardmap {
 constexpr int kHomeShard = 0;
 constexpr const char* kShardPortsEnv = "JG_BUS_SHARD_PORTS";
 
+// Tenant namespaces (ISSUE 8, runtime/busns.py): a namespaced wire
+// topic "<ns>:<topic>" is CLASSIFIED by its logical topic — region
+// spread, span wildcards and droppable-beacon shedding are per-tenant
+// identical to the un-namespaced fleet — while the FNV fallback hashes
+// the full wire topic (choice-identical to the Python mirror).
+inline std::string strip_ns(const std::string& topic) {
+  const size_t colon = topic.find(':');
+  if (colon == std::string::npos || colon == 0) return topic;
+  if (topic.find(' ') < colon) return topic;  // not a namespace prefix
+  return topic.substr(colon + 1);
+}
+
 inline uint32_t fnv1a32(const std::string& s) {
   uint32_t h = 2166136261u;
   for (unsigned char b : s) {
@@ -51,10 +63,11 @@ inline bool all_digits(const std::string& s) {
 // The single owning shard of `topic` in an `num_shards` pool.
 inline int shard_of(const std::string& topic, int num_shards) {
   if (num_shards <= 1) return kHomeShard;
+  const std::string logical = strip_ns(topic);
   const size_t plen = strlen(kPosTopicPrefix);
-  if (topic.compare(0, plen, kPosTopicPrefix) == 0 &&
-      (topic.empty() || topic.back() != '*')) {
-    const std::string suffix = topic.substr(plen);
+  if (logical.compare(0, plen, kPosTopicPrefix) == 0 &&
+      (logical.empty() || logical.back() != '*')) {
+    const std::string suffix = logical.substr(plen);
     const size_t dot = suffix.find('.');
     if (dot != std::string::npos && all_digits(suffix.substr(0, dot)) &&
         all_digits(suffix.substr(dot + 1))) {
@@ -74,7 +87,8 @@ inline std::vector<int> shards_for_subscription(const std::string& topic,
   if (num_shards <= 1) return {kHomeShard};
   if (topic.size() >= 2 &&
       topic.compare(topic.size() - 2, 2, ".*") == 0) {
-    const std::string prefix = topic.substr(0, topic.size() - 1);
+    const std::string logical = strip_ns(topic);
+    const std::string prefix = logical.substr(0, logical.size() - 1);
     const std::string pos_prefix = kPosTopicPrefix;
     const bool spans =
         prefix.compare(0, pos_prefix.size(), pos_prefix) == 0 ||

@@ -28,6 +28,15 @@ its regions, not the fleet.  With a single port (the default and the
 ``JG_BUS_SHARDS=1`` kill switch) the wire is byte-identical to the
 pre-pool client.
 
+Tenant namespace (ISSUE 8): with ``JG_BUS_NS=<tenant>`` (or a
+``namespace=`` arg) every logical topic is prefixed ``<tenant>:`` on
+the wire and stripped on delivery (runtime/busns.py), so whole fleets
+share one busd pool without cross-talk while their role code stays
+tenant-agnostic; the hello advertises ``caps:["ns1"]``.  Cross-tenant
+infrastructure (solverd serving many fleets) passes ``raw=True`` to
+``subscribe``/``publish`` to address wire topics directly.  With no
+namespace the wire is byte-identical to the pre-namespace client.
+
 Like the C++ client, it can survive a bus restart: with ``reconnect=True``
 a dropped connection is retried with exponential backoff (0.25 s .. 4 s);
 on success the client re-sends hello, re-subscribes every topic, and calls
@@ -64,15 +73,17 @@ from typing import Callable, Iterator, List, Optional
 
 from p2p_distributed_tswap_tpu.obs import registry as _reg
 from p2p_distributed_tswap_tpu.obs import trace
-from p2p_distributed_tswap_tpu.runtime import shardmap
+from p2p_distributed_tswap_tpu.runtime import busns, shardmap
 
 # Topics busd's slow-consumer policy may shed (droppable streams) — the
-# complement is the control plane the replay outbox preserves.
+# complement is the control plane the replay outbox preserves.  Judged
+# on the LOGICAL topic: a tenant's beacons shed like anyone else's.
 _DROPPABLE_PREFIX = "mapd.pos."
 _DROPPABLE_TOPICS = ("mapd.metrics", "mapd.path")
 
 
 def _is_control_topic(topic: str) -> bool:
+    topic = busns.strip_ns(topic)
     return not (topic.startswith(_DROPPABLE_PREFIX)
                 or topic in _DROPPABLE_TOPICS)
 
@@ -104,11 +115,16 @@ class BusClient:
                  on_reconnect: Optional[Callable[[], None]] = None,
                  registry: Optional[_reg.Registry] = None,
                  fastframe: Optional[bool] = None,
-                 shard_ports: Optional[List[int]] = None):
+                 shard_ports: Optional[List[int]] = None,
+                 namespace: Optional[str] = None):
         self.peer_id = peer_id or f"py-{int(time.time() * 1000) % 10 ** 10}"
         self._host, self._timeout = host, timeout
         self._reconnect = reconnect
         self._on_reconnect = on_reconnect
+        # tenant namespace: explicit arg beats JG_BUS_NS beats none
+        self._ns = (busns.validate(namespace) if namespace is not None
+                    else busns.namespace_from_env())
+        self._ns_prefix = busns.wire_topic(self._ns, "") if self._ns else ""
         # relay fast framing: advertised in hello, armed by the hub's
         # welcome (see module docstring); None = the JG_BUS_FASTFRAME env
         self._fastframe = (os.environ.get("JG_BUS_FASTFRAME", "1")
@@ -186,6 +202,9 @@ class BusClient:
         # JG_BUS_SHARDS=1 kill switch) stays byte-identical.
         if self._n > 1:
             caps.append("shard1")
+        if self._ns:
+            # namespaced tenant client (ISSUE 8); absent = legacy wire
+            caps.append("ns1")
         if caps:
             hello["caps"] = caps
         self._send_raw(link, hello)
@@ -289,13 +308,20 @@ class BusClient:
         except OSError:
             self._drop(link)
 
-    def subscribe(self, topic: str) -> None:
+    def _wire(self, topic: str, raw: bool) -> str:
+        """The on-the-wire topic: namespaced unless ``raw`` (cross-tenant
+        infrastructure addressing wire topics directly)."""
+        return topic if raw else busns.wire_topic(self._ns, topic)
+
+    def subscribe(self, topic: str, raw: bool = False) -> None:
+        topic = self._wire(topic, raw)
         for s in shardmap.shards_for_subscription(topic, self._n):
             link = self._ensure_link(s)
             link.topics.add(topic)
             self._send(link, {"op": "sub", "topic": topic})
 
-    def unsubscribe(self, topic: str) -> None:
+    def unsubscribe(self, topic: str, raw: bool = False) -> None:
+        topic = self._wire(topic, raw)
         for s in shardmap.shards_for_subscription(topic, self._n):
             link = self._links[s]
             link.topics.discard(topic)
@@ -328,7 +354,8 @@ class BusClient:
             self.registry.count("bus.outbox_overflow")
         self._outbox.append((topic, data))
 
-    def publish(self, topic: str, data: dict) -> None:
+    def publish(self, topic: str, data: dict, raw: bool = False) -> None:
+        topic = self._wire(topic, raw)
         link = self._ensure_link(shardmap.shard_of(topic, self._n))
         if link.sock is None:
             self._try_reconnect(link)
@@ -341,11 +368,20 @@ class BusClient:
             return
         self._publish_on(link, topic, data)
 
-    def query_peers(self, topic: str) -> None:
+    def query_peers(self, topic: str, raw: bool = False) -> None:
         self._send(self._links[shardmap.HOME_SHARD],
-                   {"op": "peers", "topic": topic})
+                   {"op": "peers", "topic": self._wire(topic, raw)})
 
     # -- receive ----------------------------------------------------------
+    def _deliver_topic(self, topic: str) -> str:
+        """Strip THIS client's namespace off a delivered wire topic, so
+        consumers see the logical topic they subscribed (an un-namespaced
+        client — e.g. solverd serving many tenants — sees wire topics
+        verbatim)."""
+        if self._ns_prefix and topic.startswith(self._ns_prefix):
+            return topic[len(self._ns_prefix):]
+        return topic
+
     def _parse_line(self, link: _Link, line: bytes) -> Optional[dict]:
         """One framed line -> normalized frame dict, or None to skip."""
         if line[:1] == b"M":
@@ -361,7 +397,7 @@ class BusClient:
             self.registry.count("bus.msgs_received", topic=topic)
             self.registry.count("bus.bytes_received", len(line) + 1,
                                 topic=topic)
-            return {"op": "msg", "topic": topic,
+            return {"op": "msg", "topic": self._deliver_topic(topic),
                     "from": sender.decode(errors="replace"),
                     "data": data}
         try:
@@ -374,6 +410,7 @@ class BusClient:
             self.registry.count("bus.msgs_received", topic=topic)
             self.registry.count("bus.bytes_received", len(line) + 1,
                                 topic=topic)
+            frame["topic"] = self._deliver_topic(topic)
         elif frame.get("op") == "welcome":
             # caps negotiation: switch publishes to fast framing only
             # when the hub advertises it (old hub -> legacy), per link

@@ -15,6 +15,7 @@ flags, no pool env) — the ``JG_BUS_SHARDS=1`` kill switch end to end.
 
 from __future__ import annotations
 
+import os
 import socket
 import subprocess
 import time
@@ -22,6 +23,22 @@ from pathlib import Path
 from typing import Callable, List, Optional, Sequence
 
 SHARD_PORTS_ENV = "JG_BUS_SHARD_PORTS"
+
+
+def parse_cpu_affinity(spec) -> Optional[List[int]]:
+    """A ``--cpu-affinity`` spec -> ordered CPU id list: "0,1,2" pins
+    shard i to cpu ``list[i % len]``; "auto" spreads across every CPU
+    this process may use; None/'' disables pinning."""
+    if spec is None or spec == "":
+        return None
+    if spec == "auto":
+        if not hasattr(os, "sched_getaffinity"):  # non-Linux: no pinning
+            return None
+        return sorted(os.sched_getaffinity(0))
+    cpus = [int(c) for c in str(spec).split(",") if str(c).strip()]
+    if not cpus:
+        raise ValueError(f"empty cpu affinity spec: {spec!r}")
+    return cpus
 
 
 def free_port() -> int:
@@ -75,11 +92,17 @@ class BusPool:
                  log_dir: Optional[Path] = None,
                  extra_args: Optional[Sequence[str]] = None,
                  spawn: Optional[Callable] = None,
-                 settle_s: float = 0.3):
+                 settle_s: float = 0.3,
+                 cpu_affinity=None):
         self.num_shards = num_shards
         self.ports = pool_ports(num_shards, home_port)
         self.procs: List[subprocess.Popen] = []
         self._logs: List = []
+        # per-shard CPU pinning (ROADMAP item 1 remaining headroom): on a
+        # many-core host the pool's shards contend less when each relay
+        # loop owns a core.  Spec: "0,1,2" (shard i -> cpu[i % len]),
+        # "auto" (spread over this process's allowed CPUs), None = off.
+        self.cpu_affinity = parse_cpu_affinity(cpu_affinity)
         for i, port in enumerate(self.ports):
             cmd = [str(binary), str(port),
                    *shard_args(i, num_shards, self.ports),
@@ -97,6 +120,15 @@ class BusPool:
             else:
                 proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
                                         stderr=subprocess.DEVNULL)
+            if self.cpu_affinity and hasattr(os, "sched_setaffinity"):
+                # post-spawn pinning is sufficient: busd is a single
+                # poll loop (no threads inherit a pre-pin mask)
+                cpu = self.cpu_affinity[i % len(self.cpu_affinity)]
+                try:
+                    os.sched_setaffinity(proc.pid, {cpu})
+                except OSError as e:  # bad cpu id / cgroup restriction
+                    print(f"⚠️  buspool: cannot pin shard {i} to cpu "
+                          f"{cpu}: {e}")
             self.procs.append(proc)
         time.sleep(settle_s)
 

@@ -96,7 +96,8 @@ class Fleet:
                  env: Optional[dict] = None,
                  config: Optional[RuntimeConfig] = None,
                  solverd_args: Optional[List[str]] = None,
-                 bus_shards: Optional[int] = None):
+                 bus_shards: Optional[int] = None,
+                 bus_cpu_affinity: Optional[str] = None):
         assert mode in ("centralized", "decentralized")
         build = ensure_built()
         self.procs: List[subprocess.Popen] = []
@@ -145,9 +146,15 @@ class Fleet:
         shards = int(bus_shards if bus_shards is not None
                      else (env or {}).get("JG_BUS_SHARDS")
                      or os.environ.get("JG_BUS_SHARDS", "1") or 1)
+        # optional per-shard CPU pinning (buspool.parse_cpu_affinity spec;
+        # JG_BUS_CPU_AFFINITY env for harnesses that configure via env)
+        affinity = (bus_cpu_affinity if bus_cpu_affinity is not None
+                    else (env or {}).get("JG_BUS_CPU_AFFINITY")
+                    or os.environ.get("JG_BUS_CPU_AFFINITY", ""))
         self.bus_pool = buspool.BusPool(
             build / "mapd_bus", num_shards=max(1, shards), home_port=port,
-            spawn=lambda name, cmd: spawn(name, cmd), settle_s=0.0)
+            spawn=lambda name, cmd: spawn(name, cmd), settle_s=0.0,
+            cpu_affinity=affinity)
         # THIS pool is the children's bus — a stale JG_BUS_SHARD_PORTS
         # inherited from the operator's shell (a previous manual pool)
         # must never leak into a fresh fleet

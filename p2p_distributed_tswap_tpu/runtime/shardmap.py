@@ -30,6 +30,13 @@ other wildcard stays on the home shard.
 
 ``JG_BUS_SHARDS=1`` (the default) is the kill switch: everything maps
 to shard 0 and both BusClients keep today's single-hub wire verbatim.
+
+Tenant namespaces (ISSUE 8): a namespaced wire topic ``<ns>:<topic>``
+(runtime/busns.py) is classified by its LOGICAL topic — a tenant's
+region beacons spread across the pool and its wildcards span shards
+exactly like the un-namespaced fleet's — while the FNV fallback hashes
+the full wire topic, so two tenants' odd-suffix position topics still
+land on (deterministically) independent shards.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ from __future__ import annotations
 import os
 from typing import List
 
+from p2p_distributed_tswap_tpu.runtime import busns
 from p2p_distributed_tswap_tpu.runtime.region import POS_TOPIC_PREFIX
 
 HOME_SHARD = 0
@@ -63,11 +71,15 @@ def _ascii_digits(s: str) -> bool:
 
 
 def shard_of(topic: str, num_shards: int) -> int:
-    """The single owning shard of ``topic`` in an ``num_shards`` pool."""
+    """The single owning shard of ``topic`` in an ``num_shards`` pool.
+    ``topic`` may be a namespaced wire topic (``<ns>:<topic>``): the
+    logical topic decides the class, the full wire topic feeds the FNV
+    fallback."""
     if num_shards <= 1:
         return HOME_SHARD
-    if topic.startswith(POS_TOPIC_PREFIX) and not topic.endswith("*"):
-        suffix = topic[len(POS_TOPIC_PREFIX):]
+    logical = busns.strip_ns(topic)
+    if logical.startswith(POS_TOPIC_PREFIX) and not logical.endswith("*"):
+        suffix = logical[len(POS_TOPIC_PREFIX):]
         rx, dot, ry = suffix.partition(".")
         if dot and _ascii_digits(rx) and _ascii_digits(ry):
             # the region math IS the shard map: deterministic from the
@@ -84,7 +96,7 @@ def shards_for_subscription(topic: str, num_shards: int) -> List[int]:
     if num_shards <= 1:
         return [HOME_SHARD]
     if topic.endswith(".*"):
-        prefix = topic[:-1]  # busd matches by this prefix
+        prefix = busns.strip_ns(topic)[:-1]  # busd matches by this prefix
         # a wildcard spans shards iff some "mapd.pos.…" topic can match
         # it: its prefix extends POS_TOPIC_PREFIX or is a prefix of it
         if prefix.startswith(POS_TOPIC_PREFIX) \

@@ -94,7 +94,8 @@ class SimAgentPool:
                  region_gossip: Optional[bool] = None,
                  region_cells: Optional[int] = None,
                  peer_id: str = "simfleet",
-                 echo_moves: bool = True):
+                 echo_moves: bool = True,
+                 namespace: Optional[str] = None):
         import numpy as np
 
         self.n = n
@@ -130,8 +131,10 @@ class SimAgentPool:
             # not a thundering herd of n beacons per interval edge
             a.next_hb = now + heartbeat_s * (k / max(1, n))
             self.agents[a.peer_id] = a
+        # namespace: this pool's whole fleet lives behind one bus tenant
+        # (ISSUE 8) — topics stay logical here, the client prefixes them
         self.bus = BusClient(host=host, port=port, peer_id=peer_id,
-                             reconnect=True)
+                             reconnect=True, namespace=namespace)
         self.bus.subscribe("mapd")
         # counters the harness reads after (or during) a run
         self.done_count = 0

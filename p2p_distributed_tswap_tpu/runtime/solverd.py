@@ -37,9 +37,25 @@ without blocking, the decode of request k+1 and the encode of response k
 overlap its execution, and the output fetch happens only when the response
 is actually due (dispatch-then-poll; ``solverd.pipeline_overlap_ms``).
 
+Multi-tenant mode (ISSUE 8): with ``--tenants ns0,ns1,...`` and/or
+``--multi-tenant`` ONE daemon serves many namespaced fleets
+(runtime/busns.py — each tenant's manager runs unmodified behind
+``JG_BUS_NS``).  Every admitted tenant owns one row of a [T, L]
+device-resident super-batch (pow2-padded on both axes); one jitted
+vmapped step plans every tenant per request burst, the direction-field
+cache is shared across tenants, and per-tenant packed-delta chains keep
+the O(churn) scatter.  Admission is budgeted (``--max-tenants``,
+``--tenant-lanes``): overflow evicts the least-recently-active tenant
+idle past ``--tenant-idle-ms``, and re-admission snapshot-resyncs
+through the existing ``plan_snapshot_request`` path (lossless — the
+manager is the system of record).  Multi-tenant mode is packed-wire
+only.  See TenantSlab/MultiTenantRunner below;
+``analysis/tenant_scaling.py`` is the measurement harness.
+
 Usage: python -m p2p_distributed_tswap_tpu.runtime.solverd
            [--port 7400] [--map FILE] [--capacity-min 16] [--warm N]
-           [--trace]
+           [--trace] [--tenants t0,t1] [--multi-tenant]
+           [--max-tenants N] [--tenant-lanes N] [--tenant-idle-ms MS]
 
 Observability (obs/): with ``JG_TRACE=1`` (or ``--trace``) every tick is
 traced phase-by-phase (decode -> cache lookup -> field sweep -> step
@@ -89,9 +105,20 @@ from p2p_distributed_tswap_tpu.ops.distance import (
     pack_directions,
     packed_cells,
 )
+from p2p_distributed_tswap_tpu.runtime import busns
 from p2p_distributed_tswap_tpu.runtime import plan_codec as pcodec
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
 from p2p_distributed_tswap_tpu.solver.step import step_parallel
+
+# Dynamic tenant admission rides this un-namespaced control topic
+# (ISSUE 8): {"type":"tenant_hello","ns":X} subscribes + admits tenant X,
+# answered with {"type":"tenant_welcome","ns":X}.  The hello must come
+# from an UN-NAMESPACED client (an orchestrator/operator tool, like the
+# tenant_scaling harness's watcher) — a fleet behind JG_BUS_NS prefixes
+# everything it publishes and cannot reach this topic itself; whoever
+# spawns tenant fleets announces them.  Static `--tenants` lists skip
+# the dance entirely.
+ADMIT_TOPIC = "solver.admit"
 
 
 def _donation_ok() -> bool:
@@ -110,6 +137,24 @@ def _donation_ok() -> bool:
         return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
     except RuntimeError:
         return False
+
+
+def _pad_pow2_chunk(min_chunk: int, *arrays):
+    """Pad parallel per-lane arrays to the next power-of-two chunk >=
+    ``min_chunk`` with duplicate writes of entry 0 (same values ->
+    idempotent), so churn bursts retrace the scatter program O(log
+    churn) times, not per distinct length.  Shared by the flat resident
+    scatter and the tenant slab's row scatter — the padding invariant
+    must never diverge between them."""
+    m = len(arrays[0])
+    chunk = min_chunk
+    while chunk < m:
+        chunk *= 2
+    if chunk == m:
+        return arrays
+    pad = chunk - m
+    return tuple(np.concatenate([a, np.full(pad, a[0], a.dtype)])
+                 for a in arrays)
 
 
 class PendingPlan:
@@ -536,20 +581,10 @@ class PlanService:
 
     def _scatter_lanes(self, lanes, vp, vg, vs, va) -> None:
         """O(churn) device update: scatter per-lane values into the
-        resident arrays, padded to a power-of-two chunk with duplicate
-        writes of entry 0 (same values -> idempotent) so churn bursts
-        retrace the program O(log churn) times."""
+        resident arrays, pow2-chunk-padded (see _pad_pow2_chunk)."""
         m = len(lanes)
-        chunk = self.SCATTER_CHUNK_MIN
-        while chunk < m:
-            chunk *= 2
-        if chunk > m:
-            pad = chunk - m
-            lanes = np.concatenate([lanes, np.full(pad, lanes[0], np.int32)])
-            vp = np.concatenate([vp, np.full(pad, vp[0], np.int32)])
-            vg = np.concatenate([vg, np.full(pad, vg[0], np.int32)])
-            vs = np.concatenate([vs, np.full(pad, vs[0], np.int32)])
-            va = np.concatenate([va, np.full(pad, va[0], bool)])
+        lanes, vp, vg, vs, va = _pad_pow2_chunk(
+            self.SCATTER_CHUNK_MIN, lanes, vp, vg, vs, va)
         scatter = self._scatter_fn()
         self.d_pos, self.d_goal, self.d_slot, self.d_active = scatter(
             self.d_pos, self.d_goal, self.d_slot, self.d_active,
@@ -961,6 +996,765 @@ class TickRunner:
         return snap
 
 
+# ---------------------------------------------------------------------------
+# Multi-tenant device residency (ISSUE 8): ONE solverd serving many fleets.
+#
+# Each tenant (a whole fleet behind a bus namespace, runtime/busns.py) gets
+# one ROW of a [T_cap, L_cap] device-resident super-batch — pow2-padded on
+# both axes exactly like the single-tenant lane padding, so tenant churn
+# and fleet growth cause O(log) recompiles.  One jitted vmapped step plans
+# EVERY tenant's lanes in a single device call per tick burst; rows are
+# physically isolated (vmap batching), so two tenants' agents can occupy
+# the same cell of their separate worlds without interacting.  The
+# direction-field cache is SHARED across tenants — all scenarios run the
+# same grid, so tenant B hits the rows tenant A swept (the cross-tenant
+# caching win) — with the existing refcount pinning counting every
+# tenant's resident goals.
+# ---------------------------------------------------------------------------
+
+
+class Tenant:
+    """One admitted fleet: its slab row, packed-delta decoder chain and
+    admission bookkeeping."""
+
+    __slots__ = ("ns", "topic", "row", "decoder", "last_req_ms",
+                 "admitted_ms", "resyncs", "snapshot_needed")
+
+    def __init__(self, ns: str, row: int):
+        self.ns = ns
+        self.topic = busns.wire_topic(ns, "solver")
+        self.row = row
+        self.decoder = pcodec.PackedStateDecoder()
+        self.last_req_ms = time.monotonic() * 1000.0
+        self.admitted_ms = self.last_req_ms
+        self.resyncs = 0
+        self.snapshot_needed = False
+
+
+class PendingSuper:
+    """A dispatched-but-unfetched super-batch step: device handles plus
+    the per-tenant requests (and per-row diff baselines) its responses
+    need.  Baselines are captured per REQUESTING row at dispatch time —
+    not a whole-slab copy — so the memcpy cost scales with the burst,
+    and a row evicted+reassigned while the step is in flight can never
+    be diffed against another tenant's state."""
+
+    __slots__ = ("new_pos", "new_goal", "bases", "reqs", "t0",
+                 "t_disp_end", "lanes")
+
+
+class TenantSlab:
+    """[T_cap, L_cap] device-resident fleet state for many tenants,
+    sharing one :class:`PlanService`'s direction-field cache (dirs rows,
+    goal refcount pins, deferred-field queue).  The service's own flat
+    single-tenant resident state stays untouched — the daemon runs one
+    mode or the other."""
+
+    def __init__(self, service: PlanService, grid: Grid,
+                 tenant_lanes: int = 1 << 16):
+        self.service = service
+        self.grid = grid
+        self.tenant_lanes = tenant_lanes  # per-tenant lane budget
+        self.T_cap = 0
+        self.L_cap = 0
+        self.h_pos = np.zeros((0, 0), np.int32)
+        self.h_goal = np.zeros((0, 0), np.int32)
+        self.h_slot = np.zeros((0, 0), np.int32)
+        self.h_active = np.zeros((0, 0), bool)
+        self.d_pos = self.d_goal = self.d_slot = self.d_active = None
+        self.rows_used: set = set()
+        # deferred-field parking, keyed (row, lane) — the slab analog of
+        # PlanService.lane_wait/wait_lanes
+        self.lane_wait: Dict[Tuple[int, int], int] = {}
+        self.wait_lanes: Dict[int, set] = {}
+        self._vstep = None
+        self._vstep_l = 0
+        self._rowscatter = None
+        self._rowset = None
+
+    # -- geometry ---------------------------------------------------------
+    def _grow(self, rows: int, lanes: int) -> None:
+        """Ensure capacity for ``rows`` tenant rows x ``lanes`` lanes;
+        pow2 padding on both axes, full re-upload on growth (rare,
+        O(log) times over a fleet's life — deltas never come here)."""
+        cap_t = max(self.T_cap, 1)
+        while cap_t < rows:
+            cap_t *= 2
+        cap_l = max(self.L_cap, self.service.capacity_min)
+        while cap_l < lanes:
+            cap_l *= 2
+        if cap_t <= self.T_cap and cap_l <= self.L_cap and self.T_cap:
+            return
+        grown = np.zeros((cap_t, cap_l), np.int32)
+        grown[:self.h_pos.shape[0], :self.h_pos.shape[1]] = self.h_pos
+        g_goal = np.zeros((cap_t, cap_l), np.int32)
+        g_goal[:self.h_goal.shape[0], :self.h_goal.shape[1]] = self.h_goal
+        g_slot = np.zeros((cap_t, cap_l), np.int32)
+        g_slot[:self.h_slot.shape[0], :self.h_slot.shape[1]] = self.h_slot
+        g_act = np.zeros((cap_t, cap_l), bool)
+        g_act[:self.h_active.shape[0], :self.h_active.shape[1]] = \
+            self.h_active
+        self.h_pos, self.h_goal = grown, g_goal
+        self.h_slot, self.h_active = g_slot, g_act
+        self.T_cap, self.L_cap = cap_t, cap_l
+        self._upload()
+        registry.get_registry().gauge("solverd.slab_lanes", cap_t * cap_l)
+
+    def _upload(self) -> None:
+        """Full host->device resync (growth/admission/eviction — the
+        structural edges; steady-state deltas use the row scatter)."""
+        self.d_pos = jnp.asarray(self.h_pos)
+        self.d_goal = jnp.asarray(self.h_goal)
+        self.d_slot = jnp.asarray(self.h_slot)
+        self.d_active = jnp.asarray(self.h_active)
+
+    def alloc_row(self) -> int:
+        row = next((r for r in range(self.T_cap)
+                    if r not in self.rows_used), None)
+        if row is None:
+            row = self.T_cap
+            self._grow(self.T_cap + 1, max(self.L_cap, 1))
+        self.rows_used.add(row)
+        return row
+
+    def free_row(self, row: int) -> None:
+        """Evict a tenant's row: unpin its goals, clear its deferred
+        parking, zero host + device state."""
+        for lane in np.flatnonzero(self.h_active[row]):
+            self.service._ref_goal(int(self.h_goal[row, lane]), -1)
+        for key in [k for k in self.lane_wait if k[0] == row]:
+            g = self.lane_wait.pop(key)
+            s = self.wait_lanes.get(g)
+            if s is not None:
+                s.discard(key)
+                if not s:
+                    del self.wait_lanes[g]
+        self.h_pos[row] = 0
+        self.h_goal[row] = 0
+        self.h_slot[row] = 0
+        self.h_active[row] = False
+        self._row_set(row)
+        self.rows_used.discard(row)
+
+    # -- jitted programs --------------------------------------------------
+    def _step_fn(self):
+        if self._vstep is None or self._vstep_l != self.L_cap:
+            cfg = SolverConfig(height=self.grid.height,
+                               width=self.grid.width,
+                               num_agents=self.L_cap)
+
+            def one(pos, goal, slot, active, dirs):
+                return step_parallel(cfg, pos, goal, slot, dirs, active)
+
+            # the super-batch: one program, tenants down the batch axis,
+            # the shared field cache broadcast (in_axes=None)
+            self._vstep = jax.jit(jax.vmap(one,
+                                           in_axes=(0, 0, 0, 0, None)))
+            self._vstep_l = self.L_cap
+        return self._vstep
+
+    def _row_scatter_fn(self):
+        if self._rowscatter is None:
+            def sc(pos, goal, slot, active, row, idx, vp, vg, vs, va):
+                return (pos.at[row, idx].set(vp), goal.at[row, idx].set(vg),
+                        slot.at[row, idx].set(vs),
+                        active.at[row, idx].set(va))
+            self._rowscatter = jax.jit(sc)
+        return self._rowscatter
+
+    def _row_set_fn(self):
+        if self._rowset is None:
+            def st(pos, goal, slot, active, row, vp, vg, vs, va):
+                return (pos.at[row].set(vp), goal.at[row].set(vg),
+                        slot.at[row].set(vs), active.at[row].set(va))
+            self._rowset = jax.jit(st)
+        return self._rowset
+
+    def _row_set(self, row: int) -> None:
+        """Device row <- host mirror row (snapshot / eviction)."""
+        if self.d_pos is None:
+            return
+        st = self._row_set_fn()
+        self.d_pos, self.d_goal, self.d_slot, self.d_active = st(
+            self.d_pos, self.d_goal, self.d_slot, self.d_active,
+            row, jnp.asarray(self.h_pos[row]),
+            jnp.asarray(self.h_goal[row]), jnp.asarray(self.h_slot[row]),
+            jnp.asarray(self.h_active[row]))
+
+    def _scatter_row_lanes(self, row, lanes, vp, vg, vs, va) -> None:
+        """O(churn) device update of one tenant row, pow2-chunk-padded
+        (the 2-D analog of PlanService._scatter_lanes; shared
+        _pad_pow2_chunk keeps the padding invariant identical)."""
+        m = len(lanes)
+        lanes, vp, vg, vs, va = _pad_pow2_chunk(
+            PlanService.SCATTER_CHUNK_MIN, lanes, vp, vg, vs, va)
+        sc = self._row_scatter_fn()
+        self.d_pos, self.d_goal, self.d_slot, self.d_active = sc(
+            self.d_pos, self.d_goal, self.d_slot, self.d_active, row,
+            jnp.asarray(lanes), jnp.asarray(vp), jnp.asarray(vg),
+            jnp.asarray(vs), jnp.asarray(va))
+        registry.get_registry().count("solverd.resident_scatter_lanes", m)
+
+    # -- deferred fields (slab flavor) ------------------------------------
+    def _unwait(self, row: int, lane: int) -> None:
+        g = self.lane_wait.pop((row, lane), None)
+        if g is not None:
+            s = self.wait_lanes.get(g)
+            if s is not None:
+                s.discard((row, lane))
+                if not s:
+                    del self.wait_lanes[g]
+
+    def _slot_of(self, row: int, lane: int, goal: int) -> int:
+        """Field row for a lane's goal; a missing row parks the lane on
+        the shared STAY row and front-queues the sweep (a waiting agent
+        outranks speculative prefetch)."""
+        svc = self.service
+        self._unwait(row, lane)
+        r = svc.goal_rows.get(goal)
+        if r is not None:
+            return r
+        self.lane_wait[(row, lane)] = goal
+        self.wait_lanes.setdefault(goal, set()).add((row, lane))
+        svc.field_queue[goal] = None
+        svc.field_queue.move_to_end(goal, last=False)
+        return svc._stay_row()
+
+    def _ensure_rows_or_defer(self, goals: List[int]) -> None:
+        svc = self.service
+        misses = svc._count_cache(goals)
+        if svc.defer_fields:
+            return
+        with trace.span("solverd.field_sweep", fresh_goals=misses,
+                        parent="solverd.tick"):
+            svc._ensure_fields(goals, min_rows=len(svc.goal_ref))
+
+    def process_field_queue(self, max_goals: Optional[int] = None) -> int:
+        """Idle-window sweep of queued goal fields + repair of slab lanes
+        parked on the STAY row (the multi-tenant analog of
+        PlanService.process_field_queue)."""
+        svc = self.service
+        if not svc.field_queue:
+            return 0
+        budget = max_goals or PlanService.FIELD_CHUNK
+        popped = []
+        while svc.field_queue and len(popped) < budget:
+            g, _ = svc.field_queue.popitem(last=False)
+            popped.append(g)
+        missing = [g for g in popped if g not in svc.goal_rows]
+        if missing:
+            with trace.span("solverd.field_prefetch", goals=len(missing)):
+                svc._ensure_fields(missing, min_rows=len(svc.goal_ref))
+            registry.get_registry().count("solverd.prefetched_fields",
+                                          len(missing))
+        registry.get_registry().gauge("solverd.field_queue",
+                                      len(svc.field_queue))
+        by_row: Dict[int, List[Tuple[int, int]]] = {}
+        for g in popped:
+            for key in sorted(self.wait_lanes.pop(g, ())):
+                row, lane = key
+                if self.lane_wait.get(key) == g \
+                        and self.h_active[row, lane] \
+                        and int(self.h_goal[row, lane]) == g:
+                    del self.lane_wait[key]
+                    by_row.setdefault(row, []).append(
+                        (lane, svc.goal_rows[g]))
+                else:
+                    self.lane_wait.pop(key, None)
+        for row, pairs in by_row.items():
+            la = np.asarray([p[0] for p in pairs], np.int32)
+            vs = np.asarray([p[1] for p in pairs], np.int32)
+            self.h_slot[row, la] = vs
+            self._scatter_row_lanes(row, la, self.h_pos[row, la].copy(),
+                                    self.h_goal[row, la].copy(), vs,
+                                    self.h_active[row, la].copy())
+        return len(popped)
+
+    # -- state application ------------------------------------------------
+    def apply(self, row: int, upd: "pcodec.DecodedUpdate") -> int:
+        """Fold one decoded snapshot/delta into tenant ``row``'s slab
+        slice (the multi-tenant port of PlanService.resident_apply);
+        returns lanes written."""
+        svc = self.service
+        reg = registry.get_registry()
+        if upd.is_snapshot:
+            lanes = upd.idx.astype(np.int64)
+            top = int(lanes.max()) + 1 if lanes.size else 1
+            self._grow(max(len(self.rows_used), row + 1), top)
+            for lane in np.flatnonzero(self.h_active[row]):
+                svc._ref_goal(int(self.h_goal[row, lane]), -1)
+            for key in [k for k in self.lane_wait if k[0] == row]:
+                self._unwait(*key)
+            self.h_active[row] = False
+            self.h_pos[row] = 0
+            self.h_goal[row] = 0
+            self.h_slot[row] = 0
+            goals = [int(g) for g in upd.goal]
+            for g in goals:
+                svc._ref_goal(g, +1)
+            self._ensure_rows_or_defer(goals)
+            self.h_pos[row, lanes] = upd.pos
+            self.h_goal[row, lanes] = upd.goal
+            self.h_slot[row, lanes] = np.fromiter(
+                (self._slot_of(row, int(l), g)
+                 for l, g in zip(lanes, goals)), np.int32, len(goals))
+            self.h_active[row, lanes] = True
+            self._row_set(row)  # a snapshot IS the O(fleet) row resync
+            reg.count("solverd.snapshots_applied")
+            return int(lanes.size)
+        final: Dict[int, Optional[Tuple[int, int]]] = {}
+        for lane in upd.removed:
+            final[int(lane)] = None
+        for lane, p, g in zip(upd.idx, upd.pos, upd.goal):
+            final[int(lane)] = (int(p), int(g))
+        if not final:
+            return 0
+        self._grow(max(len(self.rows_used), row + 1), max(final) + 1)
+        goals = []
+        for lane, v in final.items():
+            if self.h_active[row, lane]:
+                svc._ref_goal(int(self.h_goal[row, lane]), -1)
+            if v is not None:
+                svc._ref_goal(v[1], +1)
+                goals.append(v[1])
+        self._ensure_rows_or_defer(goals)
+        m = len(final)
+        lanes = np.fromiter(final.keys(), np.int32, m)
+        vp = np.zeros(m, np.int32)
+        vg = np.zeros(m, np.int32)
+        vs = np.zeros(m, np.int32)
+        va = np.zeros(m, bool)
+        for k, (lane, v) in enumerate(final.items()):
+            if v is None:
+                self._unwait(row, lane)
+                continue
+            vp[k], vg[k] = v
+            vs[k] = self._slot_of(row, lane, v[1])
+            va[k] = True
+        self.h_pos[row, lanes] = vp
+        self.h_goal[row, lanes] = vg
+        self.h_slot[row, lanes] = vs
+        self.h_active[row, lanes] = va
+        self._scatter_row_lanes(row, lanes, vp, vg, vs, va)
+        return m
+
+    # -- planning ---------------------------------------------------------
+    def dispatch(self, reqs: Dict[str, dict],
+                 rows: Dict[str, int]) -> Optional[PendingSuper]:
+        """One vmapped device step over the WHOLE slab (every admitted
+        tenant's lanes, responders and idlers alike — the step is
+        stateless w.r.t. resident pos, so stepping a tenant without a
+        pending request costs only masked compute); ``reqs`` maps tenant
+        ns -> its ingested request, ``rows`` its slab row — the rows
+        that get responses."""
+        n = int(self.h_active.sum())
+        if n == 0 or not reqs:
+            return None
+        t0 = time.perf_counter()
+        with trace.span("solverd.step_dispatch", capacity=self.L_cap,
+                        tenants=len(self.rows_used),
+                        parent="solverd.tick"):
+            step = self._step_fn()
+            new_pos, new_goal, _ = step(self.d_pos, self.d_goal,
+                                        self.d_slot, self.d_active,
+                                        self.service.dirs)
+        p = PendingSuper()
+        p.new_pos, p.new_goal = new_pos, new_goal
+        p.bases = {ns: (row, self.h_pos[row].copy(),
+                        self.h_goal[row].copy(),
+                        self.h_active[row].copy())
+                   for ns, row in rows.items()}
+        p.reqs = reqs
+        p.lanes = n
+        p.t0 = t0
+        p.t_disp_end = time.perf_counter()
+        reg = registry.get_registry()
+        reg.gauge("solverd.superbatch_tenants", len(reqs))
+        reg.gauge("solverd.superbatch_lanes", n)
+        return p
+
+    def fetch(self, p: PendingSuper) -> Tuple[np.ndarray, np.ndarray]:
+        """Block on the super-step outputs; per-tenant diffs are cut by
+        the runner against the dispatch-time baselines."""
+        with trace.span("solverd.device_sync", parent="solverd.tick"):
+            return np.asarray(p.new_pos), np.asarray(p.new_goal)
+
+
+class MultiTenantRunner:
+    """Admission, ingest and response encoding for the tenant slab.
+
+    The daemon loop feeds it raw bus frames (wire topics — this runner
+    and the slab are the only tenant-AWARE layer; managers and agents
+    run unmodified behind their namespaces).  ``publish`` abstracts the
+    bus so tests can drive the runner against a list."""
+
+    def __init__(self, slab: TenantSlab, grid: Grid,
+                 publish, max_tenants: int = 64,
+                 idle_evict_ms: float = 2000.0,
+                 heartbeat: Optional[HeartbeatWriter] = None,
+                 budget_ms: float = TICK_BUDGET_MS):
+        self.slab = slab
+        self.grid = grid
+        self.publish = publish
+        self.max_tenants = max_tenants
+        self.idle_evict_ms = idle_evict_ms
+        self.heartbeat = heartbeat
+        self.budget_ms = budget_ms
+        self.tenants: Dict[str, Tenant] = {}
+        self.pending_reqs: Dict[str, dict] = {}
+        self.registry = registry.get_registry()
+        self.ticks = 0
+        self.dropped_total = 0
+
+    MAX_LANES = TickRunner.MAX_LANES
+
+    # -- admission / eviction --------------------------------------------
+    def ensure_tenant(self, ns: str) -> Optional[Tenant]:
+        t = self.tenants.get(ns)
+        if t is not None:
+            return t
+        if len(self.tenants) >= self.max_tenants:
+            victim = self._evictable()
+            if victim is None:
+                # everyone is actively planning: refuse rather than
+                # thrash (the caller's requests drop until a slot idles)
+                self.registry.count("solverd.tenant_admission_rejected")
+                return None
+            self.evict(victim, reason="lru")
+        t = Tenant(ns, self.slab.alloc_row())
+        self.tenants[ns] = t
+        self.registry.count("solverd.tenant_admissions")
+        self.registry.gauge("solverd.tenants", len(self.tenants))
+        print(f"🏷️  tenant {ns or '<default>'} admitted "
+              f"(row {t.row}, {len(self.tenants)} resident)", flush=True)
+        return t
+
+    def _evictable(self) -> Optional[Tenant]:
+        """The least-recently-active tenant idle past the threshold."""
+        now_ms = time.monotonic() * 1000.0
+        idle = [t for t in self.tenants.values()
+                if now_ms - t.last_req_ms >= self.idle_evict_ms]
+        if not idle:
+            return None
+        return min(idle, key=lambda t: t.last_req_ms)
+
+    def evict(self, t: Tenant, reason: str = "manual") -> None:
+        """Release a tenant's device memory; its bus subscription stays,
+        and the next plan_request re-admits it with a fresh decoder —
+        whose seq gap triggers the plan_snapshot_request resync, so the
+        manager (the system of record) rebuilds the row losslessly."""
+        self.slab.free_row(t.row)
+        self.tenants.pop(t.ns, None)
+        self.pending_reqs.pop(t.ns, None)
+        self.registry.count("solverd.tenant_evictions")
+        self.registry.gauge("solverd.tenants", len(self.tenants))
+        self.publish(t.topic, {"type": "tenant_evicted", "ns": t.ns,
+                               "reason": reason})
+        print(f"🏷️  tenant {t.ns or '<default>'} evicted ({reason}); "
+              f"re-admission will snapshot-resync", flush=True)
+
+    # -- ingest -----------------------------------------------------------
+    def _packet_sane(self, pkt) -> bool:
+        for a in (pkt.idx, pkt.named_idx, pkt.removed):
+            if a.size and (int(a.min()) < 0
+                           or int(a.max()) >= min(self.MAX_LANES,
+                                                  self.slab.tenant_lanes)):
+                return False
+        n_cells = self.grid.num_cells
+        for a in (pkt.pos, pkt.goal):
+            if a.size and (int(a.min()) < 0 or int(a.max()) >= n_cells):
+                return False
+        return True
+
+    def ingest(self, ns: str, data: dict, stale: bool = False) -> bool:
+        """Decode one tenant's plan_request into its slab row.  Packed
+        deltas are order-sensitive, so superseded requests still apply
+        (``stale=True``); returns True when ``data`` became the
+        tenant's request to answer this burst."""
+        if data.get("codec") != pcodec.CODEC_NAME:
+            # multi-tenant mode is packed-wire only — and an unservable
+            # request must not ADMIT (a legacy-JSON manager would evict
+            # a healthy idle tenant just to squat a slab row forever)
+            self.registry.count("solverd.json_requests_ignored")
+            return False
+        t = self.ensure_tenant(ns)
+        if t is None:
+            return False
+        t.last_req_ms = time.monotonic() * 1000.0
+        t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
+        with trace.span("solverd.request_decode", parent="solverd.tick"):
+            try:
+                raw = base64.b64decode(data.get("data") or "",
+                                       validate=True)
+                pkt = pcodec.decode(raw)
+            except (ValueError, pcodec.CodecError):
+                self.registry.count("solverd.bad_packets")
+                return False
+            if pkt.trace is not None:
+                obs_events.emit("plan.request", trace_id=pkt.trace.trace_id,
+                                hop=pkt.trace.hop,
+                                send_ms=pkt.trace.send_ms,
+                                seq=data.get("seq"))
+            if not self._packet_sane(pkt):
+                self.registry.count("solverd.bad_packets")
+                return False
+            self.registry.count("solverd.decode_bytes", len(raw))
+            if pkt.kind == pcodec.KIND_DELTA:
+                self.registry.count("solverd.delta_agents",
+                                    int(pkt.idx.size))
+            try:
+                upd = t.decoder.apply(pkt)
+            except pcodec.SeqGapError as e:
+                t.snapshot_needed = True
+                self.registry.count("solverd.seq_gaps")
+                trace.instant("solverd.seq_gap", have=e.have_seq,
+                              base=e.base_seq, tenant=ns)
+                return False
+            self.slab.apply(t.row, upd)
+            self.slab.service.prefetch_goals(data.get("hints") or [])
+        if stale:
+            return False
+        caps = data.get("caps") or []
+        req = {"ns": ns, "seq": data.get("seq"), "caps": caps,
+               "t0": t0, "t0_ns": t0_ns, "tc": pkt.trace,
+               "t_dec": time.perf_counter()}
+        if pcodec.CODEC_NAME not in caps:
+            req["names"] = list(t.decoder.names)
+        self.pending_reqs[ns] = req
+        return True
+
+    def flush_snapshot_requests(self) -> None:
+        for t in self.tenants.values():
+            if t.snapshot_needed:
+                t.snapshot_needed = False
+                t.resyncs += 1
+                self.registry.count("solverd.tenant_resyncs")
+                self.publish(t.topic, {
+                    "type": "plan_snapshot_request",
+                    "have_seq": (t.decoder.last_seq
+                                 if t.decoder.last_seq is not None
+                                 else -1)})
+
+    # -- plan / respond ---------------------------------------------------
+    def begin(self) -> Optional[PendingSuper]:
+        reqs, self.pending_reqs = self.pending_reqs, {}
+        if not reqs:
+            return None
+        rows = {ns: self.tenants[ns].row for ns in reqs
+                if ns in self.tenants}
+        return self.slab.dispatch(reqs, rows)
+
+    def finish(self, p: PendingSuper, pipelined: bool = False) -> None:
+        """Fetch the super-step and publish one response per requesting
+        tenant (packed when its request advertised the codec, legacy
+        JSON otherwise)."""
+        t_fetch0 = time.perf_counter()
+        overlap_ms = 1000.0 * (t_fetch0 - p.t_disp_end)
+        self.registry.observe("solverd.pipeline_overlap_ms", overlap_ms)
+        new_pos, new_goal = self.slab.fetch(p)
+        t_fetched = time.perf_counter()
+        w = self.grid.width
+        for ns, r in p.reqs.items():
+            t = self.tenants.get(ns)
+            base = p.bases.get(ns)
+            if t is None or base is None or t.row != base[0]:
+                continue  # evicted (or evicted+re-admitted) in flight
+            row, base_pos, base_goal, base_active = base
+            changed = base_active \
+                & ((new_pos[row] != base_pos)
+                   | (new_goal[row] != base_goal))
+            lanes = np.flatnonzero(changed).astype(np.int32)
+            npos = new_pos[row][lanes].astype(np.int32)
+            ngoal = new_goal[row][lanes].astype(np.int32)
+            us = int(1e6 * ((p.t_disp_end - r["t0"])
+                            + (t_fetched - t_fetch0)))
+            resp_tc = None
+            if r.get("tc") is not None and obs_events.ctx_enabled():
+                resp_tc = r["tc"].next_hop()
+            with trace.span("solverd.reply_encode", parent="solverd.tick"):
+                if pcodec.CODEC_NAME in r["caps"]:
+                    rpkt = pcodec.encode_response(r["seq"], lanes, npos,
+                                                  ngoal)
+                    rpkt.trace = resp_tc
+                    resp = {"type": "plan_response", "seq": r["seq"],
+                            "codec": pcodec.CODEC_NAME,
+                            "duration_micros": us,
+                            "data": pcodec.encode_b64(rpkt)}
+                else:
+                    names = r.get("names") or []
+                    moves = []
+                    for lane, c, g in zip(lanes, npos, ngoal):
+                        pid = names[int(lane)] \
+                            if 0 <= int(lane) < len(names) else None
+                        if pid is None:
+                            continue
+                        moves.append({"peer_id": pid,
+                                      "next_pos": [int(c) % w, int(c) // w],
+                                      "goal": [int(g) % w, int(g) // w]})
+                    resp = {"type": "plan_response", "seq": r["seq"],
+                            "duration_micros": us, "moves": moves}
+                    if resp_tc is not None:
+                        resp["tc"] = [resp_tc.trace_id, resp_tc.hop,
+                                      resp_tc.send_ms]
+            self.publish(t.topic, resp)
+        self.ticks += 1
+        first = min(r["t0"] for r in p.reqs.values())
+        total_ms = 1000.0 * (time.perf_counter() - first)
+        trace.complete("solverd.tick",
+                       min(r["t0_ns"] for r in p.reqs.values()),
+                       time.perf_counter_ns()
+                       - min(r["t0_ns"] for r in p.reqs.values()),
+                       tenants=len(p.reqs), pipelined=pipelined)
+        self.registry.observe("tick_ms", total_ms)
+        if total_ms > self.budget_ms:
+            self.registry.count("tick.over_budget")
+        self.registry.gauge("tick.agents", p.lanes)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(
+                self.ticks, p.lanes,
+                {"total": total_ms,
+                 "overlap": overlap_ms if pipelined else 0.0},
+                counters=trace.snapshot()["counters"])
+            trace.flush()
+
+    def stats(self) -> dict:
+        snap = trace.snapshot()
+        svc = self.slab.service
+        snap["service"] = {
+            "mode": "multi_tenant",
+            "ticks": self.ticks,
+            "dropped_stale": self.dropped_total,
+            "tenants": {
+                (t.ns or "<default>"): {
+                    "row": t.row,
+                    "lanes": int(self.slab.h_active[t.row].sum())
+                    if t.row < self.slab.T_cap else 0,
+                    "last_seq": t.decoder.last_seq,
+                    "resyncs": t.resyncs,
+                    "idle_ms": round(time.monotonic() * 1000.0
+                                     - t.last_req_ms, 1),
+                } for t in self.tenants.values()},
+            "slab": {"t_cap": self.slab.T_cap, "l_cap": self.slab.L_cap,
+                     "lanes": int(self.slab.h_active.sum())},
+            "cached_fields": len(svc.goal_rows),
+            "max_fields": svc.max_fields,
+            "cache_hits": svc.cache_hits,
+            "cache_misses": svc.cache_misses,
+            "defer_fields": svc.defer_fields,
+            "field_queue": len(svc.field_queue),
+            "deferred_lanes": len(self.slab.lane_wait),
+        }
+        snap["network"] = self.registry.network_summary()
+        return snap
+
+
+def multi_tenant_loop(bus: BusClient, runner: MultiTenantRunner,
+                      slab: TenantSlab, beacon,
+                      stats_requested: dict, dump_stats) -> None:
+    """The multi-tenant daemon loop: tenant-tagged ingest (wire topics
+    carry the namespace), one pipelined vmapped super-step per request
+    burst, per-tenant responses, dynamic admission via
+    ``solver.admit``."""
+
+    def subscribe_tenant(ns: str) -> None:
+        bus.subscribe(busns.wire_topic(ns, "solver"), raw=True)
+
+    svc = slab.service
+    pending: Optional[PendingSuper] = None
+
+    def route(frame) -> Optional[Tuple[str, dict]]:
+        """(tenant ns, plan_request payload) of a frame, handling the
+        control messages inline; None for everything else."""
+        if frame.get("op") != "msg":
+            return None
+        data = frame.get("data") or {}
+        topic = frame.get("topic") or ""
+        ns, logical = busns.split_ns(topic)
+        typ = data.get("type")
+        if logical == ADMIT_TOPIC:
+            if typ == "tenant_hello" and isinstance(data.get("ns"), str):
+                try:
+                    hello_ns = busns.validate(data["ns"])
+                except ValueError:
+                    return None
+                subscribe_tenant(hello_ns)
+                if runner.ensure_tenant(hello_ns) is not None:
+                    bus.publish(ADMIT_TOPIC,
+                                {"type": "tenant_welcome", "ns": hello_ns})
+            return None
+        if logical != "solver":
+            return None
+        if typ == "stats_request":
+            # cross-tenant stats enumerate EVERY tenant's namespace and
+            # activity — operator tooling only: answered on the
+            # un-namespaced topic, never into a tenant's namespace
+            if ns == "":
+                bus.publish(topic, {"type": "stats_response",
+                                    **runner.stats()}, raw=True)
+            return None
+        if typ == "flight_dump":
+            if ns != "":
+                return None  # operator tooling, same rule as stats
+            path = flightrec.dump(reason="bus_request")
+            bus.publish(topic, {
+                "type": "flight_dump_response", "proc": "solverd",
+                "peer_id": "solverd", "path": path,
+                "events": len(flightrec.get_recorder())}, raw=True)
+            return None
+        if typ != "plan_request":
+            return None
+        return ns, data
+
+    while True:
+        frame = bus.recv(timeout=0.002 if pending is not None
+                         else (0.02 if svc.field_queue else 1.0))
+        beacon.maybe_beat()
+        if stats_requested["flag"]:
+            stats_requested["flag"] = False
+            dump_stats()
+        if frame is None:
+            if pending is not None:
+                runner.finish(pending, pipelined=True)
+                pending = None
+            elif svc.field_queue:
+                slab.process_field_queue()
+            continue
+        routed = route(frame)
+        if routed is None:
+            continue
+        # stale drain, PER TENANT: every packed request applies in
+        # order, only the newest per tenant is planned this burst.
+        # BOUNDED: with many tenants ticking fast the inter-arrival gap
+        # can stay under the drain timeout forever — an in-flight
+        # step's responses must not be withheld behind an endless drain
+        bursts: Dict[str, List[dict]] = {routed[0]: [routed[1]]}
+        drained = 0
+        while drained < 256:
+            nxt = bus.recv(timeout=0.005)
+            if nxt is None:
+                break
+            drained += 1
+            r = route(nxt)
+            if r is not None:
+                bursts.setdefault(r[0], []).append(r[1])
+        any_ok = False
+        for ns, reqs in bursts.items():
+            for stale_req in reqs[:-1]:
+                runner.ingest(ns, stale_req, stale=True)
+            if runner.ingest(ns, reqs[-1]):
+                any_ok = True
+            dropped = len(reqs) - 1
+            if dropped:
+                runner.dropped_total += dropped
+                trace.count("solverd.dropped_stale", dropped)
+        runner.flush_snapshot_requests()
+        nxt_pending = runner.begin() if any_ok else None
+        if pending is not None:
+            runner.finish(pending, pipelined=True)
+        pending = nxt_pending
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=7400)
@@ -974,7 +1768,31 @@ def main(argv=None) -> int:
     # Force the CPU backend (tests; also the env-var route is unreliable in
     # environments whose sitecustomize pre-imports jax with a plugin set).
     ap.add_argument("--cpu", action="store_true")
+    # Multi-tenant mode (ISSUE 8): serve many namespaced fleets from one
+    # device-resident super-batch.  --tenants pre-subscribes a static
+    # tenant list; --multi-tenant additionally listens on solver.admit
+    # for dynamic tenant_hello admission.  Either flag enables the mode.
+    ap.add_argument("--tenants", default=None,
+                    help="comma list of bus namespaces to serve "
+                         "(JG_BUS_NS values; '' = the un-namespaced "
+                         "default fleet)")
+    ap.add_argument("--multi-tenant", action="store_true",
+                    help="dynamic tenant admission via solver.admit")
+    ap.add_argument("--max-tenants", type=int, default=64,
+                    help="device-memory admission budget: tenants beyond "
+                         "this evict the least-recently-active idle "
+                         "tenant (snapshot-resync on re-admission)")
+    ap.add_argument("--tenant-lanes", type=int, default=1 << 16,
+                    help="per-tenant lane budget (requests addressing "
+                         "lanes past it are rejected)")
+    ap.add_argument("--tenant-idle-ms", type=float, default=2000.0,
+                    help="a tenant is eviction-eligible only after this "
+                         "long without a plan_request")
     args = ap.parse_args(argv)
+    tenant_list = ([busns.validate(t.strip()) for t in
+                    args.tenants.split(",")] if args.tenants is not None
+                   else [])
+    multi_tenant = bool(tenant_list) or args.multi_tenant
 
     tracer = trace.configure(enabled=True if args.trace else None,
                              proc="solverd")
@@ -1001,8 +1819,25 @@ def main(argv=None) -> int:
     # reconnect=True: a busd restart must not kill the planning daemon —
     # it resubscribes and resumes answering plan_requests (the manager
     # plans natively during the gap via its failover path)
-    bus = BusClient(port=args.port, peer_id="solverd", reconnect=True)
-    bus.subscribe("solver")
+    # Multi-tenant solverd IS the cross-tenant infrastructure: its own
+    # client must be un-namespaced no matter what JG_BUS_NS the spawning
+    # environment exported (a fleet-wide env would otherwise prefix the
+    # admit/solver subscriptions and merge that tenant into the default
+    # row).  Single-tenant mode keeps the env behavior — a whole fleet
+    # (solverd included) can legitimately live behind one namespace.
+    bus = BusClient(port=args.port, peer_id="solverd", reconnect=True,
+                    namespace="" if multi_tenant else None)
+    if multi_tenant:
+        # tenant plan wires are WIRE topics (the solverd client itself is
+        # un-namespaced — it is the cross-tenant infrastructure)
+        for ns in tenant_list:
+            bus.subscribe(busns.wire_topic(ns, "solver"), raw=True)
+        if args.multi_tenant:
+            bus.subscribe(ADMIT_TOPIC)
+        if "" not in tenant_list:
+            bus.subscribe("solver")  # the un-namespaced default fleet
+    else:
+        bus.subscribe("solver")
 
     try:
         jax.devices()
@@ -1035,6 +1870,16 @@ def main(argv=None) -> int:
         print(f"🔎 tracing on: {tracer.default_path('trace')} "
               f"(+ heartbeat sidecar)", flush=True)
     runner = TickRunner(service, grid, heartbeat=heartbeat)
+    mt_runner = slab = None
+    if multi_tenant:
+        slab = TenantSlab(service, grid, tenant_lanes=args.tenant_lanes)
+        mt_runner = MultiTenantRunner(
+            slab, grid,
+            publish=lambda topic, data: bus.publish(topic, data, raw=True),
+            max_tenants=args.max_tenants,
+            idle_evict_ms=args.tenant_idle_ms, heartbeat=heartbeat)
+        for ns in tenant_list:
+            mt_runner.ensure_tenant(ns)
 
     # live-metrics plane: optional HTTP /metrics (JG_METRICS_PORT) and the
     # periodic registry beacon on bus topic mapd.metrics (fleet_top reads it)
@@ -1052,7 +1897,8 @@ def main(argv=None) -> int:
                   lambda *_: stats_requested.__setitem__("flag", True))
 
     def dump_stats() -> None:
-        print("📈 stats " + json.dumps(runner.stats()), flush=True)
+        print("📈 stats " + json.dumps((mt_runner or runner).stats()),
+              flush=True)
         trace.flush()
 
     def answer_stats() -> None:
@@ -1061,10 +1907,18 @@ def main(argv=None) -> int:
         bus.publish("solver", {"type": "stats_response", **runner.stats()})
         trace.flush()
 
-    trace.instant("solverd.up", port=args.port)
+    trace.instant("solverd.up", port=args.port, multi_tenant=multi_tenant)
     print(f"🧮 solverd up on port {args.port} "
-          f"(grid {grid.height}x{grid.width}, devices={jax.devices()})")
+          f"(grid {grid.height}x{grid.width}, devices={jax.devices()}"
+          + (f", tenants={[t or '<default>' for t in tenant_list]}"
+             f" max={args.max_tenants}" if multi_tenant else "") + ")")
     sys.stdout.flush()
+
+    if multi_tenant:
+        # the tenant-aware loop replaces the single-fleet one end to end
+        multi_tenant_loop(bus, mt_runner, slab, beacon, stats_requested,
+                          dump_stats)
+        return 0
 
     # Pipelined tick loop (dispatch-then-poll): after dispatching the step
     # for request k the daemon returns to the bus instead of blocking on

@@ -32,8 +32,11 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/runtime/shardmap.py \
     p2p_distributed_tswap_tpu/runtime/buspool.py \
     p2p_distributed_tswap_tpu/runtime/simagent.py \
+    p2p_distributed_tswap_tpu/runtime/busns.py \
+    p2p_distributed_tswap_tpu/runtime/solverd.py \
     p2p_distributed_tswap_tpu/obs/slo.py \
     analysis/fleetsim.py \
+    analysis/tenant_scaling.py \
     scripts/bus_smoke.py \
     scripts/trace_smoke.py \
     bench.py
@@ -94,6 +97,21 @@ then
     echo "fleetsim gate OK (breach drill tripped as expected)"
 else
     echo "fleetsim gate SKIPPED (no C++ toolchain / binaries)"
+fi
+
+echo "== multi-tenant smoke =="
+# ISSUE 8: two namespaced fleets (real C++ managers behind JG_BUS_NS +
+# wire-faithful sim pools) on ONE busd + ONE multi-tenant solverd.
+# Asserts both tenants complete tasks through the shared device
+# super-batch with zero cross-tenant resyncs/evictions — cross-talk on
+# the namespaced wire would stall a fleet and fail the gate.
+if [[ -x cpp/build/mapd_bus && -x cpp/build/mapd_manager_centralized ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    JAX_PLATFORMS=cpu python analysis/tenant_scaling.py --smoke \
+        --log-dir /tmp/jg_tenant_ci_logs
+else
+    echo "multi-tenant smoke SKIPPED (no C++ toolchain / binaries)"
 fi
 
 echo "== tier-1 suite =="
