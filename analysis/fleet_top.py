@@ -83,6 +83,27 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
             f" peer rx/tx={p['bus']['peer_rx_msgs']}/{p['bus']['peer_tx_msgs']}"
             f" drops={p['bus']['slow_consumer_drops']}"
             for peer, p in bus_rows))
+    # field-engine health (ISSUE 9): per-cause sweeps, repair counters,
+    # queue depth + starvation age, dynamic-world seq — solverd rows
+    field_rows = [(peer, p) for peer, p in rollup["peers"].items()
+                  if p.get("field")]
+    if field_rows:
+        def _field_cell(peer, p):
+            f = p["field"]
+            s = f["sweeps"]
+            cell = (f"{peer[:16]}: q={f['queue']} age={f['max_age']}"
+                    f" sweeps f/p/r={s.get('fresh_goal', 0)}"
+                    f"/{s.get('prime', 0)}/{s.get('repair', 0)}"
+                    f" repairs={f['repairs']}"
+                    f"(+{f['repair_fallbacks']} full)")
+            if f.get("world_seq"):
+                cell += f" world_seq={f['world_seq']}"
+            if f.get("promotions"):
+                cell += f" promoted={f['promotions']}"
+            return cell
+
+        lines.append("FIELD " + " | ".join(
+            _field_cell(peer, p) for peer, p in field_rows))
     # fleet task throughput (ISSUE 7): manager done-counter derivations
     if f.get("tasks_dispatched") is not None:
         ratio = f.get("completion_ratio")

@@ -144,6 +144,52 @@ class MetricsWindow:
         self.bus.close()
 
 
+class WorldToggler:
+    """Dynamic-obstacle injection (ISSUE 9): every ``every`` seconds,
+    reopen the previous wall and ask the manager to close a fresh random
+    vertical wall of ``cells`` cells (world_update_request on "mapd").
+    The MANAGER validates and applies — cells under agents, goals or
+    task endpoints come back rejected, which is the correct behavior,
+    not a harness failure; the pool's world counters record what
+    actually landed."""
+
+    def __init__(self, sim: SimAgentPool, side: int, cells: int,
+                 every: float, seed: int):
+        import random
+
+        self.sim = sim
+        self.side = side
+        self.cells = cells
+        self.every = every
+        self.rng = random.Random(seed)
+        self.prev = []
+        self.next_at = time.monotonic() + every
+        self.sent = 0
+
+    def maybe(self) -> None:
+        if not self.cells or time.monotonic() < self.next_at:
+            return
+        self.next_at = time.monotonic() + self.every
+        toggles = [[x, y, 0] for x, y in self.prev]  # reopen the old wall
+        x0 = self.rng.randrange(2, max(3, self.side - 2))
+        y0 = self.rng.randrange(0, max(1, self.side - self.cells))
+        wall = [(x0, y0 + i) for i in range(self.cells)]
+        toggles += [[x, y, 1] for x, y in wall]
+        self.prev = wall
+        self.sim.bus.publish("mapd", {"type": "world_update_request",
+                                      "toggles": toggles})
+        self.sent += 1
+
+    def reopen_all(self) -> None:
+        """End-of-run cleanup: reopen the last wall so the drain phase
+        (in-flight tasks finishing) faces the static world again."""
+        if self.prev:
+            self.sim.bus.publish(
+                "mapd", {"type": "world_update_request",
+                         "toggles": [[x, y, 0] for x, y in self.prev]})
+            self.prev = []
+
+
 def _timeline_summary(trace_dir: Path) -> dict:
     from analysis import task_timeline
 
@@ -248,6 +294,9 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
                 sim.pump(0.45)
                 watch.pump(0.05)
 
+        toggler = WorldToggler(sim, args.side, args.world_toggle_cells,
+                               args.world_toggle_every, args.seed + 77)
+
         def drive(seconds: float):
             nonlocal next_inject
             end = time.monotonic() + seconds
@@ -255,6 +304,7 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
                 if open_loop and time.monotonic() >= next_inject:
                     next_inject = time.monotonic() + inject_every
                     inject(per_inject)
+                toggler.maybe()
                 sim.pump(0.3)
                 watch.pump(0.05)
 
@@ -267,6 +317,11 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
         t0 = time.monotonic()
         drive(args.window)
         wall = time.monotonic() - t0
+        if toggler.sent:
+            # reopen the final wall so the post-window drain (in-flight
+            # tasks completing, done-acks landing) faces the static map
+            toggler.reopen_all()
+            sim.pump(1.0)
         watch.pump(2.5)  # one more beacon interval: final counters land
 
         rollup = watch.agg.rollup()
@@ -316,6 +371,12 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
                 hist_quantile(claim, 0.99), 3)
             signals["sim.claim_wire_p50_ms"] = round(
                 hist_quantile(claim, 0.5), 3)
+        if toggler.sent:
+            # dynamic-world evidence rides the signals so a spec can
+            # demand toggles actually landed (unknown = exit 2 otherwise)
+            signals["world.requests"] = toggler.sent
+            signals["world.updates_seen"] = sim.world_updates
+            signals["world.toggles_accepted"] = sim.world_accepted
         timeline = None
         if not args.no_trace and trace_dir.exists():
             timeline = _timeline_summary(trace_dir)
@@ -338,6 +399,15 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             "signals": signals,
             "slo": result,
         }
+        if toggler.sent:
+            rung["world"] = {
+                "toggle_cells": args.world_toggle_cells,
+                "toggle_every_s": args.world_toggle_every,
+                "requests": toggler.sent,
+                "updates_seen": sim.world_updates,
+                "toggles_accepted": sim.world_accepted,
+                "toggles_rejected": sim.world_rejected,
+            }
         if timeline is not None:
             rung["timeline"] = timeline
         return rung
@@ -361,6 +431,121 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
         # re-bind the sinks to the restored environment
         _trace.configure(proc="simfleet")
         _events.configure("simfleet")
+
+
+def run_tenant_smoke(args) -> int:
+    """ISSUE 9 satellite (ROADMAP item 2 remaining headroom): admit N
+    tenants DYNAMICALLY through the live ``solver.admit`` tenant_hello
+    flow — orchestrator-style, the way a real control plane would — and
+    prove each namespaced fleet completes tasks on the one solverd.
+    tenant_scaling.py pre-registers via ``--tenants``; this path runs
+    solverd with ``--multi-tenant`` ONLY, so admission happens on the
+    wire."""
+    ensure_built()
+    tenants = [f"ft{i}" for i in range(args.tenants)]
+    port = buspool.free_port()
+    log_dir = Path(args.log_dir) / f"tenant_smoke_{args.tenants}"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    procs, logs = [], []
+
+    def spawn(name, cmd, stdin=None, env=None):
+        log = open(log_dir / f"{name}.log", "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, stdin=stdin, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             env=dict(os.environ, **(env or {})))
+        procs.append(p)
+        return p
+
+    pool = orch = None
+    pools = {}
+    try:
+        pool = buspool.BusPool(BUILD_DIR / "mapd_bus", num_shards=1,
+                               home_port=port, spawn=spawn)
+        os.environ.update(pool.env())
+        time.sleep(0.3)
+        sd = spawn("solverd",
+                   [sys.executable, "-m",
+                    "p2p_distributed_tswap_tpu.runtime.solverd",
+                    "--port", str(port), "--map", args.map_file, "--cpu",
+                    "--multi-tenant",
+                    "--max-tenants", str(args.tenants)])
+        if not wait_for_log(log_dir / "solverd.log", "solverd up", 600,
+                            proc=sd):
+            raise RuntimeError("solverd never became ready")
+        # the orchestrator announces each tenant and waits for its
+        # welcome BEFORE spawning the fleet — plan_requests published
+        # into an unsubscribed topic would be lost
+        orch = BusClient(port=port, peer_id="fleetsim-orch")
+        orch.subscribe("solver.admit")
+        welcomed = set()
+        for ns in tenants:
+            orch.publish("solver.admit",
+                         {"type": "tenant_hello", "ns": ns})
+        deadline = time.monotonic() + 30.0
+        while len(welcomed) < len(tenants) \
+                and time.monotonic() < deadline:
+            f = orch.recv(timeout=0.5)
+            if f and f.get("op") == "msg":
+                d = f.get("data") or {}
+                if d.get("type") == "tenant_welcome":
+                    welcomed.add(d.get("ns"))
+        if welcomed != set(tenants):
+            print(f"tenant smoke FAILED: welcomes {sorted(welcomed)} != "
+                  f"{tenants}", flush=True)
+            return 1
+        print(f"tenant smoke: {len(welcomed)} tenants admitted via "
+              "solver.admit", flush=True)
+        mgrs = {}
+        for ns in tenants:
+            mgrs[ns] = spawn(
+                f"manager_{ns}",
+                [str(BUILD_DIR / "mapd_manager_centralized"),
+                 "--port", str(port), "--map", args.map_file,
+                 "--solver", "tpu",
+                 "--max-tracked-agents", str(args.agents + 8)],
+                stdin=subprocess.PIPE, env={"JG_BUS_NS": ns})
+        time.sleep(0.5)
+        for i, ns in enumerate(tenants):
+            pools[ns] = SimAgentPool(args.agents, args.side, port=port,
+                                     seed=i + 1, peer_id=f"sim-{ns}",
+                                     namespace=ns)
+        for p in pools.values():
+            p.heartbeat_all()
+
+        def pump_all(budget_s: float) -> None:
+            end = time.monotonic() + budget_s
+            while time.monotonic() < end:
+                for p in pools.values():
+                    p.pump(0.05)
+
+        pump_all(2.0)
+        for m in mgrs.values():
+            m.stdin.write(f"tasks {args.agents}\n".encode())
+            m.stdin.flush()
+        pump_all(args.settle + args.window)
+        done = {ns: p.done_count for ns, p in pools.items()}
+        ok = all(v >= 1 for v in done.values())
+        print(f"tenant smoke {'OK' if ok else 'FAILED'}: dynamic "
+              f"admission + per-tenant dones {done}", flush=True)
+        return 0 if ok else 1
+    finally:
+        for p in pools.values():
+            p.close()
+        if orch is not None:
+            orch.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if pool is not None:
+            pool.close()
+        for log in logs:
+            log.close()
+        os.environ.pop(buspool.SHARD_PORTS_ENV, None)
 
 
 def write_artifact(out: Path, doc: dict) -> None:
@@ -444,7 +629,26 @@ def main(argv=None) -> int:
     ap.add_argument("--no-trace", action="store_true",
                     help="skip JG_TRACE (phase-attribution SLOs read "
                          "unknown)")
+    ap.add_argument("--world-toggle-cells", type=int, default=0,
+                    help="dynamic worlds (ISSUE 9): close a random "
+                         "N-cell wall every --world-toggle-every "
+                         "seconds (reopening the previous one); 0 = "
+                         "static world")
+    ap.add_argument("--world-toggle-every", type=float, default=6.0)
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="dynamic-admission smoke (ISSUE 9 satellite): "
+                         "N namespaced fleets admitted LIVE through the "
+                         "solver.admit tenant_hello flow against one "
+                         "--multi-tenant solverd (no pre-registration); "
+                         "exit 0 iff every tenant gets a welcome and "
+                         "completes >= 1 task")
     args = ap.parse_args(argv)
+
+    if args.tenants >= 1:
+        args.map_file = f"/tmp/fleetsim_{args.side}.map.txt"
+        Path(args.map_file).write_text(
+            "\n".join(["." * args.side] * args.side) + "\n")
+        return run_tenant_smoke(args)
 
     args.map_file = f"/tmp/fleetsim_{args.side}.map.txt"
     Path(args.map_file).write_text(

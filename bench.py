@@ -69,6 +69,12 @@ unavailable), the headline also carries a ``fleetsim`` record — rated-load
 fleet tasks/s and the p99 dispatch->claim wire phase from a scaled-down
 ``analysis/fleetsim.py`` run — so the BENCH trajectory tracks end-to-end
 fleet health next to ms/step.
+
+Field-engine axis (ISSUE 9): unless BENCH_FIELD=0, the headline carries a
+``field_engine`` record — ms/field of a full fixpoint resweep vs the
+bounded-region incremental repair (analysis/field_bench.py --quick) plus
+the multi-field-kernel GO/NO-GO verdict — so dynamic-world repair cost
+rides the BENCH trajectory too.
 """
 
 from __future__ import annotations
@@ -633,6 +639,45 @@ def run_fleetsim_axis() -> dict:
     }
 
 
+def run_field_engine_axis() -> dict:
+    """Field-engine rung for the BENCH trajectory (ISSUE 9): ms/field of
+    a full resweep vs the bounded-region incremental repair at CI scale
+    (analysis/field_bench.py --quick).  Failures are recorded, never
+    fatal."""
+    import tempfile
+    from pathlib import Path
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = Path(tempfile.mkdtemp(prefix="jg-bench-field-")) / "fe.json"
+    cmd = [sys.executable,
+           os.path.join(root, "analysis", "field_bench.py"),
+           "--quick", "--out", str(out)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        return {"error": "field_bench timeout"}
+    if not out.exists():
+        return {"error": (proc.stderr or proc.stdout or "no output")[-300:]}
+    try:
+        doc = json.loads(out.read_text())
+    except json.JSONDecodeError as e:
+        return {"error": f"artifact parse: {e}"}
+    r = doc.get("repair_vs_full") or {}
+    return {
+        "grid": r.get("grid"),
+        "full_resweep_ms": r.get("full_resweep_ms_mean"),
+        "repair_ms": r.get("repair_ms_mean"),
+        "repair_speedup": r.get("speedup_vs_full"),
+        "repair_fallbacks": r.get("repair_fallbacks"),
+        "bit_identical": r.get("bit_identical_to_full_recompute"),
+        "multi_field_verdict": (doc.get("multi_field") or {}).get(
+            "verdict"),
+    }
+
+
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
         trace.configure(proc=f"bench-{sys.argv[2]}")
@@ -679,6 +724,9 @@ def main():
             "invariants_ok")
     if os.environ.get("BENCH_FLEETSIM", "1") != "0":
         head["fleetsim"] = run_fleetsim_axis()
+    if os.environ.get("BENCH_FIELD", "1") != "0":
+        # field-engine axis (ISSUE 9): ms/field full vs incremental
+        head["field_engine"] = run_field_engine_axis()
     print(json.dumps(head), flush=True)
 
 

@@ -35,7 +35,13 @@ constexpr uint16_t kVersion = 1;
 constexpr uint8_t kSnapshot = 1;
 constexpr uint8_t kDelta = 2;
 constexpr uint8_t kResponse = 3;
+// world1 (ISSUE 9): obstacle-toggle batch on the unchanged packed1
+// framing — idx[] = flat cells, pos[] = blocked flag (0/1), goal[] =
+// zero padding; seq = the manager's monotone world_seq.  Byte-identical
+// mirror of plan_codec.py encode_world/decode_world.
+constexpr uint8_t kWorld = 4;
 constexpr const char* kCodecName = "packed1";
+constexpr const char* kWorldCap = "world1";
 constexpr int kSnapshotEvery = 64;
 
 // Compact per-message causal context (ISSUE 5 "trace1"): trace_id is
@@ -269,6 +275,21 @@ inline std::optional<Packet> decode(const std::string& buf) {
     }
   }
   if (p.names.size() != n_named) return std::nullopt;
+  return p;
+}
+
+// world1 toggle batch: cells[k] becomes an obstacle when blocked[k] != 0.
+inline Packet encode_world(int64_t world_seq,
+                           const std::vector<int32_t>& cells,
+                           const std::vector<int32_t>& blocked) {
+  Packet p;
+  p.kind = kWorld;
+  p.seq = world_seq;
+  p.base_seq = 0;
+  p.idx = cells;
+  p.pos.reserve(blocked.size());
+  for (int32_t b : blocked) p.pos.push_back(b ? 1 : 0);
+  p.goal.assign(cells.size(), 0);
   return p;
 }
 

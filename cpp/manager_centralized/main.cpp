@@ -114,6 +114,21 @@ int main(int argc, char** argv) {
   // falls back to flat position_update.
   const bool region_gossip =
       knobs.get_int("--region-gossip", "JG_REGION_GOSSIP", 1) != 0;
+  // dynamic worlds (ISSUE 9): accept world_update_request toggles, mutate
+  // the grid, and broadcast caps-negotiated world_update frames.
+  // JG_DYNAMIC_WORLD=0 is the kill switch — requests are counted and
+  // DROPPED, the world1 cap never rides plan_request, and the wire stays
+  // byte-identical to the static build.  A NAMESPACED manager (JG_BUS_NS
+  // set — a tenant on a multi-tenant solverd) defaults OFF: the solverd
+  // grid is shared across tenants and drops tenant-plane world frames,
+  // so accepting toggles here would diverge this fleet's grid from its
+  // planner's (agents walled in by a phantom wall).  An explicit
+  // --dynamic-world/JG_DYNAMIC_WORLD=1 still overrides for
+  // single-tenant-behind-a-namespace setups.
+  const char* ns_env = getenv("JG_BUS_NS");
+  const bool dynamic_world =
+      knobs.get_int("--dynamic-world", "JG_DYNAMIC_WORLD",
+                    (ns_env && *ns_env) ? 0 : 1) != 0;
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -166,6 +181,11 @@ int main(int argc, char** argv) {
 
   std::map<std::string, AgentInfo> agents;
   std::set<std::string> known_left;
+  // cells targeted by move_instructions of the last two planning ticks:
+  // a world toggle must not close a cell an agent is currently walking
+  // into (its position_update lands a beat after the instruction) —
+  // protected alongside positions/goals/task endpoints below
+  std::set<Cell> recent_move_targets, prev_move_targets;
   std::deque<Json> pending_tasks;  // pending_task_requests (ref :367-436)
   // Task ids that were re-queued from a dead/stale agent (at-least-once
   // hazard: the original agent may still be alive and complete the task).
@@ -300,6 +320,16 @@ int main(int argc, char** argv) {
       auto it = agents.find(ids[k]);
       if (it == agents.end()) continue;
       if (next[k] == it->second.pos) continue;  // no-op moves not sent
+      if (!grid.is_free(next[k])) {
+        // dynamic worlds (ISSUE 9): a plan computed against the
+        // pre-toggle mask may still point into a freshly closed cell
+        // until solverd's repair lands — the manager, as the system of
+        // record for the world, never instructs an agent into a wall
+        // (the lane waits a tick; the repaired field routes it around)
+        metrics_count("manager.moves_blocked_world");
+        continue;
+      }
+      recent_move_targets.insert(next[k]);
       Json mi;
       mi.set("type", "move_instruction")
           .set("peer_id", ids[k])
@@ -529,6 +559,9 @@ int main(int argc, char** argv) {
       caps.push_back(Json(codec::kCodecName));
       // trace1 cap: this peer reads trace blocks on packed responses
       if (tctx) caps.push_back(Json("trace1"));
+      // world1 cap (ISSUE 9): this manager may emit world_update frames;
+      // gated so JG_DYNAMIC_WORLD=0 keeps the request bytes identical
+      if (dynamic_world) caps.push_back(Json(codec::kWorldCap));
       Json req;
       req.set("type", "plan_request")
           .set("seq", plan_seq)
@@ -565,6 +598,138 @@ int main(int argc, char** argv) {
     sent_goals = std::move(snap);
     plan_sent_ms = mono_ms();
     bus.publish("solver", req);
+  };
+
+  // ---- dynamic worlds (ISSUE 9) ----
+  // An operator/harness asks for obstacle toggles with
+  //   {"type":"world_update_request","toggles":[[x,y,blocked01],...]}
+  // on "mapd".  The manager is the system of record for the world: it
+  // VALIDATES each toggle (in bounds; a closing cell must be free,
+  // unoccupied, and not a live goal or any task's pickup/delivery — a
+  // wall through a task endpoint would strand the task forever), mutates
+  // its grid, resets the native distance cache, and broadcasts the
+  // accepted batch as caps-negotiated world_update frames: JSON
+  // [x,y,blocked] on "mapd" for agents/harnesses, and packed world1 (or
+  // [cell,blocked] JSON when the plan wire is JSON) on "solver" so the
+  // daemon repairs its cached fields.  The requester gets a
+  // world_update_applied ack with per-toggle rejection reasons.
+  int64_t world_seq = 0;
+  auto handle_world_request = [&](const Json& d) {
+    if (!dynamic_world) {
+      metrics_count("manager.world_updates_ignored");
+      return;
+    }
+    std::set<Cell> protected_cells;
+    auto protect_task = [&](const Json& t) {
+      if (auto p = parse_point(t["pickup"])) protected_cells.insert(*p);
+      if (auto p = parse_point(t["delivery"])) protected_cells.insert(*p);
+    };
+    for (auto& [peer, a] : agents) {
+      protected_cells.insert(a.pos);
+      protected_cells.insert(a.goal);
+      if (a.task) protect_task(*a.task);
+    }
+    for (const auto& t : pending_tasks) protect_task(t);
+    // in-flight moves: instructions already published may not have
+    // echoed back as position_updates yet — closing their target would
+    // wall the walking agent in
+    protected_cells.insert(recent_move_targets.begin(),
+                           recent_move_targets.end());
+    protected_cells.insert(prev_move_targets.begin(),
+                           prev_move_targets.end());
+    std::vector<int32_t> cells, blocked;
+    Json rejected;
+    for (const auto& e : d["toggles"].as_array()) {
+      const auto& arr = e.as_array();
+      if (arr.size() != 3) {
+        // malformed entries must still show in the ack — the requester
+        // reconciles accepted + rejected against what it submitted
+        Json r;
+        r.push_back(Json(static_cast<int64_t>(-1)));
+        r.push_back(Json(static_cast<int64_t>(-1)));
+        r.push_back(Json(std::string("malformed")));
+        rejected.push_back(r);
+        continue;
+      }
+      const int x = static_cast<int>(arr[0].as_int());
+      const int y = static_cast<int>(arr[1].as_int());
+      const bool blk = arr[2].as_int() != 0;
+      auto reject = [&](const char* why) {
+        Json r;
+        r.push_back(Json(static_cast<int64_t>(x)));
+        r.push_back(Json(static_cast<int64_t>(y)));
+        r.push_back(Json(std::string(why)));
+        rejected.push_back(r);
+      };
+      if (!grid.in_bounds(x, y)) {
+        reject("out_of_bounds");
+        continue;
+      }
+      const Cell c = grid.cell(x, y);
+      if ((grid.free[c] != 0) == !blk) {
+        reject("noop");
+        continue;
+      }
+      if (blk && protected_cells.count(c)) {
+        reject("occupied");
+        continue;
+      }
+      grid.free[c] = blk ? 0 : 1;
+      cells.push_back(static_cast<int32_t>(c));
+      blocked.push_back(blk ? 1 : 0);
+    }
+    if (!cells.empty()) {
+      ++world_seq;
+      dc.clear();  // native fields rebuild against the new mask on demand
+      free_cells = grid.free_cells();
+      metrics_count("manager.world_updates");
+      metrics_count("manager.world_toggles",
+                    static_cast<double>(cells.size()));
+      metrics_gauge("manager.world_seq", static_cast<double>(world_seq));
+      Json fleet_toggles;
+      for (size_t k = 0; k < cells.size(); ++k) {
+        Json t;
+        t.push_back(Json(static_cast<int64_t>(grid.x_of(cells[k]))));
+        t.push_back(Json(static_cast<int64_t>(grid.y_of(cells[k]))));
+        t.push_back(Json(static_cast<int64_t>(blocked[k])));
+        fleet_toggles.push_back(t);
+      }
+      Json wu;
+      wu.set("type", "world_update")
+          .set("world_seq", world_seq)
+          .set("toggles", fleet_toggles);
+      bus.publish("mapd", wu);
+      if (solver == "tpu") {
+        Json su;
+        su.set("type", "world_update").set("world_seq", world_seq);
+        if (use_packed) {
+          su.set("codec", codec::kCodecName)
+              .set("data", codec::encode_b64(
+                       codec::encode_world(world_seq, cells, blocked)));
+        } else {
+          Json st;
+          for (size_t k = 0; k < cells.size(); ++k) {
+            Json t;
+            t.push_back(Json(static_cast<int64_t>(cells[k])));
+            t.push_back(Json(static_cast<int64_t>(blocked[k])));
+            st.push_back(t);
+          }
+          su.set("toggles", st);
+        }
+        bus.publish("solver", su);
+      }
+      log_info("🌍 world update %lld: %zu toggle(s) applied, %zu free "
+               "cell(s) remain\n",
+               static_cast<long long>(world_seq), cells.size(),
+               free_cells.size());
+    }
+    if (rejected.is_null()) rejected = Json(JsonArray{});
+    Json ack;
+    ack.set("type", "world_update_applied")
+        .set("world_seq", world_seq)
+        .set("accepted", static_cast<int64_t>(cells.size()))
+        .set("rejected", rejected);
+    bus.publish("mapd", ack);
   };
 
   int64_t last_plan_response = mono_ms();
@@ -858,6 +1023,8 @@ int main(int argc, char** argv) {
               if (auto t = itm->second.total_time())
                 metrics_observe("task.total_time_ms",
                                 static_cast<double>(*t));
+          } else if (type == "world_update_request") {
+            handle_world_request(d);
           } else if (type == "flight_dump") {
             // black-box query: dump the ring and answer with the path
             bus.publish("mapd",
@@ -960,6 +1127,9 @@ int main(int argc, char** argv) {
       trace_count("manager.plan_ticks");
       auto tick_t0 = std::chrono::steady_clock::now();
       last_plan = now;
+      // roll the move-target protection window (last two ticks)
+      prev_move_targets = std::move(recent_move_targets);
+      recent_move_targets.clear();
       pickup_transitions();
       if (!agents.empty()) {
         if (solver == "tpu") {

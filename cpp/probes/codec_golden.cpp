@@ -20,6 +20,11 @@
 //                  stdout: {"shard":k,"subs":[k...]} per line — the
 //                  topic→shard map (cpp/common/shardmap.hpp) the Python
 //                  side asserts choice-identical (ISSUE 6)
+//   --world-encode stdin: one JSON per line
+//                  {"seq":N,"cells":[...],"blocked":[0|1,...],
+//                   "trace":[id,hop,ms]?}
+//                  stdout: one base64 world1 packet per line (ISSUE 9;
+//                  --decode round-trips it like any packed1 kind)
 
 #include <cstdio>
 #include <iostream>
@@ -61,10 +66,11 @@ static Json trace_json(bool has, const codec::TraceCtx& t) {
 int main(int argc, char** argv) {
   const std::string mode = argc > 1 ? argv[1] : "";
   if (mode != "--encode" && mode != "--decode" && mode != "--pos1-encode" &&
-      mode != "--pos1-decode" && mode != "--shardmap") {
+      mode != "--pos1-decode" && mode != "--shardmap" &&
+      mode != "--world-encode") {
     fprintf(stderr,
             "usage: codec_golden --encode|--decode|--pos1-encode|"
-            "--pos1-decode|--shardmap < lines\n");
+            "--pos1-decode|--shardmap|--world-encode < lines\n");
     return 2;
   }
   codec::PackedFleetEncoder enc;
@@ -120,6 +126,28 @@ int main(int argc, char** argv) {
           .set("task", p->has_task ? Json(p->task_id) : Json())
           .set("trace", trace_json(p->has_trace, p->trace));
       printf("%s\n", out.dump().c_str());
+      continue;
+    }
+    if (mode == "--world-encode") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad world script line\n");
+        return 1;
+      }
+      const Json& j = *parsed;
+      std::vector<int32_t> cells, blocked;
+      for (const auto& c : j["cells"].as_array())
+        cells.push_back(static_cast<int32_t>(c.as_int()));
+      for (const auto& b : j["blocked"].as_array())
+        blocked.push_back(static_cast<int32_t>(b.as_int()));
+      codec::Packet pkt = codec::encode_world(j["seq"].as_int(), cells,
+                                              blocked);
+      codec::TraceCtx tc;
+      if (parse_trace(j, &tc)) {
+        pkt.has_trace = true;
+        pkt.trace = tc;
+      }
+      printf("%s\n", codec::encode_b64(pkt).c_str());
       continue;
     }
     if (mode == "--decode") {

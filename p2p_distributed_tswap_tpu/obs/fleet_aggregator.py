@@ -235,6 +235,7 @@ class FleetAggregator:
             "bandwidth": self._rates(st),
             "tick": None,
             "cache": None,
+            "field": None,
             "tasks": None,
             "mgr_tasks": self._mgr_tasks(st),
         }
@@ -283,6 +284,27 @@ class FleetAggregator:
                 "misses": int(misses),
                 "hit_rate": round(hits / (hits + misses), 4),
                 "recompiles": int(counter_total(m, "solverd.recompiles")),
+            }
+        # field-engine health (ISSUE 9): idle-window queue depth + the
+        # starvation age gauge, per-cause sweep counters
+        # (fresh_goal/prime/repair), incremental-repair counters, and the
+        # dynamic-world sequence — solverd beacons only
+        gauges = m.get("gauges") or {}
+        sweeps = counters_by_label(m, "solverd.field_sweeps", "cause")
+        repairs = counter_total(m, "solverd.field_repairs")
+        if sweeps or repairs \
+                or "solverd.field_queue" in gauges:
+            out["field"] = {
+                "queue": int(gauges.get("solverd.field_queue") or 0),
+                "max_age": int(
+                    gauges.get("solverd.field_queue_max_age") or 0),
+                "sweeps": {k: int(v) for k, v in sorted(sweeps.items())},
+                "repairs": int(repairs),
+                "repair_fallbacks": int(
+                    counter_total(m, "solverd.field_repair_fallbacks")),
+                "promotions": int(
+                    counter_total(m, "solverd.field_queue_promotions")),
+                "world_seq": int(gauges.get("solverd.world_seq") or 0),
             }
         if task_hist and task_hist["count"]:
             out["tasks"] = {

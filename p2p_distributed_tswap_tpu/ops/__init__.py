@@ -1,9 +1,14 @@
 """Batched grid ops: BFS distance/direction fields (the production planner
 primitive), their grid-tile-sharded variants (spatial decomposition with
-ppermute halo exchange), and reserved space-time A* (the
+ppermute halo exchange), bounded-region incremental field repair for
+dynamic worlds (field_repair), and reserved space-time A* (the
 prioritized-planning primitive, ref src/algorithm/a_star.rs)."""
 
 from p2p_distributed_tswap_tpu.ops import distance
+from p2p_distributed_tswap_tpu.ops import field_repair  # noqa: F401
+from p2p_distributed_tswap_tpu.ops.field_repair import (  # noqa: F401
+    repair_field,
+)
 from p2p_distributed_tswap_tpu.ops.distance import (
     direction_fields,
     directions_from_distance,

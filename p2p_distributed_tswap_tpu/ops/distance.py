@@ -256,9 +256,13 @@ def direction_fields(free: jnp.ndarray, goals_idx: jnp.ndarray,
 
     Default path: the sweep/extract pipeline below (whose directional
     sweeps dispatch to the Pallas strip kernel on eligible TPU shapes).
-    With MAPD_FUSED=1 (experimental, measured slower — see
-    ops/field_fused.py) VMEM-resident fields instead run as one fused
-    seed -> fixpoint -> codes kernel launch per field."""
+    With MAPD_FUSED=1 (opt-in pending an on-chip measurement — see
+    ops/field_fused.py) VMEM-resident fields instead run fused
+    seed -> fixpoint -> codes kernel launches, EIGHT fields per program
+    packed across sublanes (MAPD_FUSED=single keeps the round-3
+    one-field experiment).  Every consumer — solverd's sweep chunk and
+    prefetch/prime paths included — dispatches through here, so the
+    kernel choice is transparent to the runtime."""
     from p2p_distributed_tswap_tpu.ops import field_fused
 
     h, w = free.shape

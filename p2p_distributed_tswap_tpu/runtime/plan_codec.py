@@ -75,6 +75,16 @@ VERSION = 1
 KIND_SNAPSHOT = 1
 KIND_DELTA = 2
 KIND_RESPONSE = 3
+# world1 (ISSUE 9): an obstacle-toggle batch for dynamic worlds, riding
+# the packed1 framing unchanged — idx[] carries flat cells, pos[] the new
+# blocked flag (0/1), goal[] is all-zero padding so every packed1
+# decoder (py and cpp) parses it with ZERO layout changes; narrow mode
+# and the trace1 block compose exactly like the plan kinds.  seq carries
+# the manager's monotone world_seq.  Caps token: "world1" (advertised on
+# plan_request only while JG_DYNAMIC_WORLD is on, so the static wire
+# stays byte-identical with the switch off).
+KIND_WORLD = 4
+WORLD_CAP = "world1"
 CODEC_NAME = "packed1"
 SNAPSHOT_EVERY = 64  # periodic resync cadence (packets)
 
@@ -361,6 +371,27 @@ class PackedStateDecoder:
                              is_snapshot=pkt.kind == KIND_SNAPSHOT,
                              idx=pkt.idx, pos=pkt.pos, goal=pkt.goal,
                              removed=removed)
+
+
+def encode_world(world_seq: int, cells: Sequence[int],
+                 blocked: Sequence[int],
+                 trace: Optional[TraceCtx] = None) -> Packet:
+    """world1 toggle batch: ``cells[k]`` becomes an obstacle when
+    ``blocked[k]`` is truthy, traversable otherwise."""
+    cells = _i32(cells)
+    flags = _i32([1 if b else 0 for b in blocked])
+    if cells.size != flags.size:
+        raise CodecError("cells/blocked length mismatch")
+    return Packet(kind=KIND_WORLD, seq=world_seq, base_seq=0, idx=cells,
+                  pos=flags, goal=np.zeros(cells.size, np.int32),
+                  trace=trace)
+
+
+def decode_world(pkt: Packet) -> List[Tuple[int, bool]]:
+    """``[(cell, blocked)]`` from a world1 packet."""
+    if pkt.kind != KIND_WORLD:
+        raise CodecError(f"not a world packet (kind {pkt.kind})")
+    return [(int(c), bool(b)) for c, b in zip(pkt.idx, pkt.pos)]
 
 
 def encode_response(seq: int, idx: Sequence[int], next_pos: Sequence[int],

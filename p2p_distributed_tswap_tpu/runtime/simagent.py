@@ -142,6 +142,13 @@ class SimAgentPool:
         self.moves = 0
         self.withdrawn = 0
         self.acked = 0
+        # dynamic worlds (ISSUE 9): sim agents are move-obeying bodies —
+        # routing around a toggled wall is the planner's job — but the
+        # harness needs proof the frames propagated and what the manager
+        # accepted
+        self.world_updates = 0
+        self.world_accepted = 0
+        self.world_rejected = 0
 
     # -- geometry ---------------------------------------------------------
     def _pt(self, c: int) -> List[int]:
@@ -307,6 +314,12 @@ class SimAgentPool:
                 a.picked = False
                 a.tc = None
                 self.withdrawn += 1
+        elif typ == "world_update":
+            self.world_updates += 1
+            _reg.count("sim.world_updates")
+        elif typ == "world_update_applied":
+            self.world_accepted += int(d.get("accepted") or 0)
+            self.world_rejected += len(d.get("rejected") or [])
         elif typ is None and "pickup" in d and "delivery" in d:
             self._on_task(d, now)
 
@@ -357,10 +370,15 @@ class SimAgentPool:
         return sum(1 for a in self.agents.values() if a.task is not None)
 
     def stats(self) -> dict:
-        return {"agents": self.n, "adopted": self.adopted,
-                "done": self.done_count, "acked": self.acked,
-                "moves": self.moves, "withdrawn": self.withdrawn,
-                "busy": self.busy()}
+        out = {"agents": self.n, "adopted": self.adopted,
+               "done": self.done_count, "acked": self.acked,
+               "moves": self.moves, "withdrawn": self.withdrawn,
+               "busy": self.busy()}
+        if self.world_updates or self.world_accepted or self.world_rejected:
+            out["world_updates"] = self.world_updates
+            out["world_accepted"] = self.world_accepted
+            out["world_rejected"] = self.world_rejected
+        return out
 
     def close(self) -> None:
         self.bus.close()

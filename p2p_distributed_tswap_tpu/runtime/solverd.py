@@ -99,9 +99,14 @@ from p2p_distributed_tswap_tpu.obs import events as obs_events
 from p2p_distributed_tswap_tpu.obs import flightrec
 from p2p_distributed_tswap_tpu.obs.beacon import MetricsBeacon
 from p2p_distributed_tswap_tpu.obs.heartbeat import TICK_BUDGET_MS
+from p2p_distributed_tswap_tpu.ops import field_repair
 from p2p_distributed_tswap_tpu.ops.distance import (
+    DIR_DXDY,
+    DIR_STAY,
     PACKED_STAY,
     direction_fields,
+    directions_from_distance,
+    distance_fields,
     pack_directions,
     packed_cells,
 )
@@ -157,6 +162,42 @@ def _pad_pow2_chunk(min_chunk: int, *arrays):
                  for a in arrays)
 
 
+class FieldQueueEntry:
+    """One queued field sweep: its cause (``fresh_goal`` — a lane is
+    parked on the STAY row waiting for it; ``prime`` — a manager
+    prefetch hint; ``repair`` — a world toggle invalidated the cached
+    row) and the queue clock at enqueue time, for the starvation age
+    bound (ISSUE 9 satellite)."""
+
+    __slots__ = ("cause", "enq")
+
+    def __init__(self, cause: str, enq: int):
+        self.cause = cause
+        self.enq = enq
+
+
+def parse_world_update(data: dict) -> Optional[List[Tuple[int, bool]]]:
+    """``[(cell, blocked)]`` from a ``world_update`` message — packed
+    world1 block (``codec: packed1``) or the JSON ``toggles`` list;
+    None on a malformed frame."""
+    if data.get("codec") == pcodec.CODEC_NAME:
+        try:
+            pkt = pcodec.decode_b64(data.get("data") or "")
+            return pcodec.decode_world(pkt)
+        except pcodec.CodecError:
+            return None
+    raw = data.get("toggles")
+    if not isinstance(raw, list):
+        return None
+    out = []
+    for e in raw:
+        try:
+            out.append((int(e[0]), bool(e[1])))
+        except (TypeError, ValueError, IndexError):
+            return None
+    return out
+
+
 class PendingPlan:
     """A dispatched-but-unfetched device step (dispatch-then-poll): holds
     the device output handles plus everything fetch() needs to finish the
@@ -193,6 +234,20 @@ class PlanService:
     # churn bursts retrace the scatter program O(log churn) times, not per
     # distinct delta length.
     SCATTER_CHUNK_MIN = 8
+    # Dynamic-world bookkeeping bounds (ISSUE 9): the toggle log compacts
+    # past this many entries (every cached field then repairs via full
+    # recompute on next touch — correct, just not incremental), and
+    # queued sweeps older than FIELD_QUEUE_MAX_AGE process_field_queue
+    # calls jump the whole queue so sustained fresh-goal churn (which
+    # front-inserts) cannot starve repair/prime entries.
+    WORLD_LOG_MAX = 4096
+    FIELD_QUEUE_MAX_AGE = 8
+    # Host repair-mirror budget: dist (int32) + dirs (uint8) = 5
+    # bytes/cell/goal, UNPACKED (the device cache is nibble-packed
+    # precisely to halve memory, so mirrors need their own ceiling).  A
+    # goal whose mirror is evicted keeps its packed row; its next repair
+    # just falls back to one full recompute.
+    MIRROR_BYTES = 256 << 20
 
     def __init__(self, grid: Grid, capacity_min: int = 16,
                  field_cache: int = 4096):
@@ -207,9 +262,42 @@ class PlanService:
         self.dirs: jnp.ndarray | None = None  # (rows, ceil(HW/8)) packed uint32
         self._step = functools.partial(jax.jit, static_argnums=0)(step_parallel)
         # jitted fixed-chunk sweep: eager per-op dispatch of the doubling
-        # scan cost ~5 s/tick on a 1-core host (stress test, round 3)
-        self._fields = jax.jit(lambda goals: pack_directions(
-            direction_fields(self.free, goals).reshape(goals.shape[0], -1)))
+        # scan cost ~5 s/tick on a 1-core host (stress test, round 3).
+        # ``free`` is an ARGUMENT, not a closure capture: a closure would
+        # bake the mask into the traced program as a constant and world
+        # toggles (apply_world_update) would silently sweep the old world.
+        self._fields = jax.jit(lambda free, goals: pack_directions(
+            direction_fields(free, goals).reshape(goals.shape[0], -1)))
+
+        def _fields_dist_impl(free, goals):
+            # dynamic-world variant: same sweeps, but the raw distance
+            # field and unpacked codes come back too — the host mirrors
+            # incremental repair starts from (ops/field_repair.py)
+            d = distance_fields(free, goals)
+            dirs = directions_from_distance(d, free)
+            return (pack_directions(dirs.reshape(goals.shape[0], -1)),
+                    d, dirs)
+
+        self._fields_dist = jax.jit(_fields_dist_impl)
+        # Dynamic world (ISSUE 9): obstacle cells toggle mid-run via
+        # caps-negotiated world_update messages.  JG_DYNAMIC_WORLD=0 is
+        # the kill switch (updates ignored, zero bookkeeping — the
+        # static path is byte-identical); =1 keeps dist/dirs host
+        # mirrors from process start so the FIRST toggle already repairs
+        # incrementally; unset flips mirror-keeping on lazily at the
+        # first accepted update (pre-existing rows then repair via one
+        # full recompute each).
+        env_dw = os.environ.get("JG_DYNAMIC_WORLD", "")
+        self.dynamic_world = env_dw != "0"
+        self.keep_dist = env_dw == "1"
+        self.free_np = np.asarray(grid.free).copy()
+        self.world_seq = 0
+        self.world_log: List[int] = []      # toggled cells, in order
+        self.dist_mirror: Dict[int, np.ndarray] = {}  # goal -> (H,W) i32
+        self.dirs_mirror: Dict[int, np.ndarray] = {}  # goal -> (H,W) u8
+        self.dist_seq: Dict[int, int] = {}  # goal -> log length at sweep
+        self.max_mirrors = max(16, self.MIRROR_BYTES // (5 * grid.num_cells))
+        self.queue_clock = 0                # process_field_queue calls
         self._last_cap = 0
         self._seen_programs = 0
         # device-resident fleet state (packed fast path); host mirrors stay
@@ -258,6 +346,73 @@ class PlanService:
             c *= 2
         return c
 
+    def _drop_goal(self, g: int) -> int:
+        """Evict one cached goal row: cache entry plus any dynamic-world
+        host mirrors.  Returns the freed row index."""
+        row = self.goal_rows.pop(g)
+        self.dist_mirror.pop(g, None)
+        self.dirs_mirror.pop(g, None)
+        self.dist_seq.pop(g, None)
+        return row
+
+    def _store_mirror(self, g: int, dist_row: np.ndarray,
+                      dirs_row: np.ndarray) -> None:
+        """Keep one goal's repair mirrors, within budget (oldest-first
+        eviction; an evicted goal's next repair full-recomputes) and as
+        COPIES — a view would pin its whole sweep-chunk array long after
+        the chunk-mates evict."""
+        if g not in self.dist_mirror:
+            while len(self.dist_mirror) >= self.max_mirrors:
+                victim = next(iter(self.dist_mirror))
+                self.dist_mirror.pop(victim)
+                self.dirs_mirror.pop(victim, None)
+                registry.get_registry().count("solverd.mirror_evictions")
+        self.dist_mirror[g] = np.array(dist_row)
+        self.dirs_mirror[g] = np.array(dirs_row)
+
+    def _sweep_into_rows(self, goals: List[int], rows: List[int]) -> None:
+        """Sweep ``goals`` in pow2 chunks no larger than FIELD_CHUNK
+        (bounded program count: 1, 2, 4, 8) and scatter their packed
+        rows into ``rows`` with ONE device scatter — each .at[].set on
+        the preallocated buffer copies the whole cache, so a burst must
+        not pay one copy per chunk.  The sub-chunk sizing matters on the
+        CPU fallback, where one 8-wide sweep costs hundreds of ms — a
+        single-goal call must not pay 8x padding waste.  In dynamic
+        mode the host repair mirrors + staleness stamps record per
+        goal.  Shared by the fresh-sweep path (_ensure_fields) and the
+        repair full-recompute fallback (_repair_goals)."""
+        parts = []
+        o, c = 0, self.FIELD_CHUNK
+        while o < len(goals):
+            rem = len(goals) - o
+            take = c if rem >= c else rem
+            size = c if rem >= c else 1 << (take - 1).bit_length()
+            chunk = goals[o:o + take]
+            padded = chunk + [chunk[-1]] * (size - take)
+            gvec = jnp.asarray(padded, jnp.int32)
+            if self.keep_dist:
+                packed, dist, dirs = self._fields_dist(self.free, gvec)
+                parts.append(packed[:take])
+                dist_np = np.asarray(dist[:take])
+                dirs_np = np.asarray(dirs[:take])
+                for j, g in enumerate(chunk):
+                    self._store_mirror(g, dist_np[j], dirs_np[j])
+            else:
+                parts.append(self._fields(self.free, gvec)[:take])
+            o += take
+        for g in goals:
+            self.dist_seq[g] = len(self.world_log)
+        fields = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        self.dirs = self.dirs.at[jnp.asarray(rows, jnp.int32)].set(fields)
+
+    def _is_stale(self, g: int) -> bool:
+        """A cached row swept before the latest world toggle no longer
+        matches the live mask (static runs: world_log stays empty and
+        nothing is ever stale — zero overhead)."""
+        if not self.world_log or g == -1:
+            return False
+        return self.dist_seq.get(g, -1) < len(self.world_log)
+
     def _ensure_fields(self, goals: List[int], min_rows: int = 0) -> None:
         missing = [g for g in dict.fromkeys(goals) if g not in self.goal_rows]
         rows_budget = max(self.max_fields,
@@ -266,6 +421,7 @@ class PlanService:
             # only grows on a capacity jump past the budget
             self._grow_dirs(rows_budget)
         if not missing:
+            self._repair_stale(goals)
             return
         # evict LRU rows when over budget — never a goal of the current
         # request (they sit at the LRU tail because the caller touches
@@ -279,7 +435,7 @@ class PlanService:
                            and g not in keep), None)
             if victim is None:
                 break
-            del self.goal_rows[victim]
+            self._drop_goal(victim)
         if len(self.goal_rows) + len(missing) > self.dirs.shape[0]:
             # every cached row is pinned by live goals: grow the buffer
             self._grow_dirs(self._capacity(len(self.goal_rows)
@@ -287,29 +443,69 @@ class PlanService:
         used = set(self.goal_rows.values())
         free_rows = [r for r in range(self.dirs.shape[0]) if r not in used]
         rows = free_rows[:len(missing)]
-        c = self.FIELD_CHUNK
-        # compute in power-of-two chunks no larger than FIELD_CHUNK
-        # (bounded program count: 1, 2, 4, 8), scatter ONCE: each
-        # .at[].set on the preallocated buffer copies the whole cache, so a
-        # startup burst must not pay one copy per chunk.  The sub-chunk
-        # sizing matters on the CPU fallback, where one 8-wide sweep costs
-        # hundreds of ms — the steady-state single-fresh-goal tick must
-        # not pay 8x padding waste for 1 field.
-        parts = []
-        o = 0
-        while o < len(missing):
-            rem = len(missing) - o
-            take = c if rem >= c else rem
-            size = c if rem >= c else 1 << (take - 1).bit_length()
-            chunk = missing[o:o + take]
-            padded = chunk + [chunk[-1]] * (size - take)
-            parts.append(self._fields(jnp.asarray(padded,
-                                                  jnp.int32))[:take])
-            o += take
-        fields = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        self.dirs = self.dirs.at[jnp.asarray(rows, jnp.int32)].set(fields)
+        self._sweep_into_rows(missing, rows)
         for g, r in zip(missing, rows):
             self.goal_rows[g] = r
+        self._repair_stale(goals)
+
+    def _repair_stale(self, goals: List[int]) -> None:
+        stale = [g for g in dict.fromkeys(goals)
+                 if g in self.goal_rows and self._is_stale(g)]
+        if stale:
+            self._repair_goals(stale)
+
+    def _repair_goals(self, goals: List[int]) -> None:
+        """Bring stale cached rows up to the live mask: bounded-region
+        incremental repair (ops/field_repair.py) where a dist mirror and
+        the toggle suffix exist, full recompute otherwise or when the
+        dirty region overflows.  One batched device scatter for every
+        repaired packed row."""
+        reg = registry.get_registry()
+        rows, packed_rows = [], []
+        fallback = []
+        h, _w = self.free_np.shape
+        for g in goals:
+            if g not in self.goal_rows or not self._is_stale(g):
+                continue
+            seq = self.dist_seq.get(g, -1)
+            mirror = self.dist_mirror.get(g)
+            res = None
+            if mirror is not None and 0 <= seq <= len(self.world_log):
+                t0 = time.perf_counter()
+                res = field_repair.repair_field(mirror, self.free_np,
+                                                self.world_log[seq:])
+                reg.observe("solverd.field_repair_ms",
+                            1000.0 * (time.perf_counter() - t0))
+            if res is None:
+                fallback.append(g)
+                continue
+            new_dist, (y0, y1, x0, x1) = res
+            # direction codes change only where distances (or their row
+            # neighbors') did: re-derive the band, repack the whole row
+            # host-side (no device round trip)
+            b0, b1 = max(0, y0 - 1), min(h, y1 + 1)
+            dirs_m = self.dirs_mirror[g]
+            if b1 > b0:
+                dirs_m[b0:b1] = field_repair.directions_np(
+                    new_dist, self.free_np, b0, b1)
+            self.dist_mirror[g] = new_dist
+            self.dist_seq[g] = len(self.world_log)
+            rows.append(self.goal_rows[g])
+            packed_rows.append(field_repair.pack_rows_np(
+                dirs_m.reshape(-1)))
+            reg.count("solverd.field_repairs")
+            reg.count("solverd.field_sweeps", cause="repair")
+        if rows:
+            self.dirs = self.dirs.at[jnp.asarray(rows, jnp.int32)].set(
+                jnp.asarray(np.stack(packed_rows)))
+        if fallback:
+            # full recompute repairs: recompute into the SAME rows (the
+            # fresh-sweep path would allocate new ones), then re-mirror
+            reg.count("solverd.field_repair_fallbacks", len(fallback))
+            reg.count("solverd.field_sweeps", len(fallback),
+                      cause="repair")
+            self._sweep_into_rows(fallback,
+                                  [self.goal_rows[g] for g in fallback])
 
     # -- stateless legacy path (JSON wire) --------------------------------
 
@@ -327,6 +523,9 @@ class PlanService:
             # absent from this request
             misses = self._count_cache(goals)
         t_sweep0 = time.perf_counter()
+        if misses:
+            registry.get_registry().count("solverd.field_sweeps", misses,
+                                          cause="fresh_goal")
         with trace.span("solverd.field_sweep", fresh_goals=misses,
                         parent="solverd.tick"):
             self._ensure_fields(goals)
@@ -486,7 +685,7 @@ class PlanService:
             victim = next((g for g in self.goal_rows
                            if self.goal_ref.get(g, 0) == 0), None)
             if victim is not None:
-                row = self.goal_rows.pop(victim)
+                row = self._drop_goal(victim)
             else:
                 row = self.dirs.shape[0]
                 self._grow_dirs(self._capacity(row + 1))
@@ -508,18 +707,87 @@ class PlanService:
                 if not s:
                     del self.wait_lanes[g]
 
+    def _queue_goal(self, goal: int, cause: str,
+                    front: bool = False) -> None:
+        """Enqueue (or re-prioritize) one idle-window sweep.  A goal
+        already queued keeps its ORIGINAL enqueue clock (ageing measures
+        true time-in-queue) but upgrades to ``fresh_goal`` when a lane
+        starts waiting on it."""
+        e = self.field_queue.get(goal)
+        if e is None:
+            self.field_queue[goal] = FieldQueueEntry(cause,
+                                                     self.queue_clock)
+        elif cause == "fresh_goal":
+            e.cause = cause
+        if front:
+            self.field_queue.move_to_end(goal, last=False)
+
+    def _queue_gauges(self) -> None:
+        reg = registry.get_registry()
+        reg.gauge("solverd.field_queue", len(self.field_queue))
+        reg.gauge("solverd.field_queue_max_age",
+                  max((self.queue_clock - e.enq
+                       for e in self.field_queue.values()), default=0))
+
+    def _pop_field_queue(self, budget: int) -> List[Tuple[int, "FieldQueueEntry"]]:
+        """Pop up to ``budget`` queued sweeps, oldest-starved first: any
+        entry older than FIELD_QUEUE_MAX_AGE process calls jumps the
+        whole queue (fresh-goal churn front-inserts on every tick and
+        would otherwise starve repair/prime entries forever)."""
+        self.queue_clock += 1
+        aged = [g for g, e in self.field_queue.items()
+                if self.queue_clock - e.enq > self.FIELD_QUEUE_MAX_AGE]
+        # promote oldest to the very front (front-insertion reverses, so
+        # iterate youngest-first)
+        for g in sorted(aged, key=lambda g: self.field_queue[g].enq,
+                        reverse=True):
+            self.field_queue.move_to_end(g, last=False)
+        if aged:
+            registry.get_registry().count("solverd.field_queue_promotions",
+                                          len(aged))
+        popped = []
+        while self.field_queue and len(popped) < budget:
+            popped.append(self.field_queue.popitem(last=False))
+        self._queue_gauges()
+        return popped
+
+    def _sweep_popped(self, popped) -> None:
+        """Shared idle-window work for popped queue entries: sweep the
+        missing rows, repair the stale ones, count per cause."""
+        reg = registry.get_registry()
+        missing = [g for g, _ in popped if g not in self.goal_rows]
+        by_cause: Dict[str, int] = {}
+        for g, e in popped:
+            # cached-but-stale entries are counted by _repair_goals
+            # (cause=repair, whatever cause queued them) — counting them
+            # here too would double-report the one repair performed
+            if g not in self.goal_rows:
+                by_cause[e.cause] = by_cause.get(e.cause, 0) + 1
+        for cause, n in by_cause.items():
+            if cause != "repair":
+                reg.count("solverd.field_sweeps", n, cause=cause)
+        if missing:
+            with trace.span("solverd.field_prefetch", goals=len(missing)):
+                self._ensure_fields(missing, min_rows=len(self.goal_ref))
+            reg.count("solverd.prefetched_fields", len(missing))
+        self._repair_stale([g for g, _ in popped])
+
     def _slot_of(self, lane: int, goal: int) -> int:
         """Field row for a lane's goal; with deferred fields on, a missing
         row parks the lane on the STAY row and queues the sweep (front of
-        the queue: a waiting agent outranks speculative prefetch)."""
+        the queue: a waiting agent outranks speculative prefetch).  A
+        stale cached row (world toggle since its sweep) serves as-is —
+        the STAY safety patch keeps it wall-legal — with its repair
+        queued for the idle window."""
         self._unwait(lane)
         row = self.goal_rows.get(goal)
         if row is not None:
+            if self._is_stale(goal):
+                self._queue_goal(goal, "repair")
             return row
         self.lane_wait[lane] = goal
         self.wait_lanes.setdefault(goal, set()).add(lane)
-        self.field_queue[goal] = None
-        self.field_queue.move_to_end(goal, last=False)
+        self._queue_goal(goal, "fresh_goal", front=True)
         return self._stay_row()
 
     def prefetch_goals(self, cells) -> None:
@@ -533,9 +801,8 @@ class PlanService:
                 continue
             if 0 <= g < self.grid.num_cells and g not in self.goal_rows \
                     and g not in self.field_queue:
-                self.field_queue[g] = None
-        registry.get_registry().gauge("solverd.field_queue",
-                                      len(self.field_queue))
+                self._queue_goal(g, "prime")
+        self._queue_gauges()
 
     def process_field_queue(self, max_goals: Optional[int] = None) -> int:
         """Sweep up to one chunk of queued goal fields (called from the
@@ -544,18 +811,9 @@ class PlanService:
         if not self.field_queue:
             return 0
         budget = max_goals or self.FIELD_CHUNK
-        popped = []
-        while self.field_queue and len(popped) < budget:
-            g, _ = self.field_queue.popitem(last=False)
-            popped.append(g)
-        missing = [g for g in popped if g not in self.goal_rows]
-        if missing:
-            with trace.span("solverd.field_prefetch", goals=len(missing)):
-                self._ensure_fields(missing, min_rows=len(self.goal_ref))
-            registry.get_registry().count("solverd.prefetched_fields",
-                                          len(missing))
-        registry.get_registry().gauge("solverd.field_queue",
-                                      len(self.field_queue))
+        popped_entries = self._pop_field_queue(budget)
+        self._sweep_popped(popped_entries)
+        popped = [g for g, _ in popped_entries]
         # repair waiters for EVERY popped goal, not just freshly swept
         # ones — a goal can enter goal_rows through another request path
         # (e.g. a legacy JSON peer on the same daemon) while queued, and
@@ -579,6 +837,100 @@ class PlanService:
                                 self.h_active[la].copy())
         return len(popped)
 
+    # -- dynamic world (ISSUE 9) ------------------------------------------
+
+    def apply_world_update(self, toggles: List[Tuple[int, bool]]) -> int:
+        """Fold one obstacle-toggle batch into the live mask.
+
+        Returns the number of cells whose state actually changed.  Per
+        accepted batch: the host+device masks update, every cached row
+        gets a STAY safety patch so no stale field can point an agent
+        INTO a newly blocked cell before its repair lands, live (pinned)
+        cached goals enqueue ``repair`` sweeps for the idle window, and
+        unpinned rows repair lazily on next touch (_slot_of)."""
+        if not self.dynamic_world:
+            return 0
+        flat = self.free_np.reshape(-1)
+        changed = []
+        for c, blocked in toggles:
+            c = int(c)
+            if not 0 <= c < self.grid.num_cells:
+                continue
+            if bool(flat[c]) != (not blocked):
+                flat[c] = not blocked
+                changed.append((c, bool(blocked)))
+        if not changed:
+            return 0
+        self.world_seq += 1
+        self.keep_dist = True
+        if len(self.world_log) + len(changed) > self.WORLD_LOG_MAX:
+            # log compaction: drop history — every cached row becomes
+            # fully stale and repairs via full recompute on next touch
+            # (correct, just not incremental)
+            self.world_log = []
+            self.dist_seq = {}
+            registry.get_registry().count("solverd.world_log_compactions")
+        self.world_log.extend(c for c, _ in changed)
+        self.free = jnp.asarray(self.free_np)
+        newly_blocked = [c for c, b in changed if b]
+        if newly_blocked and self.dirs is not None:
+            self._stay_patch(newly_blocked)
+        for g in list(self.goal_rows):
+            if g != -1 and self.goal_ref.get(g, 0) > 0 \
+                    and self._is_stale(g):
+                self._queue_goal(g, "repair")
+        self._queue_gauges()
+        reg = registry.get_registry()
+        reg.count("solverd.world_toggles", len(changed))
+        reg.gauge("solverd.world_seq", self.world_seq)
+        return len(changed)
+
+    def _stay_patch(self, blocked_cells: List[int]) -> None:
+        """Wall-safety overlay on EVERY cached packed row: a newly
+        blocked cell's own code becomes STAY, and any neighbor whose
+        code points INTO it becomes STAY (the lane waits in place until
+        the exact repair computes the detour).  One gather + one scatter
+        over the affected packed words across all rows."""
+        h, w = self.free_np.shape
+        # word index -> [(nibble, required_code | None)]; None forces STAY
+        words: Dict[int, list] = {}
+        for c in blocked_cells:
+            words.setdefault(c >> 3, []).append((c & 7, None))
+            cy, cx = divmod(c, w)
+            for k, (dx, dy) in enumerate(DIR_DXDY):
+                nx, ny = cx - dx, cy - dy  # neighbor whose code k lands on c
+                if 0 <= nx < w and 0 <= ny < h:
+                    n = ny * w + nx
+                    words.setdefault(n >> 3, []).append((n & 7, k))
+        cols = sorted(words)
+        # np.asarray of a device buffer is read-only — copy before patching
+        cur = np.array(self.dirs[:, jnp.asarray(cols, jnp.int32)])
+        stay = np.uint32(DIR_STAY)
+        for j, wi in enumerate(cols):
+            for nib, req in words[wi]:
+                shift = np.uint32(4 * nib)
+                keep = np.uint32(0xFFFFFFFF) ^ (np.uint32(0xF) << shift)
+                vals = (cur[:, j] >> shift) & np.uint32(0xF)
+                hit = np.ones(cur.shape[0], bool) if req is None \
+                    else vals == req
+                patched = (cur[:, j] & keep) | (stay << shift)
+                cur[:, j] = np.where(hit, patched, cur[:, j])
+        self.dirs = self.dirs.at[:, jnp.asarray(cols, jnp.int32)].set(
+            jnp.asarray(cur))
+        # host dirs mirrors get the same overlay (repair re-derives the
+        # exact band from the repaired distances later)
+        for dirs_m in self.dirs_mirror.values():
+            flat = dirs_m.reshape(-1)
+            for c in blocked_cells:
+                flat[c] = DIR_STAY
+                cy, cx = divmod(c, w)
+                for k, (dx, dy) in enumerate(DIR_DXDY):
+                    nx, ny = cx - dx, cy - dy
+                    if 0 <= nx < w and 0 <= ny < h:
+                        n = ny * w + nx
+                        if flat[n] == k:
+                            flat[n] = DIR_STAY
+
     def _scatter_lanes(self, lanes, vp, vg, vs, va) -> None:
         """O(churn) device update: scatter per-lane values into the
         resident arrays, pow2-chunk-padded (see _pad_pow2_chunk)."""
@@ -599,6 +951,9 @@ class PlanService:
         misses = self._count_cache(goals)
         if self.defer_fields:
             return
+        if misses:
+            registry.get_registry().count("solverd.field_sweeps", misses,
+                                          cause="fresh_goal")
         with trace.span("solverd.field_sweep", fresh_goals=misses,
                         parent="solverd.tick"):
             self._ensure_fields(goals, min_rows=len(self.goal_ref))
@@ -704,6 +1059,27 @@ class PlanService:
         p.t_plan0 = p.t_sweep0 = p.t_disp0 = t0
         p.t_disp_end = time.perf_counter()
         return p
+
+
+def apply_world_frame(service: PlanService, reg, data: dict) -> int:
+    """One ``world_update`` frame into the service — shared by the
+    single-tenant TickRunner and the multi-tenant runner.  With
+    JG_DYNAMIC_WORLD=0 the frame is counted and DROPPED (the static
+    pipeline stays byte-identical)."""
+    if not service.dynamic_world:
+        reg.count("solverd.world_updates_ignored")
+        return 0
+    toggles = parse_world_update(data)
+    if toggles is None:
+        reg.count("solverd.bad_packets")
+        return 0
+    n = service.apply_world_update(toggles)
+    reg.count("solverd.world_updates")
+    if n:
+        print(f"🌍 world_update (seq {data.get('world_seq')}): {n} "
+              f"cell(s) toggled, {len(service.field_queue)} repair(s) "
+              f"queued", flush=True)
+    return n
 
 
 class PendingTick:
@@ -959,10 +1335,17 @@ class TickRunner:
         """plan_request dict -> plan_response dict (None for empty fleets
         or non-planning packets) — the synchronous decode->plan->encode
         path tests and simple drivers use."""
+        if data.get("type") == "world_update":
+            self.handle_world(data)
+            return None
         pending = self.begin() if self.ingest(data) else None
         if pending is None:
             return None
         return self.finish(pending)
+
+    def handle_world(self, data: dict) -> int:
+        """Dynamic-world toggle frame (ISSUE 9): see apply_world_frame."""
+        return apply_world_frame(self.service, self.registry, data)
 
     def stats(self) -> dict:
         """Machine-readable daemon state: tracer snapshot + service view."""
@@ -983,6 +1366,10 @@ class TickRunner:
             "defer_fields": svc.defer_fields,
             "field_queue": len(svc.field_queue),
             "deferred_lanes": len(svc.lane_wait),
+            "dynamic_world": svc.dynamic_world,
+            "world_seq": svc.world_seq,
+            "world_log": len(svc.world_log),
+            "dist_mirrors": len(svc.dist_mirror),
             "last_phase_ms": {k: round(v, 3)
                               for k, v in svc.last_phase_ms.items()},
         }
@@ -1208,16 +1595,18 @@ class TenantSlab:
     def _slot_of(self, row: int, lane: int, goal: int) -> int:
         """Field row for a lane's goal; a missing row parks the lane on
         the shared STAY row and front-queues the sweep (a waiting agent
-        outranks speculative prefetch)."""
+        outranks speculative prefetch).  Stale rows (world toggle since
+        their sweep) queue a repair, like the flat path."""
         svc = self.service
         self._unwait(row, lane)
         r = svc.goal_rows.get(goal)
         if r is not None:
+            if svc._is_stale(goal):
+                svc._queue_goal(goal, "repair")
             return r
         self.lane_wait[(row, lane)] = goal
         self.wait_lanes.setdefault(goal, set()).add((row, lane))
-        svc.field_queue[goal] = None
-        svc.field_queue.move_to_end(goal, last=False)
+        svc._queue_goal(goal, "fresh_goal", front=True)
         return svc._stay_row()
 
     def _ensure_rows_or_defer(self, goals: List[int]) -> None:
@@ -1225,6 +1614,9 @@ class TenantSlab:
         misses = svc._count_cache(goals)
         if svc.defer_fields:
             return
+        if misses:
+            registry.get_registry().count("solverd.field_sweeps", misses,
+                                          cause="fresh_goal")
         with trace.span("solverd.field_sweep", fresh_goals=misses,
                         parent="solverd.tick"):
             svc._ensure_fields(goals, min_rows=len(svc.goal_ref))
@@ -1232,23 +1624,15 @@ class TenantSlab:
     def process_field_queue(self, max_goals: Optional[int] = None) -> int:
         """Idle-window sweep of queued goal fields + repair of slab lanes
         parked on the STAY row (the multi-tenant analog of
-        PlanService.process_field_queue)."""
+        PlanService.process_field_queue; popping, ageing promotion and
+        per-cause counting are the SHARED service helpers)."""
         svc = self.service
         if not svc.field_queue:
             return 0
         budget = max_goals or PlanService.FIELD_CHUNK
-        popped = []
-        while svc.field_queue and len(popped) < budget:
-            g, _ = svc.field_queue.popitem(last=False)
-            popped.append(g)
-        missing = [g for g in popped if g not in svc.goal_rows]
-        if missing:
-            with trace.span("solverd.field_prefetch", goals=len(missing)):
-                svc._ensure_fields(missing, min_rows=len(svc.goal_ref))
-            registry.get_registry().count("solverd.prefetched_fields",
-                                          len(missing))
-        registry.get_registry().gauge("solverd.field_queue",
-                                      len(svc.field_queue))
+        popped_entries = svc._pop_field_queue(budget)
+        svc._sweep_popped(popped_entries)
+        popped = [g for g, _ in popped_entries]
         by_row: Dict[int, List[Tuple[int, int]]] = {}
         for g in popped:
             for key in sorted(self.wait_lanes.pop(g, ())):
@@ -1524,6 +1908,11 @@ class MultiTenantRunner:
         self.pending_reqs[ns] = req
         return True
 
+    def handle_world(self, data: dict) -> int:
+        """Operator-plane dynamic-world toggle (ISSUE 9): the shared
+        grid mutates for every tenant at once."""
+        return apply_world_frame(self.slab.service, self.registry, data)
+
     def flush_snapshot_requests(self) -> None:
         for t in self.tenants.values():
             if t.snapshot_needed:
@@ -1644,6 +2033,8 @@ class MultiTenantRunner:
             "defer_fields": svc.defer_fields,
             "field_queue": len(svc.field_queue),
             "deferred_lanes": len(self.slab.lane_wait),
+            "dynamic_world": svc.dynamic_world,
+            "world_seq": svc.world_seq,
         }
         snap["network"] = self.registry.network_summary()
         return snap
@@ -1701,6 +2092,19 @@ def multi_tenant_loop(bus: BusClient, runner: MultiTenantRunner,
                 "type": "flight_dump_response", "proc": "solverd",
                 "peer_id": "solverd", "path": path,
                 "events": len(flightrec.get_recorder())}, raw=True)
+            return None
+        if typ == "world_update":
+            # The grid is SHARED across every tenant's slab row, so only
+            # the UN-NAMESPACED operator plane may mutate it — a single
+            # tenant's manager must not re-shape every other fleet's
+            # world.  (Namespaced C++ managers default dynamic-world OFF
+            # for exactly this reason — their grids must not diverge
+            # from a planner that drops their frames; per-tenant masks
+            # are ROADMAP headroom.)
+            if ns == "":
+                runner.handle_world(data)
+            else:
+                runner.registry.count("solverd.world_updates_ignored")
             return None
         if typ != "plan_request":
             return None
@@ -1860,7 +2264,14 @@ def main(argv=None) -> int:
         # task churn arrives a goal or two per tick and must not pay a
         # first-use compile mid-fleet
         for size in (1, 2, 4):
-            service._fields(jnp.asarray([int(sel[0])] * size, jnp.int32))
+            gvec = jnp.asarray([int(sel[0])] * size, jnp.int32)
+            if service.keep_dist:
+                # dynamic mode sweeps through the dist-returning variant
+                # — warming the packed-only program would leave the live
+                # path cold and pay the compile mid-fleet
+                service._fields_dist(service.free, gvec)
+            else:
+                service._fields(service.free, gvec)
         print(f"🔥 pre-warmed: capacity {service._capacity(n)} step "
               f"program, field chunk programs, {n} field rows in "
               f"{time.perf_counter() - t0:.1f}s", flush=True)
@@ -1969,6 +2380,11 @@ def main(argv=None) -> int:
                 "peer_id": "solverd", "path": path,
                 "events": len(flightrec.get_recorder())})
             continue
+        if data.get("type") == "world_update":
+            # dynamic world (ISSUE 9): toggle the mask, STAY-patch the
+            # cache, queue repairs — never stalls the tick path
+            runner.handle_world(data)
+            continue
         if data.get("type") != "plan_request":
             continue
         # Staleness drop: if planning fell behind the manager's tick (slow
@@ -1994,6 +2410,10 @@ def main(argv=None) -> int:
                 # a stats_request queued behind plan_requests must not be
                 # swallowed by the stale drain — answer it right here
                 answer_stats()
+            elif ndata.get("type") == "world_update":
+                # world toggles are ORDER-SENSITIVE against the deltas
+                # around them and must not vanish in a drain either
+                runner.handle_world(ndata)
         for stale_req in reqs[:-1]:
             runner.ingest(stale_req, stale=True)
         ok = runner.ingest(reqs[-1])

@@ -34,11 +34,15 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/runtime/simagent.py \
     p2p_distributed_tswap_tpu/runtime/busns.py \
     p2p_distributed_tswap_tpu/runtime/solverd.py \
+    p2p_distributed_tswap_tpu/ops/field_repair.py \
+    p2p_distributed_tswap_tpu/ops/field_fused.py \
     p2p_distributed_tswap_tpu/obs/slo.py \
     analysis/fleetsim.py \
     analysis/tenant_scaling.py \
+    analysis/field_bench.py \
     scripts/bus_smoke.py \
     scripts/trace_smoke.py \
+    scripts/field_fuzz.py \
     bench.py
 echo "syntax OK"
 
@@ -51,6 +55,12 @@ echo "== codec fuzz gate =="
 # packed encoders must be byte-identical and resident packed planning
 # must equal stateless JSON planning; plus pos1 beacon fuzz (ISSUE 4)
 JAX_PLATFORMS=cpu python scripts/codec_fuzz.py
+
+echo "== field-repair fuzz gate =="
+# ISSUE 9: random obstacle-toggle sequences through the bounded-region
+# repair must stay bit-identical to full recompute (chained, so drift
+# compounds), incl. ROI-overflow fallback + freed-door window growth
+JAX_PLATFORMS=cpu python scripts/field_fuzz.py
 
 echo "== busd relay micro-smoke =="
 # N-client fanout sanity under the fast relay framing (ISSUE 4): fast +
@@ -97,6 +107,49 @@ then
     echo "fleetsim gate OK (breach drill tripped as expected)"
 else
     echo "fleetsim gate SKIPPED (no C++ toolchain / binaries)"
+fi
+
+echo "== dynamic-world smoke =="
+# ISSUE 9: a live fleet (busd + manager --solver tpu + solverd + sim
+# pool) with walls closing every few seconds via world_update_request;
+# the incremental field repairs must route the fleet around them —
+# completion ratio 1.0 and >= 1 accepted toggle, judged from the
+# artifact the run writes.
+if [[ -x cpp/build/mapd_bus ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    # fresh artifact every run: a stale file from a previous invocation
+    # must never pass the gate for a build whose run crashed early
+    rm -f /tmp/jg_dynworld_ci.json
+    JAX_PLATFORMS=cpu python analysis/fleetsim.py \
+        --agents 12 --side 24 --tick-ms 250 --solver tpu \
+        --settle 12 --window 15 --seed 1 --no-trace \
+        --world-toggle-cells 5 --world-toggle-every 5 \
+        --spec scripts/fleetsim_ci.spec.json \
+        --out /tmp/jg_dynworld_ci.json \
+        --log-dir /tmp/jg_dynworld_ci_logs || true
+    JAX_PLATFORMS=cpu python - <<'PY'
+import json, sys
+r = json.load(open("/tmp/jg_dynworld_ci.json"))["rungs"][0]
+sig = r["signals"]
+world = r.get("world") or {}
+ok = (sig.get("fleet.completion_ratio") == 1.0
+      and world.get("toggles_accepted", 0) >= 1
+      and world.get("updates_seen", 0) >= 1)
+print("dynamic-world smoke:", json.dumps({
+    "completion": sig.get("fleet.completion_ratio"),
+    "world": world}))
+sys.exit(0 if ok else 1)
+PY
+    echo "dynamic-world smoke OK"
+    # ISSUE 9 satellite (ROADMAP item 2 headroom): N tenants admitted
+    # LIVE through solver.admit tenant_hello — exit 0 iff every tenant
+    # is welcomed and completes >= 1 task
+    JAX_PLATFORMS=cpu python analysis/fleetsim.py --tenants 2 \
+        --agents 4 --side 24 --settle 10 --window 25 \
+        --log-dir /tmp/jg_dynworld_ci_logs
+else
+    echo "dynamic-world smoke SKIPPED (no C++ toolchain / binaries)"
 fi
 
 echo "== multi-tenant smoke =="

@@ -153,6 +153,54 @@ def pos1_fuzz(seed: int, count: int = 200) -> bool:
     return True
 
 
+def world_fuzz(seed: int, count: int = 100) -> bool:
+    """Random world1 toggle batches (ISSUE 9): py round-trip, py<->cpp
+    byte identity (narrow + wide + trace1 composition), and decode_world
+    rejection of non-world kinds.  Returns False when the golden binary
+    is unavailable (pure-python checks still ran)."""
+    rng = np.random.default_rng(seed)
+    cases = []
+    for k in range(count):
+        hi = 1 << 20 if rng.random() < 0.4 else 65536  # wide vs narrow
+        n = int(rng.integers(1, 12))
+        cells = [int(c) for c in rng.integers(0, hi, size=n)]
+        blocked = [int(b) for b in rng.integers(0, 2, size=n)]
+        trace = None
+        if rng.random() < 0.5:
+            trace = pc.TraceCtx(int(rng.integers(1, 1 << 52)),
+                                int(rng.integers(0, 1 << 16)),
+                                int(rng.integers(1, 1 << 44)))
+        pkt = pc.encode_world(k + 1, cells, blocked, trace=trace)
+        b64 = pc.encode_b64(pkt)
+        back = pc.decode_b64(b64)
+        assert back.kind == pc.KIND_WORLD and back.seq == k + 1
+        assert back.trace == trace, f"world seed {seed}: trace diverged"
+        assert pc.decode_world(back) == \
+            [(c, bool(b)) for c, b in zip(cells, blocked)], \
+            f"world seed {seed}: round-trip diverged"
+        cases.append((cells, blocked, trace, b64))
+    try:
+        pc.decode_world(pc.Packet(kind=pc.KIND_DELTA, seq=1))
+        raise AssertionError("decode_world accepted a delta packet")
+    except pc.CodecError:
+        pass
+    binary = _golden_binary()
+    if binary is None:
+        return False
+    feed = "\n".join(
+        '{"seq":%d,"cells":[%s],"blocked":[%s]%s}' % (
+            k + 1, ",".join(map(str, cells)), ",".join(map(str, blocked)),
+            "" if tr is None else
+            ',"trace":[%d,%d,%d]' % (tr.trace_id, tr.hop, tr.send_ms))
+        for k, (cells, blocked, tr, _) in enumerate(cases)) + "\n"
+    out = subprocess.run([str(binary), "--world-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert out.stdout.split() == [b64 for _, _, _, b64 in cases], \
+        f"world seed {seed}: cpp encoder bytes diverged"
+    return True
+
+
 def golden_fuzz(lines_by_seed: dict) -> bool:
     binary = _golden_binary()
     if binary is None:
@@ -246,6 +294,13 @@ def main() -> int:
               "byte-identical, malformed rejected")
     else:
         print("pos1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
+              file=sys.stderr)
+    world_native = all([world_fuzz(seed) for seed in range(args.seeds)])
+    if world_native:
+        print(f"world1 fuzz: {args.seeds} seeds round-trip, cpp "
+              "byte-identical")
+    else:
+        print("world1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
               file=sys.stderr)
     if not args.skip_plans:
         for seed in range(2):

@@ -1,0 +1,173 @@
+"""ops/field_repair.py — bounded-region repair must be EXACT.
+
+The contract is bit-identity with a full recompute after any toggle
+sequence: random grids, random obstacle add/remove batches applied
+cumulatively (each repair starts from the previous repaired field, so
+errors would compound and surface), plus the targeted edges — long-range
+decrease through a freed door (window growth), dirty-region overflow
+(fallback to None), a blocked goal, and the direction/pack helpers.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.ops import distance, field_repair
+
+
+def _full(free_np: np.ndarray, goal: int) -> np.ndarray:
+    return np.asarray(distance.distance_fields(
+        jnp.asarray(free_np), jnp.asarray([goal], np.int32)))[0]
+
+
+def _full_dirs(free_np: np.ndarray, goal: int) -> np.ndarray:
+    d = distance.distance_fields(jnp.asarray(free_np),
+                                 jnp.asarray([goal], np.int32))
+    return np.asarray(distance.directions_from_distance(
+        d, jnp.asarray(free_np)))[0]
+
+
+def _random_world(rng, h, w, p_block=0.25):
+    free = rng.random((h, w)) > p_block
+    # keep the goal on a free cell of the largest useful area
+    cells = np.flatnonzero(free.reshape(-1))
+    goal = int(rng.choice(cells))
+    return free, goal
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_toggle_sequences_bit_identical(seed):
+    """Cumulative random toggle batches: every repaired field equals the
+    full recompute, and the repair CHAIN (field N repairs field N-1's
+    output) never drifts."""
+    rng = np.random.default_rng(seed)
+    h = w = 24
+    free, goal = _random_world(rng, h, w)
+    free = free.copy()
+    free.reshape(-1)[goal] = True
+    dist = _full(free, goal)
+    for _ in range(8):
+        k = int(rng.integers(1, 4))
+        cand = [c for c in rng.integers(0, h * w, size=16).tolist()
+                if c != goal][:k]
+        if not cand:
+            continue
+        for c in cand:
+            free.reshape(-1)[c] = ~free.reshape(-1)[c]
+        res = field_repair.repair_field(dist, free, cand)
+        ref = _full(free, goal)
+        if res is None:
+            # overflow fallback is allowed — but then the caller full-
+            # recomputes; emulate that so the chain continues
+            dist = ref
+            continue
+        new_dist, (y0, y1, x0, x1) = res
+        np.testing.assert_array_equal(new_dist, ref)
+        # nothing outside the reported box may have changed
+        outside = np.ones((h, w), bool)
+        outside[y0:y1, x0:x1] = False
+        np.testing.assert_array_equal(new_dist[outside], dist[outside])
+        dist = new_dist
+
+
+def test_freed_door_long_range_decrease_grows_window():
+    """A wall splits the grid; the goal side serves one half.  Freeing
+    the single door cell re-routes the ENTIRE far half — decreases must
+    propagate past any small first window (rim check -> growth) and the
+    result must still be exact."""
+    h = w = 32
+    free = np.ones((h, w), bool)
+    free[:, 16] = False
+    goal = 5 * w + 3
+    dist = _full(free, goal)
+    assert (dist[:, 17:] >= field_repair.INF).all()  # far half unreachable
+    door = 8 * w + 16
+    free.reshape(-1)[door] = True
+    res = field_repair.repair_field(dist, free, [door])
+    ref = _full(free, goal)
+    if res is not None:  # may legitimately overflow to fallback
+        np.testing.assert_array_equal(res[0], ref)
+    else:
+        pytest.skip("overflowed to full-resweep fallback (allowed)")
+
+
+def test_wall_close_reroutes_exactly():
+    """Blocking a corridor cell forces a detour: the invalidation
+    cascade must catch every cell whose paths all crossed it."""
+    h = w = 24
+    free = np.ones((h, w), bool)
+    free[10, 1:23] = False
+    free[10, 12] = True  # the only gap
+    goal = 2 * w + 12
+    dist = _full(free, goal)
+    free[10, 12] = False  # close the gap: the far half detours via the
+    # open border columns — a large but bounded re-route.  Thresholds
+    # lifted so the EXACT repair path (not the fallback) is exercised.
+    res = field_repair.repair_field(dist, free, [10 * w + 12],
+                                    max_dirty=h * w, max_window=h * w)
+    ref = _full(free, goal)
+    assert res is not None
+    np.testing.assert_array_equal(res[0], ref)
+    # default thresholds legitimately overflow to the fallback here
+    assert field_repair.repair_field(dist, free, [10 * w + 12]) is None
+
+
+def test_dirty_overflow_falls_back():
+    """Blocking the goal's only neighbor corridor invalidates nearly the
+    whole grid; with a tiny max_dirty the repair must return None, never
+    a wrong field."""
+    h = w = 16
+    free = np.ones((h, w), bool)
+    goal = 0
+    dist = _full(free, goal)
+    # wall off the goal's column corridor: huge invalidation
+    free[1, :] = False
+    toggles = [1 * w + x for x in range(w)]
+    res = field_repair.repair_field(dist, free, toggles, max_dirty=4)
+    assert res is None
+
+
+def test_blocked_goal_repairs_or_falls_back():
+    h = w = 12
+    free = np.ones((h, w), bool)
+    goal = 5 * w + 5
+    dist = _full(free, goal)
+    free.reshape(-1)[goal] = False
+    res = field_repair.repair_field(dist, free, [goal])
+    ref = _full(free, goal)  # all-INF by convention
+    if res is not None:
+        np.testing.assert_array_equal(res[0], ref)
+
+
+def test_noop_toggle_returns_unchanged():
+    h = w = 8
+    free = np.ones((h, w), bool)
+    goal = 3
+    dist = _full(free, goal)
+    res = field_repair.repair_field(dist, free, [])
+    assert res is not None
+    np.testing.assert_array_equal(res[0], dist)
+
+
+def test_directions_np_matches_reference_band_and_full():
+    rng = np.random.default_rng(7)
+    free, goal = _random_world(rng, 20, 28)
+    free.reshape(-1)[goal] = True
+    dist = _full(free, goal)
+    ref = _full_dirs(free, goal)
+    full = field_repair.directions_np(dist, free)
+    np.testing.assert_array_equal(full, ref)
+    band = field_repair.directions_np(dist, free, 5, 13)
+    np.testing.assert_array_equal(band, ref[5:13])
+    edge = field_repair.directions_np(dist, free, 0, 3)
+    np.testing.assert_array_equal(edge, ref[0:3])
+    tail = field_repair.directions_np(dist, free, 17, 20)
+    np.testing.assert_array_equal(tail, ref[17:20])
+
+
+def test_pack_rows_np_matches_device_packer():
+    rng = np.random.default_rng(9)
+    codes = rng.integers(0, 5, size=(3, 37), dtype=np.uint8)
+    ours = field_repair.pack_rows_np(codes)
+    theirs = np.asarray(distance.pack_directions(jnp.asarray(codes)))
+    np.testing.assert_array_equal(ours, theirs)

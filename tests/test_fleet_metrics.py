@@ -260,6 +260,50 @@ def test_aggregator_tolerates_null_sections():
     assert roll["peers"]["cxx-1"]["bandwidth"]["bytes_sent"] == 0
 
 
+def test_aggregator_field_engine_section():
+    """ISSUE 9: a solverd beacon's field-engine counters (per-cause
+    sweeps, repair counters, queue depth + starvation age, world seq)
+    roll up into a ``field`` section and render as a FIELD line."""
+    from analysis.fleet_top import render
+
+    agg = FleetAggregator()
+    agg.ingest({
+        "type": "metrics_beacon", "peer_id": "solverd", "proc": "solverd",
+        "pid": 1,
+        "metrics": {
+            "uptime_s": 5.0,
+            "counters": {
+                'solverd.field_sweeps{cause="fresh_goal"}': 12,
+                'solverd.field_sweeps{cause="prime"}': 5,
+                'solverd.field_sweeps{cause="repair"}': 3,
+                "solverd.field_repairs": 2,
+                "solverd.field_repair_fallbacks": 1,
+                "solverd.field_queue_promotions": 4,
+            },
+            "gauges": {"solverd.field_queue": 7,
+                       "solverd.field_queue_max_age": 9,
+                       "solverd.world_seq": 2},
+            "hists": {}}}, now_ms=1000)
+    roll = agg.rollup(now_ms=1000)
+    f = roll["peers"]["solverd"]["field"]
+    assert f == {"queue": 7, "max_age": 9,
+                 "sweeps": {"fresh_goal": 12, "prime": 5, "repair": 3},
+                 "repairs": 2, "repair_fallbacks": 1, "promotions": 4,
+                 "world_seq": 2}
+    text = render(roll)
+    assert "FIELD" in text and "sweeps f/p/r=12/5/3" in text \
+        and "world_seq=2" in text
+    # a beacon without field counters keeps the section None (no line)
+    agg2 = FleetAggregator()
+    agg2.ingest({"type": "metrics_beacon", "peer_id": "a", "proc": "agent",
+                 "pid": 2, "metrics": {"uptime_s": 1.0, "counters": {},
+                                       "gauges": {}, "hists": {}}},
+                now_ms=1000)
+    roll2 = agg2.rollup(now_ms=1000)
+    assert roll2["peers"]["a"]["field"] is None
+    assert "FIELD" not in render(roll2)
+
+
 def test_aggregator_staleness_and_rates():
     agg = FleetAggregator(stale_after_s=6.0)
     snap1 = {"uptime_s": 10.0,
