@@ -8,9 +8,17 @@ Every process keeps an always-on ring of its newest structured events
 one wall-clock-ordered view of the moments before an incident — the
 aviation black-box readout for a fleet outage (ISSUE 5).
 
+``--audit`` (ISSUE 10) additionally merges the auditor's confirmed
+divergence records (``*.audit.jsonl``, written by the standalone auditor
+/ scripts/audit_smoke.py ``--record``) into the same timeline as
+``audit.divergence`` events — a post-mortem then shows *when* the
+distributed state forked relative to the last seconds of lifecycle
+events, not just that it did.
+
 Usage:
   python analysis/blackbox.py --dir <fleet log dir> [--last 30] [--json]
   python analysis/blackbox.py --dir results/trace --grep task.dispatch
+  python analysis/blackbox.py --dir <fleet log dir> --audit
 """
 
 from __future__ import annotations
@@ -44,14 +52,49 @@ def load_dumps(directory: Path) -> tuple:
     return metas, events
 
 
+def load_audit(directory: Path) -> list:
+    """Auditor divergence records (``*.audit.jsonl``) as flight-style
+    events: ``audit.divergence`` with the class/peers/watermarks in the
+    detail fields, time-ordered."""
+    out = []
+    for path in sorted(directory.glob("*.audit.jsonl")):
+        for line in path.read_text(errors="ignore").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or "ts_ms" not in rec:
+                continue
+            out.append({
+                "ts_ms": rec["ts_ms"],
+                "proc": "auditor",
+                "pid": path.stem.split(".")[0],
+                "event": "audit.divergence",
+                "class": rec.get("class"),
+                "peer": (f"{rec.get('peer_a')}"
+                         + (f"~{rec.get('peer_b')}" if rec.get("peer_b")
+                            else "")),
+                "seq": rec.get("seq"),
+                "epoch": rec.get("epoch"),
+                "error": rec.get("detail"),
+            })
+    return out
+
+
 def render_event(ev: dict, t_end_ms: int) -> str:
     rel = (ev.get("ts_ms", 0) - t_end_ms) / 1000.0
     who = f"{ev.get('proc', '?')}/{ev.get('pid', '?')}"
     detail = " ".join(
         f"{k}={ev[k]}" for k in ("task_id", "trace_id", "hop", "peer",
-                                 "wire_ms", "seq", "error")
+                                 "wire_ms", "seq", "epoch", "class",
+                                 "error")
         if k in ev)
-    return f"  {rel:+9.3f}s  {who:<28} {ev.get('event', '?'):<22} {detail}"
+    mark = "🔴 " if ev.get("event") == "audit.divergence" else "  "
+    return (f"{mark}{rel:+9.3f}s  {who:<28} "
+            f"{ev.get('event', '?'):<22} {detail}")
 
 
 def main(argv=None) -> int:
@@ -63,11 +106,18 @@ def main(argv=None) -> int:
                     help="window before the newest event, seconds")
     ap.add_argument("--grep", default="",
                     help="substring filter on the event name")
+    ap.add_argument("--audit", action="store_true",
+                    help="merge auditor divergence records "
+                         "(*.audit.jsonl) into the timeline (ISSUE 10)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
     directory = Path(args.dir)
     metas, events = load_dumps(directory)
+    audit_events = load_audit(directory) if args.audit else []
+    if audit_events:
+        events = sorted(events + audit_events,
+                        key=lambda e: e.get("ts_ms", 0))
     if args.grep:
         events = [e for e in events if args.grep in str(e.get("event", ""))]
     t_end = max((e.get("ts_ms", 0) for e in events), default=0)
@@ -76,13 +126,16 @@ def main(argv=None) -> int:
     if args.as_json:
         print(json.dumps({"dir": str(directory), "dumps": metas,
                           "t_end_ms": t_end, "window_s": args.last,
+                          "audit_divergences": len(audit_events),
                           "events": window}))
-        return 0 if metas else 1
-    if not metas:
+        return 0 if metas or audit_events else 1
+    if not metas and not audit_events:
         print(f"no *.flight.jsonl dumps in {directory} — trigger one with "
               f"SIGUSR2, a bus flight_dump message, or a process exit")
         return 1
-    print(f"black box: {len(metas)} ring dump(s) in {directory}")
+    print(f"black box: {len(metas)} ring dump(s) in {directory}"
+          + (f", {len(audit_events)} audit divergence(s)"
+             if args.audit else ""))
     for m in metas:
         print(f"  {m['file']}: {m.get('proc')}/{m.get('pid')} "
               f"reason={m.get('reason')} events={m.get('events')}")

@@ -27,6 +27,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from p2p_distributed_tswap_tpu.obs import audit as _audit  # noqa: E402
 from p2p_distributed_tswap_tpu.obs import slo as _slo  # noqa: E402
 from p2p_distributed_tswap_tpu.obs.beacon import METRICS_TOPIC  # noqa: E402
 from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (  # noqa: E402
@@ -104,6 +105,45 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
 
         lines.append("FIELD " + " | ".join(
             _field_cell(peer, p) for peer, p in field_rows))
+    # world-epoch tracking (ISSUE 10 satellite): every peer carrying a
+    # world_seq gauge, plus the audit beacons' per-tenant epochs — a
+    # dynamic-world-OFF peer in a toggling fleet renders "OFF!", the
+    # visible form of the PR 9 silent-divergence caveat
+    world_rows = [(peer, p) for peer, p in rollup["peers"].items()
+                  if p.get("world")]
+    audit_st = rollup.get("audit")
+    if world_rows or (audit_st and audit_st.get("epochs")):
+        cells = []
+        for peer, p in world_rows:
+            w = p["world"]
+            dyn = w.get("dynamic")
+            tag = "" if dyn is None else (" dyn" if dyn else " OFF!")
+            cells.append(f"{peer[:16]}@{w['seq']}{tag}")
+        seen = {peer for peer, _ in world_rows}
+        for peer, e in ((audit_st or {}).get("epochs") or {}).items():
+            if peer in seen:
+                continue
+            ns = f" ns={e['ns']}" if e.get("ns") else ""
+            dyn = e.get("dynamic")
+            tag = "" if dyn is None else (" dyn" if dyn else " OFF!")
+            cells.append(f"{peer[:16]}@{e['epoch']}{tag}{ns}")
+        lines.append("WORLD " + " | ".join(cells))
+    # the AUDIT verdict line (ISSUE 10): state-consistency judgment from
+    # the embedded auditor — green/amber/red plus the active divergences
+    if audit_st:
+        head = (f"AUDIT {audit_st['verdict'].upper()}"
+                f" peers={audit_st['peers']}"
+                f" joins={audit_st['joins']}"
+                f" div={audit_st['divergences']}")
+        if color:
+            tint = {"green": "\x1b[32m", "amber": "\x1b[33m",
+                    "red": "\x1b[31m"}[audit_st["verdict"]]
+            head = f"{tint}{head}\x1b[0m"
+        for d in audit_st.get("active") or []:
+            head += (f"  [{d['class']}] {d['peer_a']}"
+                     + (f"↔{d['peer_b']}" if d.get("peer_b") else "")
+                     + f": {d['detail']}")
+        lines.append(head)
     # fleet task throughput (ISSUE 7): manager done-counter derivations
     if f.get("tasks_dispatched") is not None:
         ratio = f.get("completion_ratio")
@@ -127,14 +167,24 @@ def collect(agg: FleetAggregator, bus: BusClient, duration: float) -> int:
     the number ingested."""
     n = 0
     deadline = time.monotonic() + duration
+    last_eval = 0.0
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             return n
+        # judge the embedded auditor DURING the window, not only in the
+        # post-collect rollup(): confirm streaks need >= 2 evaluation
+        # rounds on fresh evidence, so a single end-of-wait evaluate
+        # (--once mode) could never turn divergent beacons into a red
+        # verdict no matter how long --wait is
+        now = time.monotonic()
+        if agg.audit.beacons and now - last_eval > 0.5:
+            last_eval = now
+            agg.audit.evaluate()
         frame = bus.recv(timeout=min(0.5, remaining))
         if not frame or frame.get("op") != "msg":
             continue
-        if frame.get("topic") != METRICS_TOPIC:
+        if frame.get("topic") not in (METRICS_TOPIC, _audit.AUDIT_TOPIC):
             continue
         if agg.ingest(frame.get("data") or {}):
             n += 1
@@ -173,7 +223,16 @@ def main(argv=None) -> int:
               f"({e})", file=sys.stderr)
         return 1
     bus.subscribe(METRICS_TOPIC)
-    agg = FleetAggregator(budget_ms=args.budget_ms)
+    # sustained divergence in the live view: pull the fleet's black
+    # boxes (throttled) so the moments before the state fork survive
+    # for blackbox --audit
+    agg = FleetAggregator(budget_ms=args.budget_ms,
+                          on_divergence=None if args.once
+                          else _audit.flight_dump_trigger(bus))
+    if _audit.enabled():
+        # the embedded auditor's feed (ISSUE 10); raw — audit beacons
+        # ride the un-namespaced operator plane like mapd.metrics
+        bus.subscribe(_audit.AUDIT_TOPIC, raw=True)
 
     if args.once:
         collect(agg, bus, args.wait)

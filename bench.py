@@ -75,6 +75,14 @@ Field-engine axis (ISSUE 9): unless BENCH_FIELD=0, the headline carries a
 bounded-region incremental repair (analysis/field_bench.py --quick) plus
 the multi-field-kernel GO/NO-GO verdict — so dynamic-world repair cost
 rides the BENCH trajectory too.
+
+Audit axis (ISSUE 10): unless BENCH_AUDIT=0, the headline carries an
+``audit`` record — digest-computation overhead in µs per beacon body
+(flat resident fleet vs an 8-tenant slab, measured in-process) plus the
+live divergence-detection latency (corruption -> confirmed roster
+divergence, in digest intervals) and drill cost from a scaled-down
+``scripts/audit_smoke.py`` run — so the always-on audit cost stays on
+the BENCH trajectory.
 """
 
 from __future__ import annotations
@@ -678,6 +686,108 @@ def run_field_engine_axis() -> dict:
     }
 
 
+def run_audit_axis() -> dict:
+    """Audit-plane rung (ISSUE 10): digest-computation µs per beacon
+    body — a flat resident fleet vs 8 tenant slab rows, measured
+    in-process against real resident state — plus live
+    divergence-detection latency and drill cost from a scaled-down
+    scripts/audit_smoke.py run.  Failures are recorded, never fatal."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    from p2p_distributed_tswap_tpu.core.grid import Grid
+    from p2p_distributed_tswap_tpu.runtime import plan_codec as pcodec
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+    from p2p_distributed_tswap_tpu.runtime.solverd import (
+        PlanService, Tenant, TenantSlab, TickRunner, audit_entries,
+        audit_entries_tenant)
+
+    out: dict = {}
+    root = os.path.dirname(os.path.abspath(__file__))
+    lanes_per_fleet = 64
+    reps = 50
+    try:
+        grid = Grid(np.ones((64, 64), np.bool_))
+        # flat: a 64-lane resident fleet, digest body = mirror + device
+        # pull + fields (what AuditBeacon computes per beat)
+        runner = TickRunner(PlanService(grid, capacity_min=4), grid)
+        enc = pcodec.PackedFleetEncoder(snapshot_every=64)
+        fleet = [(f"ag{k:03d}", k, k + 1) for k in range(lanes_per_fleet)]
+        assert runner.ingest({
+            "type": "plan_request", "seq": 1, "codec": pcodec.CODEC_NAME,
+            "caps": [pcodec.CODEC_NAME],
+            "data": pcodec.encode_b64(enc.encode_tick(1, fleet))})
+        audit_entries(runner.service, 1)  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            audit_entries(runner.service, 1)
+        out["flat_us_per_beacon"] = round(
+            1e6 * (time.perf_counter() - t0) / reps, 1)
+        out["flat_lanes"] = lanes_per_fleet
+        # slab: 8 tenants x 64 lanes; one beat digests every tenant row
+        svc2 = PlanService(grid, capacity_min=4)
+        slab = TenantSlab(svc2, grid)
+        slab._grow(8, lanes_per_fleet)
+        rng = np.random.default_rng(0)
+        slab.h_pos[:8, :lanes_per_fleet] = rng.integers(
+            0, grid.num_cells, (8, lanes_per_fleet))
+        slab.h_goal[:8, :lanes_per_fleet] = rng.integers(
+            0, grid.num_cells, (8, lanes_per_fleet))
+        slab.h_active[:8, :lanes_per_fleet] = True
+        slab._upload()
+        tenants = [Tenant(f"t{k}", k) for k in range(8)]
+        for t in tenants:
+            audit_entries_tenant(slab, t)  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for t in tenants:
+                audit_entries_tenant(slab, t)
+        out["slab8_us_per_beacon"] = round(
+            1e6 * (time.perf_counter() - t0) / reps, 1)
+        out["slab_tenants"] = 8
+        out["slab_lanes_per_tenant"] = lanes_per_fleet
+    except Exception as e:  # noqa: BLE001 — axis must never kill BENCH
+        out["microbench_error"] = f"{type(e).__name__}: {e}"
+
+    if not (BUILD_DIR / "mapd_bus").exists() \
+            and (shutil.which("cmake") is None
+                 or shutil.which("ninja") is None):
+        out["live"] = {"skipped": "C++ runtime unavailable"}
+        return out
+    art = Path(tempfile.mkdtemp(prefix="jg-bench-audit-")) / "audit.json"
+    cmd = [sys.executable, os.path.join(root, "scripts", "audit_smoke.py"),
+           "--out", str(art)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=420,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        out["live"] = {"error": "audit_smoke timeout"}
+        return out
+    if not art.exists():
+        out["live"] = {"error": (proc.stderr or proc.stdout
+                                 or "no output")[-300:]}
+        return out
+    try:
+        doc = json.loads(art.read_text())
+    except json.JSONDecodeError as e:
+        out["live"] = {"error": f"artifact parse: {e}"}
+        return out
+    out["live"] = {
+        "interval_s": doc.get("interval_s"),
+        "clean_joins": (doc.get("clean") or {}).get("joins"),
+        "detect_s": (doc.get("drill") or {}).get("detect_s"),
+        "detect_intervals": (doc.get("drill") or {}).get(
+            "detect_intervals"),
+        "drill_requests": (doc.get("drill") or {}).get("requests"),
+    }
+    return out
+
+
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
         trace.configure(proc=f"bench-{sys.argv[2]}")
@@ -727,6 +837,9 @@ def main():
     if os.environ.get("BENCH_FIELD", "1") != "0":
         # field-engine axis (ISSUE 9): ms/field full vs incremental
         head["field_engine"] = run_field_engine_axis()
+    if os.environ.get("BENCH_AUDIT", "1") != "0":
+        # audit axis (ISSUE 10): digest µs/beacon + detection latency
+        head["audit"] = run_audit_axis()
     print(json.dumps(head), flush=True)
 
 

@@ -1,0 +1,201 @@
+// Fleet audit plane (ISSUE 10) — native mirror of
+// p2p_distributed_tswap_tpu/obs/audit.py: FNV-1a-64 state digests over
+// canonically packed tuples, the audit1 beacon blob, and the range-hash
+// helpers the drill responder uses.  BYTE-IDENTICAL to the Python side
+// (golden-tested via cpp/probes/codec_golden.cpp --audit-encode /
+// --audit-decode / --audit-digest, fuzzed by scripts/codec_fuzz.py) —
+// keep every packing rule in lockstep.
+//
+// Digest canon:
+//   lane digest:   active (lane,pos,goal) triples sorted by lane, each
+//                  packed little-endian i32 x3 (12 bytes);
+//   ledger digest: (task_id i64, state u8, pickup i32, delivery i32)
+//                  tuples sorted by (task_id, state), 17 bytes each;
+//   view digest:   sorted in-flight task ids, i64 each;
+//   cells digest:  sorted i32 cells.
+//
+// audit1 blob (little-endian):
+//   u32 magic "AUD1"  u8 version=1  u8 flags=0  u16 n_entries
+//   per entry: u8 section  u32 count  i64 seq  i64 epoch  u64 digest
+//
+// Sections (never renumber): 1 shadow, 2 mirror, 3 device, 4 fields,
+// 5 ledger, 6 view.  Digests cross the JSON drill wire as 16-char
+// lowercase hex (a u64 would round through the double-typed Json).
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace mapd {
+namespace audit {
+
+constexpr const char* kAuditTopic = "mapd.audit";
+constexpr const char* kAuditCap = "audit1";
+constexpr uint32_t kAuditMagic = 0x31445541;  // b"AUD1"
+constexpr uint8_t kAuditVersion = 1;
+
+constexpr uint8_t kSecShadow = 1;
+constexpr uint8_t kSecMirror = 2;
+constexpr uint8_t kSecDevice = 3;
+constexpr uint8_t kSecFields = 4;
+constexpr uint8_t kSecLedger = 5;
+constexpr uint8_t kSecView = 6;
+
+constexpr uint8_t kTaskPending = 0;
+constexpr uint8_t kTaskToPickup = 1;
+constexpr uint8_t kTaskToDelivery = 2;
+
+constexpr uint64_t kFnv64Offset = 0xCBF29CE484222325ull;
+constexpr uint64_t kFnv64Prime = 0x100000001B3ull;
+
+inline uint64_t fnv1a64(const uint8_t* data, size_t n,
+                        uint64_t h = kFnv64Offset) {
+  for (size_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= kFnv64Prime;
+  }
+  return h;
+}
+
+namespace detail {
+inline void put_i32(std::string& b, int32_t v) {
+  uint32_t u = static_cast<uint32_t>(v);
+  for (int k = 0; k < 4; ++k) b += static_cast<char>((u >> (8 * k)) & 0xFF);
+}
+inline void put_i64(std::string& b, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  for (int k = 0; k < 8; ++k) b += static_cast<char>((u >> (8 * k)) & 0xFF);
+}
+inline void put_u64(std::string& b, uint64_t u) {
+  for (int k = 0; k < 8; ++k) b += static_cast<char>((u >> (8 * k)) & 0xFF);
+}
+inline uint64_t hash_str(const std::string& b) {
+  return fnv1a64(reinterpret_cast<const uint8_t*>(b.data()), b.size());
+}
+}  // namespace detail
+
+struct Entry {
+  uint8_t section = 0;
+  uint32_t count = 0;
+  int64_t seq = 0;
+  int64_t epoch = 0;
+  uint64_t digest = 0;
+};
+
+// Sorted-by-lane (lane,pos,goal) triples -> (digest, count).  The caller
+// provides triples ALREADY sorted ascending by lane (std::map iteration
+// order); packing is little-endian i32 x3, matching audit.py lane_digest.
+struct LaneDigest {
+  std::string buf;
+  uint32_t count = 0;
+  void add(int32_t lane, int32_t pos, int32_t goal) {
+    detail::put_i32(buf, lane);
+    detail::put_i32(buf, pos);
+    detail::put_i32(buf, goal);
+    ++count;
+  }
+  uint64_t digest() const { return detail::hash_str(buf); }
+};
+
+// Sorted-by-(task_id,state) ledger tuples -> (digest, count).
+struct LedgerDigest {
+  std::string buf;
+  uint32_t count = 0;
+  void add(int64_t task_id, uint8_t state, int32_t pickup,
+           int32_t delivery) {
+    detail::put_i64(buf, task_id);
+    buf += static_cast<char>(state);
+    detail::put_i32(buf, pickup);
+    detail::put_i32(buf, delivery);
+    ++count;
+  }
+  uint64_t digest() const { return detail::hash_str(buf); }
+};
+
+// Sorted in-flight task ids -> (digest, count).
+inline uint64_t view_digest(const std::vector<int64_t>& sorted_ids) {
+  std::string buf;
+  for (int64_t t : sorted_ids) detail::put_i64(buf, t);
+  return detail::hash_str(buf);
+}
+
+// Sorted cells -> (digest, count).
+inline uint64_t cells_digest(const std::vector<int32_t>& sorted_cells) {
+  std::string buf;
+  for (int32_t c : sorted_cells) detail::put_i32(buf, c);
+  return detail::hash_str(buf);
+}
+
+inline std::string encode_audit(const std::vector<Entry>& entries) {
+  std::string out;
+  out.reserve(8 + entries.size() * 29);
+  detail::put_i32(out, static_cast<int32_t>(kAuditMagic));
+  out += static_cast<char>(kAuditVersion);
+  out += static_cast<char>(0);  // flags
+  out += static_cast<char>(entries.size() & 0xFF);
+  out += static_cast<char>((entries.size() >> 8) & 0xFF);
+  for (const Entry& e : entries) {
+    out += static_cast<char>(e.section);
+    detail::put_i32(out, static_cast<int32_t>(e.count));
+    detail::put_i64(out, e.seq);
+    detail::put_i64(out, e.epoch);
+    detail::put_u64(out, e.digest);
+  }
+  return out;
+}
+
+inline bool decode_audit(const std::string& buf,
+                         std::vector<Entry>* out) {
+  if (buf.size() < 8) return false;
+  const uint8_t* b = reinterpret_cast<const uint8_t*>(buf.data());
+  uint32_t magic = static_cast<uint32_t>(b[0]) |
+                   (static_cast<uint32_t>(b[1]) << 8) |
+                   (static_cast<uint32_t>(b[2]) << 16) |
+                   (static_cast<uint32_t>(b[3]) << 24);
+  if (magic != kAuditMagic || b[4] != kAuditVersion) return false;
+  uint16_t n = static_cast<uint16_t>(b[6] | (b[7] << 8));
+  if (buf.size() != 8 + static_cast<size_t>(n) * 29) return false;
+  out->clear();
+  const uint8_t* q = b + 8;
+  auto get_u32 = [](const uint8_t* p) {
+    return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+           (static_cast<uint32_t>(p[2]) << 16) |
+           (static_cast<uint32_t>(p[3]) << 24);
+  };
+  auto get_u64 = [](const uint8_t* p) {
+    uint64_t v = 0;
+    for (int k = 7; k >= 0; --k) v = (v << 8) | p[k];
+    return v;
+  };
+  for (uint16_t k = 0; k < n; ++k, q += 29) {
+    Entry e;
+    e.section = q[0];
+    e.count = get_u32(q + 1);
+    e.seq = static_cast<int64_t>(get_u64(q + 5));
+    e.epoch = static_cast<int64_t>(get_u64(q + 13));
+    e.digest = get_u64(q + 21);
+    out->push_back(e);
+  }
+  return true;
+}
+
+// 16-char lowercase hex — the JSON-wire spelling of a digest.
+inline std::string digest_hex(uint64_t d) {
+  char buf[17];
+  snprintf(buf, sizeof(buf), "%016llx",
+           static_cast<unsigned long long>(d));
+  return std::string(buf);
+}
+
+// The audit plane is ON unless JG_AUDIT=0 (kill switch: wire
+// byte-identical to the pre-audit build).
+inline bool audit_enabled() {
+  const char* v = getenv("JG_AUDIT");
+  return !(v && v[0] == '0' && v[1] == '\0');
+}
+
+}  // namespace audit
+}  // namespace mapd

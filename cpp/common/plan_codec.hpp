@@ -409,6 +409,13 @@ class PackedFleetEncoder {
     return it->second;
   }
 
+  // The whole lane -> (pos, goal) state as last sent, sorted by lane
+  // (std::map) — the audit plane (ISSUE 10) digests this after every
+  // tick and the drill responder range-hashes it.
+  const std::map<int32_t, std::pair<int32_t, int32_t>>& shadow_map() const {
+    return shadow_;
+  }
+
  private:
   int snapshot_every_;
   std::vector<std::string> roster_;  // lane -> peer id ("" = free)

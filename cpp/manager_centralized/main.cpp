@@ -22,6 +22,7 @@
 #include <signal.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -34,8 +35,10 @@
 #include <set>
 #include <sstream>
 #include <string>
+#include <tuple>
 #include <vector>
 
+#include "../common/audit.hpp"
 #include "../common/bus.hpp"
 #include "../common/events.hpp"
 #include "../common/grid.hpp"
@@ -129,6 +132,15 @@ int main(int argc, char** argv) {
   const bool dynamic_world =
       knobs.get_int("--dynamic-world", "JG_DYNAMIC_WORLD",
                     (ns_env && *ns_env) ? 0 : 1) != 0;
+  // audit plane (ISSUE 10): periodic state-consistency digest beacons on
+  // mapd.audit (task-ledger FNV chain + packed-encoder shadow ring keyed
+  // by plan seq and world epoch) plus the bisect drill responder.
+  // JG_AUDIT=0 is the kill switch: no subscription, no frames — the
+  // wire stays byte-identical to the pre-audit build.
+  const bool audit_on =
+      knobs.get_int("--audit", "JG_AUDIT", 1) != 0;
+  const int64_t audit_interval_ms =
+      knobs.get_int("--audit-interval-ms", "JG_AUDIT_INTERVAL_MS", 2000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -166,6 +178,9 @@ int main(int argc, char** argv) {
   bus.subscribe("mapd");
   if (region_gossip) bus.subscribe(kPosTopicWildcard);
   if (solver == "tpu") bus.subscribe("solver");
+  // audit plane rides the un-namespaced operator topic (raw): a tenant
+  // manager's digests must reach the cross-tenant auditor
+  if (audit_on) bus.subscribe(audit::kAuditTopic, /*raw=*/true);
   // survive a bus restart (reconnect + resubscribe inside BusClient);
   // agents re-announce themselves on their own reconnect, so tracking
   // repopulates within a heartbeat
@@ -173,6 +188,13 @@ int main(int argc, char** argv) {
   // live-metrics beacon: registry snapshot on mapd.metrics every ~2 s
   // (fleet_top / obs.fleet_aggregator merge it with the Python peers')
   bus.enable_metrics_beacon("manager_centralized");
+  // world-epoch tracking (ISSUE 10 satellite): the epoch + dynamic-world
+  // gauges are ALWAYS present, so fleet_top's WORLD line shows a
+  // 0-epoch (or dynamic-OFF) manager instead of omitting it — the PR 9
+  // caveat (namespaced managers silently diverging from a toggling
+  // operator plane) becomes visible instead of folklore
+  metrics_gauge("manager.world_seq", 0.0);
+  metrics_gauge("manager.dynamic_world", dynamic_world ? 1.0 : 0.0);
   log_info("🧠 centralized manager %s up (grid %dx%d, solver=%s%s)\n",
            my_id.c_str(), grid.width, grid.height, solver.c_str(),
            clean ? ", clean" : "");
@@ -529,6 +551,19 @@ int main(int argc, char** argv) {
   const bool use_packed = (plan_codec != "json");
   codec::PackedFleetEncoder plan_enc;
   int64_t plan_sent_ms = 0;  // fresh-response RTT (manager.plan_rtt_ms)
+  // world epoch (monotone, bumped per accepted world_update batch):
+  // every audit digest carries it as the second watermark
+  int64_t world_seq = 0;
+  // accumulated accepted toggles (cell -> blocked, last state wins):
+  // replayed to a resyncing solverd so a daemon restarted mid-run
+  // re-learns every wall instead of silently planning on the original
+  // map (the stale_epoch divergence the audit plane exposes)
+  std::map<int32_t, int> world_state;
+  // audit shadow ring (ISSUE 10): per-tick digests of the fleet state AS
+  // SENT, keyed by plan seq — shipped inside every audit beacon so the
+  // auditor joins solverd's post-apply mirror digest at the exact same
+  // watermark despite the 2 s beacon cadence vs the 500 ms tick
+  std::deque<audit::Entry> audit_ring;
 
   auto plan_request_tpu = [&]() {
     Span sp("manager.plan_request_encode");
@@ -547,6 +582,22 @@ int main(int argc, char** argv) {
         pkt.trace = codec::TraceCtx{
             trace_epoch | 0x80000000LL | (plan_seq & 0x7FFFFFFF), 1,
             unix_ms()};
+      }
+      if (audit_on) {
+        // digest the post-encode shadow: exactly the state solverd's
+        // mirror must hold after applying THIS packet (same canon:
+        // sorted-by-lane (lane,pos,goal) i32 triples, obs/audit.py)
+        audit::LaneDigest ld;
+        for (const auto& [lane, pg] : plan_enc.shadow_map())
+          ld.add(lane, pg.first, pg.second);
+        audit::Entry e;
+        e.section = audit::kSecShadow;
+        e.count = ld.count;
+        e.seq = plan_seq;
+        e.epoch = world_seq;
+        e.digest = ld.digest();
+        audit_ring.push_back(e);
+        while (audit_ring.size() > 8) audit_ring.pop_front();
       }
       if (pkt.kind == codec::kSnapshot)
         metrics_count("manager.plan_snapshots");
@@ -613,7 +664,6 @@ int main(int argc, char** argv) {
   // [cell,blocked] JSON when the plan wire is JSON) on "solver" so the
   // daemon repairs its cached fields.  The requester gets a
   // world_update_applied ack with per-toggle rejection reasons.
-  int64_t world_seq = 0;
   auto handle_world_request = [&](const Json& d) {
     if (!dynamic_world) {
       metrics_count("manager.world_updates_ignored");
@@ -677,6 +727,7 @@ int main(int argc, char** argv) {
       grid.free[c] = blk ? 0 : 1;
       cells.push_back(static_cast<int32_t>(c));
       blocked.push_back(blk ? 1 : 0);
+      world_state[static_cast<int32_t>(c)] = blk ? 1 : 0;
     }
     if (!cells.empty()) {
       ++world_seq;
@@ -730,6 +781,139 @@ int main(int argc, char** argv) {
         .set("accepted", static_cast<int64_t>(cells.size()))
         .set("rejected", rejected);
     bus.publish("mapd", ack);
+  };
+
+  // ---- audit plane (ISSUE 10): ledger digests, beacon, drill ----
+  // (task_id, state, pickup, delivery) tuples over pending + in-flight
+  // tasks, sorted by (id, state) — the ledger canon of obs/audit.py.
+  auto ledger_tuples = [&]() {
+    std::vector<std::tuple<int64_t, uint8_t, int32_t, int32_t>> tup;
+    auto cells_of = [&](const Json& t, int32_t* pk, int32_t* dl) {
+      auto p = parse_point(t["pickup"]);
+      auto d2 = parse_point(t["delivery"]);
+      *pk = p ? static_cast<int32_t>(*p) : -1;
+      *dl = d2 ? static_cast<int32_t>(*d2) : -1;
+    };
+    for (const auto& t : pending_tasks) {
+      int32_t pk, dl;
+      cells_of(t, &pk, &dl);
+      tup.emplace_back(t["task_id"].as_int(), audit::kTaskPending, pk, dl);
+    }
+    for (auto& [peer, a] : agents) {
+      if (!a.task) continue;
+      int32_t pk, dl;
+      cells_of(*a.task, &pk, &dl);
+      tup.emplace_back((*a.task)["task_id"].as_int(),
+                       a.phase == Phase::ToDelivery
+                           ? audit::kTaskToDelivery
+                           : audit::kTaskToPickup,
+                       pk, dl);
+    }
+    std::sort(tup.begin(), tup.end());
+    return tup;
+  };
+
+  auto publish_audit_beacon = [&]() {
+    std::vector<audit::Entry> entries(audit_ring.begin(),
+                                      audit_ring.end());
+    auto tup = ledger_tuples();
+    audit::LedgerDigest ld;
+    int64_t pending = 0, to_pickup = 0, to_delivery = 0;
+    std::vector<int64_t> inflight;
+    for (const auto& [id, st, pk, dl] : tup) {
+      ld.add(id, st, pk, dl);
+      if (st == audit::kTaskPending) ++pending;
+      else if (st == audit::kTaskToPickup) ++to_pickup;
+      else ++to_delivery;
+      if (st != audit::kTaskPending) inflight.push_back(id);
+    }
+    audit::Entry el;
+    el.section = audit::kSecLedger;
+    el.count = ld.count;
+    el.seq = plan_seq;
+    el.epoch = world_seq;
+    el.digest = ld.digest();
+    entries.push_back(el);
+    std::sort(inflight.begin(), inflight.end());
+    audit::Entry ev;
+    ev.section = audit::kSecView;
+    ev.count = static_cast<uint32_t>(inflight.size());
+    ev.seq = plan_seq;
+    ev.epoch = world_seq;
+    ev.digest = audit::view_digest(inflight);
+    entries.push_back(ev);
+    Json caps;
+    caps.push_back(Json(std::string(audit::kAuditCap)));
+    Json buckets;
+    buckets.set("pending", pending)
+        .set("to_pickup", to_pickup)
+        .set("to_delivery", to_delivery);
+    Json b;
+    b.set("type", "audit_beacon")
+        .set("peer_id", my_id)
+        .set("proc", "manager_centralized")
+        .set("ns", (ns_env && *ns_env) ? std::string(ns_env)
+                                       : std::string())
+        .set("ts_ms", unix_ms())
+        .set("interval_s", audit_interval_ms / 1000.0)
+        .set("caps", caps)
+        .set("dynamic_world", dynamic_world)
+        .set("buckets", buckets)
+        .set("data", codec::b64_encode(audit::encode_audit(entries)));
+    bus.publish(audit::kAuditTopic, b, /*raw=*/true);
+  };
+
+  // Bisect drill responder: range digests over lane halves ("shadow")
+  // or task-id halves ("ledger"), rows at the leaf — the auditor
+  // recurses to the first divergent lane without any full-state ship.
+  auto handle_drill = [&](const Json& d) {
+    if (!audit_on) return;
+    const std::string target = d["target"].as_str();
+    if (target != "manager_centralized" && target != my_id) return;
+    const std::string view = d["view"].as_str();
+    const int64_t lo = d["lo"].as_int();
+    const int64_t hi = d["hi"].as_int();
+    const bool want_rows = d["rows"].as_bool() || hi - lo <= 4;
+    Json resp;
+    resp.set("type", "audit_drill_response")
+        .set("req_id", d["req_id"])
+        .set("peer_id", my_id)
+        .set("target", target)
+        .set("view", view)
+        .set("lo", lo)
+        .set("hi", hi);
+    if (view == "ledger") {
+      audit::LedgerDigest ld;
+      for (const auto& [id, st, pk, dl] : ledger_tuples()) {
+        if (id < lo || id >= hi) continue;
+        ld.add(id, st, pk, dl);
+      }
+      resp.set("digest", audit::digest_hex(ld.digest()))
+          .set("count", static_cast<int64_t>(ld.count));
+    } else {  // "shadow"
+      audit::LaneDigest ldg;
+      Json rows;
+      for (const auto& [lane, pg] : plan_enc.shadow_map()) {
+        if (lane < lo || lane >= hi) continue;
+        ldg.add(lane, pg.first, pg.second);
+        if (want_rows) {
+          Json r;
+          r.push_back(Json(static_cast<int64_t>(lane)));
+          r.push_back(Json(static_cast<int64_t>(pg.first)));
+          r.push_back(Json(static_cast<int64_t>(pg.second)));
+          r.push_back(Json(static_cast<int64_t>(1)));
+          r.push_back(Json(plan_enc.peer_of(lane)));
+          rows.push_back(r);
+        }
+      }
+      resp.set("digest", audit::digest_hex(ldg.digest()))
+          .set("count", static_cast<int64_t>(ldg.count));
+      if (want_rows) {
+        if (rows.is_null()) rows = Json(JsonArray{});
+        resp.set("rows", rows);
+      }
+    }
+    bus.publish(audit::kAuditTopic, resp, /*raw=*/true);
   };
 
   int64_t last_plan_response = mono_ms();
@@ -885,7 +1069,7 @@ int main(int argc, char** argv) {
       return true;
   };
 
-  int64_t last_plan = 0, last_cleanup = mono_ms();
+  int64_t last_plan = 0, last_cleanup = mono_ms(), last_audit = 0;
   std::string stdin_buf;
   bool running = true;
 
@@ -1006,6 +1190,39 @@ int main(int argc, char** argv) {
             log_info("🔁 solver daemon requested a plan snapshot "
                      "(its chain ends at seq %lld)\n",
                      static_cast<long long>(d["have_seq"].as_int()));
+            if (dynamic_world && !world_state.empty()) {
+              // world replay (ISSUE 10): a resyncing daemon may have
+              // restarted with the ORIGINAL map — re-send every
+              // accumulated toggle at the current epoch so its grid
+              // (and world_seq, which it adopts from the frame)
+              // reconverges with the planner of record
+              std::vector<int32_t> cells, blocked;
+              for (const auto& [c, b] : world_state) {
+                cells.push_back(c);
+                blocked.push_back(b);
+              }
+              Json su;
+              su.set("type", "world_update").set("world_seq", world_seq);
+              if (use_packed) {
+                su.set("codec", codec::kCodecName)
+                    .set("data", codec::encode_b64(codec::encode_world(
+                             world_seq, cells, blocked)));
+              } else {
+                Json st;
+                for (size_t k = 0; k < cells.size(); ++k) {
+                  Json t;
+                  t.push_back(Json(static_cast<int64_t>(cells[k])));
+                  t.push_back(Json(static_cast<int64_t>(blocked[k])));
+                  st.push_back(t);
+                }
+                su.set("toggles", st);
+              }
+              bus.publish("solver", su);
+              metrics_count("manager.world_replays");
+              log_info("🌍 replayed %zu accumulated world toggle(s) at "
+                       "epoch %lld with the snapshot\n",
+                       cells.size(), static_cast<long long>(world_seq));
+            }
           } else if (type == "task_metric_received") {
             task_metrics.update_received(
                 static_cast<uint64_t>(d["task_id"].as_int()),
@@ -1025,6 +1242,8 @@ int main(int argc, char** argv) {
                                 static_cast<double>(*t));
           } else if (type == "world_update_request") {
             handle_world_request(d);
+          } else if (type == "audit_drill_request") {
+            handle_drill(d);
           } else if (type == "flight_dump") {
             // black-box query: dump the ring and answer with the path
             bus.publish("mapd",
@@ -1164,6 +1383,10 @@ int main(int argc, char** argv) {
       if (tick_ms_taken > static_cast<double>(planning_ms))
         metrics_count("tick.over_budget");
       metrics_gauge("tick.agents", static_cast<double>(agents.size()));
+    }
+    if (audit_on && now - last_audit >= audit_interval_ms) {
+      last_audit = now;
+      publish_audit_beacon();
     }
     if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;

@@ -29,8 +29,11 @@
 #include <type_traits>
 #include <vector>
 
+#include <algorithm>
 #include <deque>
+#include <tuple>
 
+#include "../common/audit.hpp"
 #include "../common/bus.hpp"
 #include "../common/events.hpp"
 #include "../common/grid.hpp"
@@ -86,6 +89,14 @@ int main(int argc, char** argv) {
   // heartbeats.  JG_REGION_GOSSIP=0 falls back to flat position_update.
   const bool region_gossip =
       knobs.get_int("--region-gossip", "JG_REGION_GOSSIP", 1) != 0;
+  // audit plane (ISSUE 10): periodic task-ledger digest beacons on
+  // mapd.audit.  The decentralized manager has no packed plan wire to
+  // shadow, but its ledger (in-flight + orphan-requeue tasks) is the
+  // system of record the auditor's view checks compare against.
+  // JG_AUDIT=0 keeps the wire byte-identical.
+  const bool audit_on = knobs.get_int("--audit", "JG_AUDIT", 1) != 0;
+  const int64_t audit_interval_ms =
+      knobs.get_int("--audit-interval-ms", "JG_AUDIT_INTERVAL_MS", 2000);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -119,6 +130,8 @@ int main(int argc, char** argv) {
     bus.subscribe(kPosTopicWildcard);
     bus.subscribe("mapd.path");  // interest-scoped path_metric stream
   }
+  // drill answering needs the audit topic; beacons alone are publish-only
+  if (audit_on) bus.subscribe(audit::kAuditTopic, /*raw=*/true);
   // survive a bus restart (reconnect + resubscribe inside BusClient);
   // agents re-announce position+goal on their own reconnect.  ADVICE r5:
   // no liveness evidence can arrive while the hub is down, so the stale
@@ -132,6 +145,10 @@ int main(int argc, char** argv) {
     sweep_hold_until = mono_ms() + claim_fresh_ms;
   });
   bus.enable_metrics_beacon("manager_decentralized");
+  // world-epoch tracking (ISSUE 10 satellite): always-present gauges so
+  // the fleet_top WORLD line shows this manager's (static) world view
+  metrics_gauge("manager.world_seq", 0.0);
+  metrics_gauge("manager.dynamic_world", 0.0);
   log_info("🧠 decentralized manager %s up (grid %dx%d)\n", my_id.c_str(),
            grid.width, grid.height);
   log_info("Commands: task | tasks N | metrics | save <file> | "
@@ -440,8 +457,117 @@ int main(int argc, char** argv) {
     }
   };
 
+  // ---- audit plane (ISSUE 10): ledger + in-flight view digests ----
+  // canonical ledger tuples + sorted in-flight view, shared by the
+  // beacon and the drill responder so both hash the same material
+  auto ledger_tuples = [&]() {
+    auto cell_of = [&](const Json& pt) -> int32_t {
+      const auto& arr = pt.as_array();
+      if (arr.size() != 2) return -1;
+      int x = static_cast<int>(arr[0].as_int());
+      int y = static_cast<int>(arr[1].as_int());
+      if (!grid.in_bounds(x, y)) return -1;
+      return static_cast<int32_t>(grid.cell(x, y));
+    };
+    // pending = the orphan requeue; in-flight tasks all carry the
+    // generic in-flight state byte (agents own the pickup flip here —
+    // this manager never learns the phase, and the digest canon must
+    // only hash what the ledger actually knows)
+    std::vector<std::tuple<int64_t, uint8_t, int32_t, int32_t>> tup;
+    for (const auto& t : requeue)
+      tup.emplace_back(t["task_id"].as_int(), audit::kTaskPending,
+                       cell_of(t["pickup"]), cell_of(t["delivery"]));
+    std::vector<int64_t> view;
+    for (const auto& [id, t] : inflight) {
+      tup.emplace_back(id, audit::kTaskToPickup, cell_of(t["pickup"]),
+                       cell_of(t["delivery"]));
+      view.push_back(id);
+    }
+    std::sort(tup.begin(), tup.end());
+    std::sort(view.begin(), view.end());
+    return std::make_pair(tup, view);
+  };
+
+  auto publish_audit_beacon = [&]() {
+    auto [tup, view] = ledger_tuples();
+    audit::LedgerDigest ld;
+    for (const auto& [id, st, pk, dl] : tup) ld.add(id, st, pk, dl);
+    std::vector<audit::Entry> entries;
+    audit::Entry el;
+    el.section = audit::kSecLedger;
+    el.count = ld.count;
+    el.seq = 0;
+    el.epoch = 0;
+    el.digest = ld.digest();
+    entries.push_back(el);
+    audit::Entry ev;
+    ev.section = audit::kSecView;
+    ev.count = static_cast<uint32_t>(view.size());
+    ev.seq = 0;
+    ev.epoch = 0;
+    ev.digest = audit::view_digest(view);
+    entries.push_back(ev);
+    Json caps;
+    caps.push_back(Json(std::string(audit::kAuditCap)));
+    Json buckets;
+    buckets.set("pending", static_cast<int64_t>(requeue.size()))
+        .set("in_flight", static_cast<int64_t>(inflight.size()));
+    const char* ns_env = getenv("JG_BUS_NS");
+    Json b;
+    b.set("type", "audit_beacon")
+        .set("peer_id", my_id)
+        .set("proc", "manager_decentralized")
+        .set("ns", (ns_env && *ns_env) ? std::string(ns_env)
+                                       : std::string())
+        .set("ts_ms", unix_ms())
+        .set("interval_s", audit_interval_ms / 1000.0)
+        .set("caps", caps)
+        .set("dynamic_world", false)
+        .set("buckets", buckets)
+        .set("data", codec::b64_encode(audit::encode_audit(entries)));
+    bus.publish(audit::kAuditTopic, b, /*raw=*/true);
+  };
+
+  // Bisect drill responder over task-id halves: "ledger" hashes the
+  // (id,state,pickup,delivery) tuples in [lo,hi), "view" the in-flight
+  // ids — the auditor recurses to the first divergent id range, same
+  // wire contract as the centralized manager's responder.
+  auto handle_drill = [&](const Json& d) {
+    if (!audit_on) return;
+    const std::string target = d["target"].as_str();
+    if (target != "manager_decentralized" && target != my_id) return;
+    const std::string view = d["view"].as_str();
+    const int64_t lo = d["lo"].as_int();
+    const int64_t hi = d["hi"].as_int();
+    Json resp;
+    resp.set("type", "audit_drill_response")
+        .set("req_id", d["req_id"])
+        .set("peer_id", my_id)
+        .set("target", target)
+        .set("view", view)
+        .set("lo", lo)
+        .set("hi", hi);
+    auto [tup, ids] = ledger_tuples();
+    if (view == "view") {
+      std::vector<int64_t> in;
+      for (int64_t id : ids)
+        if (id >= lo && id < hi) in.push_back(id);
+      resp.set("digest", audit::digest_hex(audit::view_digest(in)))
+          .set("count", static_cast<int64_t>(in.size()));
+    } else {  // "ledger"
+      audit::LedgerDigest ld;
+      for (const auto& [id, st, pk, dl] : tup) {
+        if (id < lo || id >= hi) continue;
+        ld.add(id, st, pk, dl);
+      }
+      resp.set("digest", audit::digest_hex(ld.digest()))
+          .set("count", static_cast<int64_t>(ld.count));
+    }
+    bus.publish(audit::kAuditTopic, resp, /*raw=*/true);
+  };
+
   bus.query_peers("mapd");
-  int64_t last_cleanup = mono_ms();
+  int64_t last_cleanup = mono_ms(), last_audit = 0;
   std::string stdin_buf;
   bool running = true;
 
@@ -539,6 +665,8 @@ int main(int argc, char** argv) {
             // black-box query: dump the ring and answer with the path
             bus.publish(
                 "mapd", flight_dump_answer("manager_decentralized", my_id));
+          } else if (type == "audit_drill_request") {
+            handle_drill(d);
           } else if (d["status"].as_str() == "done") {
             const std::string& peer = m.from;
             const long long tid = d["task_id"].as_int();
@@ -656,6 +784,10 @@ int main(int argc, char** argv) {
     if (!alive) break;
 
     int64_t now = mono_ms();
+    if (audit_on && now - last_audit >= audit_interval_ms) {
+      last_audit = now;
+      publish_audit_beacon();
+    }
     if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;
       // ADVICE r5: both liveness sweeps below act on the ABSENCE of

@@ -25,11 +25,26 @@
 //                   "trace":[id,hop,ms]?}
 //                  stdout: one base64 world1 packet per line (ISSUE 9;
 //                  --decode round-trips it like any packed1 kind)
+//   --audit-digest stdin: one JSON per line (ISSUE 10)
+//                  {"lanes":[[lane,pos,goal],...]} |
+//                  {"ledger":[[id,state,pickup,delivery],...]} |
+//                  {"view":[id,...]} | {"cells":[c,...]}
+//                  stdout: {"digest":"<16-hex>","count":n} per line —
+//                  the audit-plane digest canon the Python side asserts
+//                  byte-identical (obs/audit.py)
+//   --audit-encode stdin: one JSON per line
+//                  {"entries":[[section,count,seq,epoch,"hex"],...]}
+//                  stdout: one base64 audit1 blob per line
+//   --audit-decode stdin: one base64 audit1 blob per line
+//                  stdout: {"entries":[[...],...]} per line ("null"
+//                  for undecodable input)
 
+#include <algorithm>
 #include <cstdio>
 #include <iostream>
 #include <string>
 
+#include "../common/audit.hpp"
 #include "../common/json.hpp"
 #include "../common/plan_codec.hpp"
 #include "../common/shardmap.hpp"
@@ -67,10 +82,12 @@ int main(int argc, char** argv) {
   const std::string mode = argc > 1 ? argv[1] : "";
   if (mode != "--encode" && mode != "--decode" && mode != "--pos1-encode" &&
       mode != "--pos1-decode" && mode != "--shardmap" &&
-      mode != "--world-encode") {
+      mode != "--world-encode" && mode != "--audit-digest" &&
+      mode != "--audit-encode" && mode != "--audit-decode") {
     fprintf(stderr,
             "usage: codec_golden --encode|--decode|--pos1-encode|"
-            "--pos1-decode|--shardmap|--world-encode < lines\n");
+            "--pos1-decode|--shardmap|--world-encode|--audit-digest|"
+            "--audit-encode|--audit-decode < lines\n");
     return 2;
   }
   codec::PackedFleetEncoder enc;
@@ -148,6 +165,115 @@ int main(int argc, char** argv) {
         pkt.trace = tc;
       }
       printf("%s\n", codec::encode_b64(pkt).c_str());
+      continue;
+    }
+    if (mode == "--audit-digest") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad audit-digest script line\n");
+        return 1;
+      }
+      const Json& j = *parsed;
+      uint64_t digest = 0;
+      uint32_t count = 0;
+      if (j.has("lanes")) {
+        // triples arrive in script order; the canon sorts by lane
+        std::vector<std::tuple<int32_t, int32_t, int32_t>> tri;
+        for (const auto& e : j["lanes"].as_array()) {
+          const auto& t = e.as_array();
+          tri.emplace_back(static_cast<int32_t>(t[0].as_int()),
+                           static_cast<int32_t>(t[1].as_int()),
+                           static_cast<int32_t>(t[2].as_int()));
+        }
+        std::stable_sort(tri.begin(), tri.end(),
+                         [](const auto& a, const auto& b) {
+                           return std::get<0>(a) < std::get<0>(b);
+                         });
+        audit::LaneDigest ld;
+        for (const auto& [l, p, g] : tri) ld.add(l, p, g);
+        digest = ld.digest();
+        count = ld.count;
+      } else if (j.has("ledger")) {
+        std::vector<std::tuple<int64_t, uint8_t, int32_t, int32_t>> tup;
+        for (const auto& e : j["ledger"].as_array()) {
+          const auto& t = e.as_array();
+          tup.emplace_back(t[0].as_int(),
+                           static_cast<uint8_t>(t[1].as_int()),
+                           static_cast<int32_t>(t[2].as_int()),
+                           static_cast<int32_t>(t[3].as_int()));
+        }
+        std::sort(tup.begin(), tup.end());
+        audit::LedgerDigest ld;
+        for (const auto& [id, st, pk, dl] : tup) ld.add(id, st, pk, dl);
+        digest = ld.digest();
+        count = ld.count;
+      } else if (j.has("view")) {
+        std::vector<int64_t> ids;
+        for (const auto& e : j["view"].as_array())
+          ids.push_back(e.as_int());
+        std::sort(ids.begin(), ids.end());
+        digest = audit::view_digest(ids);
+        count = static_cast<uint32_t>(ids.size());
+      } else if (j.has("cells")) {
+        std::vector<int32_t> cs;
+        for (const auto& e : j["cells"].as_array())
+          cs.push_back(static_cast<int32_t>(e.as_int()));
+        std::sort(cs.begin(), cs.end());
+        digest = audit::cells_digest(cs);
+        count = static_cast<uint32_t>(cs.size());
+      } else {
+        fprintf(stderr, "codec_golden: unknown audit-digest kind\n");
+        return 1;
+      }
+      Json out;
+      out.set("digest", audit::digest_hex(digest))
+          .set("count", static_cast<int64_t>(count));
+      printf("%s\n", out.dump().c_str());
+      continue;
+    }
+    if (mode == "--audit-encode") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad audit-encode script line\n");
+        return 1;
+      }
+      std::vector<audit::Entry> entries;
+      for (const auto& e : (*parsed)["entries"].as_array()) {
+        const auto& t = e.as_array();
+        audit::Entry en;
+        en.section = static_cast<uint8_t>(t[0].as_int());
+        en.count = static_cast<uint32_t>(t[1].as_int());
+        en.seq = t[2].as_int();
+        en.epoch = t[3].as_int();
+        // digests ride scripts as hex (u64 would round through doubles)
+        en.digest = strtoull(t[4].as_str().c_str(), nullptr, 16);
+        entries.push_back(en);
+      }
+      printf("%s\n",
+             codec::b64_encode(audit::encode_audit(entries)).c_str());
+      continue;
+    }
+    if (mode == "--audit-decode") {
+      auto raw = codec::b64_decode(line);
+      std::vector<audit::Entry> entries;
+      if (!raw || !audit::decode_audit(*raw, &entries)) {
+        printf("null\n");
+        continue;
+      }
+      Json arr;
+      for (const auto& e : entries) {
+        Json t;
+        t.push_back(Json(static_cast<int64_t>(e.section)));
+        t.push_back(Json(static_cast<int64_t>(e.count)));
+        t.push_back(Json(e.seq));
+        t.push_back(Json(e.epoch));
+        t.push_back(Json(audit::digest_hex(e.digest)));
+        arr.push_back(t);
+      }
+      if (arr.is_null()) arr = Json(JsonArray{});
+      Json out;
+      out.set("entries", arr);
+      printf("%s\n", out.dump().c_str());
       continue;
     }
     if (mode == "--decode") {

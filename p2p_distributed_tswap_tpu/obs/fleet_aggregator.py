@@ -29,7 +29,17 @@ operator-facing rollup ``analysis/fleet_top.py`` renders:
   delta-rate ``tasks_per_s`` with the same counter-reset clamp as the
   bandwidth rates, cumulative ``completion_ratio``) and fleet-level
   ``tasks_per_s`` / ``completion_ratio`` — the signals the SLO engine
-  (obs/slo.py) judges.
+  (obs/slo.py) judges;
+- world-epoch tracking (ISSUE 10 satellite): a peer whose metrics
+  beacon carries ``manager.world_seq`` / ``solverd.world_seq`` (and the
+  matching ``*.dynamic_world`` flag) gains a per-peer ``world`` section
+  — fleet_top's WORLD line renders it, so a dynamic-world-OFF manager
+  in a toggling fleet is visible instead of folklore;
+- the embedded auditor (ISSUE 10): ``audit_beacon`` payloads (topic
+  ``mapd.audit``) feed an :class:`obs.audit.AuditJoiner`; the rollup
+  gains an ``audit`` section (verdict, active divergences, per-peer
+  epochs) and fleet_top renders the AUDIT verdict line.  Feed audit
+  frames through the same :meth:`FleetAggregator.ingest`.
 """
 
 from __future__ import annotations
@@ -37,6 +47,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from p2p_distributed_tswap_tpu.obs import audit as _audit
 from p2p_distributed_tswap_tpu.obs import registry as _reg
 from p2p_distributed_tswap_tpu.obs.beacon import BEACON_INTERVAL_S
 from p2p_distributed_tswap_tpu.obs.registry import hist_quantile, parse_key
@@ -100,7 +111,8 @@ class FleetAggregator:
     """Merge beacons into a live fleet rollup."""
 
     def __init__(self, budget_ms: float = 500.0,
-                 stale_after_s: float = STALE_AFTER_S):
+                 stale_after_s: float = STALE_AFTER_S,
+                 on_divergence=None):
         self.budget_ms = budget_ms
         self.stale_after_s = stale_after_s
         self._peers: Dict[str, _PeerState] = {}
@@ -108,6 +120,11 @@ class FleetAggregator:
         # counter-reset evidence (process restarts observed via shrinking
         # cumulative counters; see _rates)
         self.counter_resets = 0
+        # embedded auditor (ISSUE 10): audit_beacon payloads route here;
+        # rollup() evaluates and exposes the verdict.  on_divergence
+        # fires once per confirmed divergence episode (fleet_top's live
+        # mode uses it to pull the fleet's black boxes).
+        self.audit = _audit.AuditJoiner(on_divergence=on_divergence)
 
     # cumulative counters watched for restarts (a shrink between two
     # consecutive beacons of one peer = the process restarted with a
@@ -120,6 +137,11 @@ class FleetAggregator:
     def ingest(self, payload: dict, now_ms: Optional[int] = None) -> bool:
         """Feed one bus message's data dict; non-beacons are ignored
         (returns False)."""
+        if isinstance(payload, dict) \
+                and payload.get("type") == "audit_beacon":
+            # the embedded auditor's feed (ISSUE 10): digest beacons
+            # merge into the joiner, not the metrics peer table
+            return self.audit.ingest(payload, now_ms=now_ms)
         if not isinstance(payload, dict) \
                 or payload.get("type") != "metrics_beacon":
             return False
@@ -312,11 +334,28 @@ class FleetAggregator:
                 "latency_p50_ms": round(hist_quantile(task_hist, 0.5), 1),
                 "latency_p95_ms": round(hist_quantile(task_hist, 0.95), 1),
             }
+        # world-epoch tracking (ISSUE 10 satellite): any peer carrying a
+        # world_seq gauge gains a `world` section — the seq AND the
+        # dynamic-world flag, so a toggling fleet with an epoch-unaware
+        # (dynamic-OFF) manager shows the split on the WORLD line
+        wseq = gauges.get("manager.world_seq",
+                          gauges.get("solverd.world_seq"))
+        wdyn = gauges.get("manager.dynamic_world",
+                          gauges.get("solverd.dynamic_world"))
+        if wseq is not None or wdyn is not None:
+            out["world"] = {
+                "seq": int(wseq or 0),
+                "dynamic": None if wdyn is None else bool(wdyn),
+            }
         return out
 
     def rollup(self, now_ms: Optional[int] = None) -> dict:
         """The fleet-wide snapshot fleet_top renders / dumps as JSON."""
         now_ms = _now_ms() if now_ms is None else now_ms
+        # audit judgment rides the rollup cadence (~ the beacon
+        # interval): streak thresholds confirm sustained divergences
+        if self.audit.beacons:
+            self.audit.evaluate(now_ms)
         peers = {peer: self._peer_rollup(st, now_ms)
                  for peer, st in sorted(self._peers.items())}
         ticks = [p["tick"] for p in peers.values() if p["tick"]]
@@ -330,6 +369,9 @@ class FleetAggregator:
             "ts_ms": now_ms,
             "budget_ms": self.budget_ms,
             "beacons_ingested": self.beacons_ingested,
+            # None until the first audit beacon: "no auditor evidence"
+            # must read unknown, never a silent green
+            "audit": self.audit.status() if self.audit.beacons else None,
             "peers": peers,
             "fleet": {
                 "peers": len(peers),

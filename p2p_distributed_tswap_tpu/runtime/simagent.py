@@ -43,6 +43,7 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from p2p_distributed_tswap_tpu.obs import audit as _audit
 from p2p_distributed_tswap_tpu.obs import events as _events
 from p2p_distributed_tswap_tpu.obs import registry as _reg
 from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
@@ -142,6 +143,16 @@ class SimAgentPool:
         self.moves = 0
         self.withdrawn = 0
         self.acked = 0
+        # audit plane (ISSUE 10): the pool is the agent-side state
+        # replica — it publishes a view digest (sorted held task ids)
+        # on mapd.audit so the auditor can join it against the
+        # manager's in-flight set.  JG_AUDIT=0 keeps the wire
+        # byte-identical to the pre-audit pool.
+        self.namespace = namespace or ""
+        self._audit_beacon = _audit.AuditBeacon(
+            self.bus, "simagent_pool", self._audit_entries,
+            ns=self.namespace) if _audit.enabled() else None
+        self.audit_beacons = 0
         # dynamic worlds (ISSUE 9): sim agents are move-obeying bodies —
         # routing around a toggled wall is the planner's job — but the
         # harness needs proof the frames propagated and what the manager
@@ -323,9 +334,26 @@ class SimAgentPool:
         elif typ is None and "pickup" in d and "delivery" in d:
             self._on_task(d, now)
 
+    def _audit_entries(self):
+        """The pool's agent-side view digest (ISSUE 10): sorted held
+        task ids, the SEC_VIEW canon the manager also beacons — their
+        digests agree iff the manager's in-flight set and the agents'
+        held set are the same tasks."""
+        held = [int(a.task["task_id"]) for a in self.agents.values()
+                if a.task is not None]
+        d, n = _audit.view_digest(held)
+        return ([_audit.AuditEntry(_audit.SEC_VIEW, n, 0, 0, d)],
+                {"held": n})
+
+    def _audit_beat(self, now: float) -> None:
+        if self._audit_beacon is not None \
+                and self._audit_beacon.maybe_beat(now) is not None:
+            self.audit_beacons += 1
+
     # -- the loop ---------------------------------------------------------
     def _due(self, now: float) -> None:
         """Heartbeats due this slice + done retransmits past their retry."""
+        self._audit_beat(now)
         for a in self.agents.values():
             if now >= a.next_hb:
                 self._beacon(a)

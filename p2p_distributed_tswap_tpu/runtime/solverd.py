@@ -95,6 +95,7 @@ import numpy as np
 from p2p_distributed_tswap_tpu.core.config import SolverConfig
 from p2p_distributed_tswap_tpu.core.grid import Grid
 from p2p_distributed_tswap_tpu.obs import HeartbeatWriter, registry, trace
+from p2p_distributed_tswap_tpu.obs import audit as obs_audit
 from p2p_distributed_tswap_tpu.obs import events as obs_events
 from p2p_distributed_tswap_tpu.obs import flightrec
 from p2p_distributed_tswap_tpu.obs.beacon import MetricsBeacon
@@ -290,6 +291,16 @@ class PlanService:
         env_dw = os.environ.get("JG_DYNAMIC_WORLD", "")
         self.dynamic_world = env_dw != "0"
         self.keep_dist = env_dw == "1"
+        # world-epoch tracking (ISSUE 10 satellite): always-present
+        # gauges so the fleet_top WORLD line can show a 0-epoch planner
+        registry.get_registry().gauge("solverd.world_seq", 0)
+        registry.get_registry().gauge("solverd.dynamic_world",
+                                      1 if self.dynamic_world else 0)
+        # injected-corruption test hook (ISSUE 10, JG_AUDIT_TEST_HOOKS):
+        # lane -> (field, forced_value, view) re-imposed after every
+        # state application, so the fault persists like a real bad lane
+        # instead of healing on the next delta
+        self.corrupt: Dict[int, Tuple[str, int, str]] = {}
         self.free_np = np.asarray(grid.free).copy()
         self.world_seq = 0
         self.world_log: List[int] = []      # toggled cells, in order
@@ -931,6 +942,59 @@ class PlanService:
                         if flat[n] == k:
                             flat[n] = DIR_STAY
 
+    # -- audit plane (ISSUE 10) -------------------------------------------
+
+    def set_corruption(self, lane: int, field: str = "goal",
+                       delta: int = 1, view: str = "both") -> bool:
+        """Register one sticky single-lane corruption (test hook for the
+        injected-corruption drill): ``field`` of ``lane`` is forced to
+        its current true value + ``delta`` after every state
+        application.  ``view`` = "both" corrupts host mirror AND device
+        (manager↔solverd roster divergence), "device" corrupts the
+        device slab only (device↔mirror drift)."""
+        lane = int(lane)
+        if field not in ("pos", "goal") or view not in ("both", "device"):
+            return False
+        if lane >= self.r_cap or not self.h_active[lane]:
+            return False
+        true = int((self.h_pos if field == "pos" else self.h_goal)[lane])
+        self.corrupt[lane] = (field, true + int(delta), view)
+        registry.get_registry().count("solverd.audit_corruptions")
+        self._apply_corruption()
+        return True
+
+    def _apply_corruption(self) -> None:
+        for lane, (field, value, view) in self.corrupt.items():
+            if lane >= self.r_cap or not self.h_active[lane]:
+                continue
+            if view != "device":
+                (self.h_pos if field == "pos" else self.h_goal)[lane] = value
+            vp = int(self.h_pos[lane])
+            vg = int(self.h_goal[lane])
+            if view == "device":
+                if field == "pos":
+                    vp = value
+                else:
+                    vg = value
+            self._scatter_lanes(np.asarray([lane], np.int32),
+                                np.asarray([vp], np.int32),
+                                np.asarray([vg], np.int32),
+                                np.asarray([int(self.h_slot[lane])],
+                                           np.int32),
+                                np.asarray([True]))
+
+    def audit_views(self, view: str):
+        """``(lanes, pos, goal)`` active-lane arrays of one audited view
+        ("mirror" = host arrays, "device" = a device pull)."""
+        if view == "device" and self.d_pos is not None:
+            da = np.asarray(self.d_active)
+            pos = np.asarray(self.d_pos)
+            goal = np.asarray(self.d_goal)
+        else:
+            da, pos, goal = self.h_active, self.h_pos, self.h_goal
+        act = np.flatnonzero(da)
+        return act, pos[act], goal[act]
+
     def _scatter_lanes(self, lanes, vp, vg, vs, va) -> None:
         """O(churn) device update: scatter per-lane values into the
         resident arrays, pow2-chunk-padded (see _pad_pow2_chunk)."""
@@ -990,6 +1054,7 @@ class PlanService:
             self.d_slot = jnp.asarray(self.h_slot)
             self.d_active = jnp.asarray(self.h_active)
             reg.count("solverd.snapshots_applied")
+            self._apply_corruption()
             return int(lanes.size)
         # delta: one final value per lane (a lane can be vacated AND
         # re-assigned to a new peer in the same packet — last write wins,
@@ -1028,6 +1093,7 @@ class PlanService:
         self.h_slot[lanes] = vs
         self.h_active[lanes] = va
         self._scatter_lanes(lanes, vp, vg, vs, va)
+        self._apply_corruption()
         return m
 
     def resident_dispatch(self) -> Optional[PendingPlan]:
@@ -1075,11 +1141,97 @@ def apply_world_frame(service: PlanService, reg, data: dict) -> int:
         return 0
     n = service.apply_world_update(toggles)
     reg.count("solverd.world_updates")
+    # epoch adoption (ISSUE 10): the frame carries the manager's
+    # monotone world_seq — adopt it so both sides' audit digests agree
+    # on the epoch watermark (the local bump alone would drift after a
+    # restart, where one replayed frame covers many original batches)
+    ws = data.get("world_seq")
+    if isinstance(ws, (int, float)) and int(ws) > service.world_seq:
+        service.world_seq = int(ws)
+        reg.gauge("solverd.world_seq", service.world_seq)
     if n:
         print(f"🌍 world_update (seq {data.get('world_seq')}): {n} "
               f"cell(s) toggled, {len(service.field_queue)} repair(s) "
               f"queued", flush=True)
     return n
+
+
+# ---------------------------------------------------------------------------
+# audit plane (ISSUE 10): digest entries, drill answering, corruption hook
+# ---------------------------------------------------------------------------
+
+
+def audit_entries(service: PlanService, seq: int
+                  ) -> Tuple[list, dict]:
+    """The flat daemon's audit-beacon body: host-mirror and device-pull
+    lane digests at the last applied seq (their equality IS the
+    device↔mirror consistency proof), plus the fresh field-cache cell
+    digest keyed by the world epoch."""
+    epoch = service.world_seq
+    entries = []
+    act, pos, goal = service.audit_views("mirror")
+    d, n = obs_audit.lane_digest(act, pos, goal)
+    entries.append(obs_audit.AuditEntry(obs_audit.SEC_MIRROR, n, seq,
+                                        epoch, d))
+    if service.d_pos is not None:
+        dact, dpos, dgoal = service.audit_views("device")
+        dd, dn = obs_audit.lane_digest(dact, dpos, dgoal)
+        entries.append(obs_audit.AuditEntry(obs_audit.SEC_DEVICE, dn, seq,
+                                            epoch, dd))
+    fresh = [g for g in service.goal_rows
+             if g != -1 and not service._is_stale(g)]
+    fd, fn = obs_audit.cells_digest(fresh)
+    entries.append(obs_audit.AuditEntry(obs_audit.SEC_FIELDS, fn, seq,
+                                        epoch, fd))
+    extra = {"dynamic_world": bool(service.dynamic_world),
+             "epoch": epoch, "seq": seq}
+    return entries, extra
+
+
+def audit_drill_reply(service: PlanService, names, req: dict,
+                      peer_id: str = "solverd") -> dict:
+    """Range-digest (plus leaf rows) over one audited view of the
+    resident fleet — the solverd side of the bisect protocol."""
+    view = req.get("view") or "mirror"
+    act, pos, goal = service.audit_views(
+        "device" if view == "device" else "mirror")
+    return obs_audit.drill_answer(req, act, pos, goal, names=names,
+                                  peer_id=peer_id)
+
+
+def handle_audit_frame(data: dict, service: PlanService, names,
+                       bus, reg, peer_id: str = "solverd") -> bool:
+    """Shared audit-plane frame handling for the flat daemon loop (drill
+    requests + the env-gated corruption hook).  Returns True when the
+    frame was an audit frame (handled or deliberately ignored)."""
+    typ = data.get("type")
+    if typ == "audit_drill_request":
+        if data.get("target") in ("solverd", peer_id):
+            bus.publish(obs_audit.AUDIT_TOPIC,
+                        audit_drill_reply(service, names, data,
+                                          peer_id=peer_id), raw=True)
+        return True
+    if typ == "audit_corrupt":
+        if not obs_audit.hooks_enabled():
+            # never a silent no-op: a drill harness must see its
+            # injection refused rather than wait for a divergence that
+            # can never come
+            reg.count("solverd.audit_corrupt_ignored")
+            print("🧪 audit_corrupt ignored (JG_AUDIT_TEST_HOOKS unset)",
+                  flush=True)
+            return True
+        ok = service.set_corruption(int(data.get("lane", -1)),
+                                    data.get("field") or "goal",
+                                    int(data.get("delta") or 1),
+                                    data.get("view") or "both")
+        print(f"🧪 audit_corrupt lane={data.get('lane')} "
+              f"field={data.get('field') or 'goal'} "
+              f"view={data.get('view') or 'both'} applied={ok}",
+              flush=True)
+        return True
+    if typ in ("audit_beacon", "audit_drill_response"):
+        return True  # other peers' audit traffic on the shared topic
+    return False
 
 
 class PendingTick:
@@ -2040,6 +2192,40 @@ class MultiTenantRunner:
         return snap
 
 
+def audit_entries_tenant(slab: TenantSlab, tenant: Tenant
+                         ) -> Tuple[list, dict]:
+    """One tenant's audit-beacon body: its slab-row host-mirror and
+    device-pull digests at ITS decoder seq (the manager behind this
+    tenant's namespace publishes the matching shadow ring)."""
+    svc = slab.service
+    row = tenant.row
+    seq = (tenant.decoder.last_seq
+           if tenant.decoder.last_seq is not None else 0)
+    epoch = svc.world_seq
+    act = np.flatnonzero(slab.h_active[row])
+    d, n = obs_audit.lane_digest(act, slab.h_pos[row][act],
+                                 slab.h_goal[row][act])
+    entries = [obs_audit.AuditEntry(obs_audit.SEC_MIRROR, n, seq,
+                                    epoch, d)]
+    if slab.d_pos is not None and row < slab.T_cap:
+        dmask = np.asarray(slab.d_active[row])
+        dact = np.flatnonzero(dmask)
+        dd, dn = obs_audit.lane_digest(dact,
+                                       np.asarray(slab.d_pos[row])[dact],
+                                       np.asarray(slab.d_goal[row])[dact])
+        entries.append(obs_audit.AuditEntry(obs_audit.SEC_DEVICE, dn, seq,
+                                            epoch, dd))
+    extra = {"dynamic_world": bool(svc.dynamic_world),
+             "epoch": epoch, "seq": seq}
+    return entries, extra
+
+
+def tenant_audit_peer(ns: str) -> str:
+    """The per-tenant audit peer id: one daemon publishes one digest
+    stream per tenant, and the joiner keys streams by peer."""
+    return f"solverd[{ns or 'default'}]"
+
+
 def multi_tenant_loop(bus: BusClient, runner: MultiTenantRunner,
                       slab: TenantSlab, beacon,
                       stats_requested: dict, dump_stats) -> None:
@@ -2054,6 +2240,84 @@ def multi_tenant_loop(bus: BusClient, runner: MultiTenantRunner,
     svc = slab.service
     pending: Optional[PendingSuper] = None
 
+    # audit plane (ISSUE 10): one digest stream PER TENANT (each joins
+    # against its own namespaced manager's shadow ring) plus a shared
+    # field-cache stream, all on the raw operator topic
+    audit_on = obs_audit.enabled()
+    audit_interval = obs_audit.interval_s()
+    audit_state = {"last": 0.0, "effective": audit_interval}
+
+    def audit_beat() -> None:
+        if not audit_on:
+            return
+        now = time.monotonic()
+        if audit_state["last"] \
+                and now - audit_state["last"] < audit_state["effective"]:
+            return
+        audit_state["last"] = now
+        t0 = time.perf_counter()
+        ts_ms = time.time_ns() // 1_000_000
+        payloads = []
+        for t in list(runner.tenants.values()):
+            entries, extra = audit_entries_tenant(slab, t)
+            payloads.append({
+                "type": "audit_beacon",
+                "peer_id": tenant_audit_peer(t.ns),
+                "proc": "solverd", "ns": t.ns, "pid": os.getpid(),
+                "ts_ms": ts_ms,
+                "caps": [obs_audit.AUDIT_CAP],
+                "data": obs_audit.encode_audit_b64(entries),
+                **extra})
+        fresh = [g for g in svc.goal_rows
+                 if g != -1 and not svc._is_stale(g)]
+        fd, fn = obs_audit.cells_digest(fresh)
+        payloads.append({
+            "type": "audit_beacon", "peer_id": "solverd",
+            "proc": "solverd", "ns": "", "pid": os.getpid(),
+            "ts_ms": ts_ms,
+            "caps": [obs_audit.AUDIT_CAP],
+            "dynamic_world": bool(svc.dynamic_world),
+            "epoch": svc.world_seq,
+            "data": obs_audit.encode_audit_b64(
+                [obs_audit.AuditEntry(obs_audit.SEC_FIELDS, fn, 0,
+                                      svc.world_seq, fd)])})
+        # self-throttle like AuditBeacon: per-tenant digest bodies
+        # re-hash every slab row — cap audit overhead at ~2% of the
+        # daemon loop by stretching the cadence when a beat runs long.
+        # Publish AFTER the recompute so every stream advertises the
+        # cadence this beat actually set (the joiner's silent threshold
+        # is 3x the advertised value)
+        audit_state["effective"] = max(
+            audit_interval, 50.0 * (time.perf_counter() - t0))
+        for p in payloads:
+            p["interval_s"] = audit_state["effective"]
+            bus.publish(obs_audit.AUDIT_TOPIC, p, raw=True)
+
+    def handle_audit(data: dict) -> None:
+        typ = data.get("type")
+        if typ == "audit_drill_request":
+            tns = data.get("ns") or ""
+            t = runner.tenants.get(tns)
+            if t is None or data.get("target") not in (
+                    "solverd", tenant_audit_peer(tns)):
+                return
+            view = data.get("view") or "mirror"
+            row = t.row
+            if view == "device" and slab.d_pos is not None:
+                mask = np.asarray(slab.d_active[row])
+                pos = np.asarray(slab.d_pos[row])
+                goal = np.asarray(slab.d_goal[row])
+            else:
+                mask, pos, goal = (slab.h_active[row], slab.h_pos[row],
+                                   slab.h_goal[row])
+            act = np.flatnonzero(mask)
+            bus.publish(obs_audit.AUDIT_TOPIC, obs_audit.drill_answer(
+                data, act, pos[act], goal[act], names=t.decoder.names,
+                peer_id=tenant_audit_peer(tns)), raw=True)
+        elif typ == "audit_corrupt":
+            # the sticky corruption hook is a flat-daemon test fixture
+            runner.registry.count("solverd.audit_corrupt_ignored")
+
     def route(frame) -> Optional[Tuple[str, dict]]:
         """(tenant ns, plan_request payload) of a frame, handling the
         control messages inline; None for everything else."""
@@ -2063,6 +2327,12 @@ def multi_tenant_loop(bus: BusClient, runner: MultiTenantRunner,
         topic = frame.get("topic") or ""
         ns, logical = busns.split_ns(topic)
         typ = data.get("type")
+        if logical == obs_audit.AUDIT_TOPIC:
+            # raw operator plane: drill requests resolve a tenant row via
+            # the request's ns field; beacons from other peers are noise
+            if ns == "":
+                handle_audit(data)
+            return None
         if logical == ADMIT_TOPIC:
             if typ == "tenant_hello" and isinstance(data.get("ns"), str):
                 try:
@@ -2114,6 +2384,7 @@ def multi_tenant_loop(bus: BusClient, runner: MultiTenantRunner,
         frame = bus.recv(timeout=0.002 if pending is not None
                          else (0.02 if svc.field_queue else 1.0))
         beacon.maybe_beat()
+        audit_beat()
         if stats_requested["flag"]:
             stats_requested["flag"] = False
             dump_stats()
@@ -2242,6 +2513,11 @@ def main(argv=None) -> int:
             bus.subscribe("solver")  # the un-namespaced default fleet
     else:
         bus.subscribe("solver")
+    if obs_audit.enabled():
+        # audit plane (ISSUE 10): digest beacons + drill answering ride
+        # the raw operator topic.  JG_AUDIT=0 skips the subscription AND
+        # every frame — the wire stays byte-identical to pre-audit.
+        bus.subscribe(obs_audit.AUDIT_TOPIC, raw=True)
 
     try:
         jax.devices()
@@ -2299,6 +2575,14 @@ def main(argv=None) -> int:
         print(f"📡 /metrics on http://127.0.0.1:{http_srv.server_port}",
               flush=True)
     beacon = MetricsBeacon(bus, proc="solverd")
+    audit_beacon = None
+    if obs_audit.enabled() and not multi_tenant:
+        audit_beacon = obs_audit.AuditBeacon(
+            bus, "solverd",
+            lambda: audit_entries(
+                service,
+                runner.packed.last_seq
+                if runner.packed.last_seq is not None else 0))
 
     # SIGUSR1 = operator stats dump: signal handlers only flip a flag (the
     # handler can interrupt the plan path mid-tick, where a full dump
@@ -2345,6 +2629,8 @@ def main(argv=None) -> int:
         frame = bus.recv(timeout=0.002 if pending is not None
                          else (0.02 if service.field_queue else 1.0))
         beacon.maybe_beat()  # ~2 s cadence riding the recv timeout
+        if audit_beacon is not None:
+            audit_beacon.maybe_beat()  # digest beacon, same cadence
         if not caps_logged and bus.hub_caps is not None:
             # relay-framing negotiation outcome (hub welcome), once —
             # operators can see at a glance whether responses ride the
@@ -2385,6 +2671,12 @@ def main(argv=None) -> int:
             # cache, queue repairs — never stalls the tick path
             runner.handle_world(data)
             continue
+        if obs_audit.enabled() and handle_audit_frame(
+                data, service, runner.packed.names, bus,
+                registry.get_registry()):
+            # audit plane (ISSUE 10): drill requests answered from the
+            # resident mirrors/device, corruption hook, peer noise
+            continue
         if data.get("type") != "plan_request":
             continue
         # Staleness drop: if planning fell behind the manager's tick (slow
@@ -2414,6 +2706,12 @@ def main(argv=None) -> int:
                 # world toggles are ORDER-SENSITIVE against the deltas
                 # around them and must not vanish in a drain either
                 runner.handle_world(ndata)
+            elif obs_audit.enabled() and str(
+                    ndata.get("type") or "").startswith("audit_"):
+                # a drill request queued behind plan_requests must be
+                # answered, not swallowed by the stale drain
+                handle_audit_frame(ndata, service, runner.packed.names,
+                                   bus, registry.get_registry())
         for stale_req in reqs[:-1]:
             runner.ingest(stale_req, stale=True)
         ok = runner.ingest(reqs[-1])

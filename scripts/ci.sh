@@ -37,6 +37,8 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/ops/field_repair.py \
     p2p_distributed_tswap_tpu/ops/field_fused.py \
     p2p_distributed_tswap_tpu/obs/slo.py \
+    p2p_distributed_tswap_tpu/obs/audit.py \
+    scripts/audit_smoke.py \
     analysis/fleetsim.py \
     analysis/tenant_scaling.py \
     analysis/field_bench.py \
@@ -150,6 +152,21 @@ PY
         --log-dir /tmp/jg_dynworld_ci_logs
 else
     echo "dynamic-world smoke SKIPPED (no C++ toolchain / binaries)"
+fi
+
+echo "== audit smoke =="
+# ISSUE 10: state-consistency gate, both halves every run — a tiny live
+# fleet must end with ZERO confirmed divergences (a fleet that cannot
+# prove itself consistent fails CI), then the injected-corruption drill
+# must confirm a roster divergence and bisect it to the exact lane +
+# field (a gate that cannot trip is no gate)
+if [[ -x cpp/build/mapd_bus && -x cpp/build/mapd_manager_centralized ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    JAX_PLATFORMS=cpu python scripts/audit_smoke.py \
+        --log-dir /tmp/jg_audit_ci_logs
+else
+    echo "audit smoke SKIPPED (no C++ toolchain / binaries)"
 fi
 
 echo "== multi-tenant smoke =="

@@ -201,6 +201,106 @@ def world_fuzz(seed: int, count: int = 100) -> bool:
     return True
 
 
+def audit_fuzz(seed: int, count: int = 80) -> bool:
+    """Random audit-plane inputs (ISSUE 10): the py digest canon
+    (lane/ledger/view/cells FNV-1a chains) and the audit1 beacon blob
+    must be byte-identical py<->cpp, and malformed blobs must be
+    rejected on both sides.  Returns False when the golden binary is
+    unavailable (pure-python checks still ran)."""
+    import json as _json
+
+    from p2p_distributed_tswap_tpu.obs import audit as au
+
+    rng = np.random.default_rng(seed)
+    digest_cases = []  # (script line, py digest hex, py count)
+    for _ in range(count):
+        kind = int(rng.integers(4))
+        if kind == 0:
+            n = int(rng.integers(0, 20))
+            lanes = rng.choice(1 << 16, size=n, replace=False).astype(int)
+            pos = rng.integers(0, 1 << 20, size=n)
+            goal = rng.integers(0, 1 << 20, size=n)
+            d, c = au.lane_digest(lanes, pos, goal)
+            line = _json.dumps({"lanes": [[int(l), int(p), int(g)]
+                                          for l, p, g in
+                                          zip(lanes, pos, goal)]})
+        elif kind == 1:
+            n = int(rng.integers(0, 20))
+            tasks = [(int(rng.integers(1, 1 << 44)),
+                      int(rng.integers(0, 3)),
+                      int(rng.integers(-1, 1 << 20)),
+                      int(rng.integers(-1, 1 << 20)))
+                     for _ in range(n)]
+            d, c = au.ledger_digest(tasks)
+            line = _json.dumps({"ledger": [list(t) for t in tasks]})
+        elif kind == 2:
+            ids = [int(t) for t in rng.integers(1, 1 << 44,
+                                                size=rng.integers(0, 30))]
+            d, c = au.view_digest(ids)
+            line = _json.dumps({"view": ids})
+        else:
+            cells = [int(t) for t in rng.integers(0, 1 << 20,
+                                                  size=rng.integers(0, 30))]
+            d, c = au.cells_digest(cells)
+            line = _json.dumps({"cells": cells})
+        digest_cases.append((line, au.digest_hex(d), c))
+
+    blob_cases = []  # (entries, py b64)
+    for _ in range(count // 2):
+        entries = [au.AuditEntry(int(rng.integers(1, 7)),
+                                 int(rng.integers(0, 1 << 31)),
+                                 int(rng.integers(0, 1 << 44)),
+                                 int(rng.integers(0, 1 << 31)),
+                                 int(rng.integers(0, 1 << 64,
+                                                  dtype=np.uint64)))
+                   for _ in range(int(rng.integers(0, 7)))]
+        b64 = au.encode_audit_b64(entries)
+        assert au.decode_audit_b64(b64) == entries, \
+            f"audit seed {seed}: py round-trip diverged"
+        raw = au.encode_audit(entries)
+        for bad in (raw[:-1], b"\xff" + raw[1:], raw + b"\x00", b""):
+            try:
+                au.decode_audit(bad)
+            except au.AuditCodecError:
+                continue
+            raise AssertionError(f"audit seed {seed}: bad blob accepted")
+        blob_cases.append((entries, b64))
+
+    binary = _golden_binary()
+    if binary is None:
+        return False
+    feed = "\n".join(line for line, _, _ in digest_cases) + "\n"
+    out = subprocess.run([str(binary), "--audit-digest"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    for (line, hexd, c), got in zip(digest_cases, out.stdout.splitlines()):
+        g = _json.loads(got)
+        assert (g["digest"], g["count"]) == (hexd, c), \
+            f"audit seed {seed}: cpp digest diverged on {line}"
+    feed = "\n".join(
+        _json.dumps({"entries": [[e.section, e.count, e.seq, e.epoch,
+                                  au.digest_hex(e.digest)]
+                                 for e in entries]})
+        for entries, _ in blob_cases) + "\n"
+    out = subprocess.run([str(binary), "--audit-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert out.stdout.split() == [b64 for _, b64 in blob_cases], \
+        f"audit seed {seed}: cpp audit1 encoder bytes diverged"
+    out = subprocess.run([str(binary), "--audit-decode"],
+                         input="\n".join(b64 for _, b64 in blob_cases)
+                         + "\n",
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    for (entries, _), got in zip(blob_cases, out.stdout.splitlines()):
+        g = _json.loads(got)
+        want = [[e.section, e.count, e.seq, e.epoch,
+                 au.digest_hex(e.digest)] for e in entries]
+        assert g and g["entries"] == want, \
+            f"audit seed {seed}: cpp audit1 decoder diverged"
+    return True
+
+
 def golden_fuzz(lines_by_seed: dict) -> bool:
     binary = _golden_binary()
     if binary is None:
@@ -301,6 +401,13 @@ def main() -> int:
               "byte-identical")
     else:
         print("world1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
+              file=sys.stderr)
+    audit_native = all([audit_fuzz(seed) for seed in range(args.seeds)])
+    if audit_native:
+        print(f"audit1 fuzz: {args.seeds} seeds digests + blobs "
+              "byte-identical, malformed rejected")
+    else:
+        print("audit1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
               file=sys.stderr)
     if not args.skip_plans:
         for seed in range(2):

@@ -1,0 +1,901 @@
+"""Audit plane (ISSUE 10): digest canon + audit1 codec (py↔cpp golden),
+joiner classification, bisect driller, solverd corruption hook, the
+aggregator/fleet_top AUDIT+WORLD surfaces, blackbox --audit merge, the
+JG_AUDIT=0 raw-socket wire pin, and the live injected-corruption drill.
+
+Unit layers run pure-Python; the pin + drill tests spawn the C++
+manager (and, for the drill, busd + solverd + a sim pool); the SIGKILL
+divergence/reconvergence e2e is marked slow.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.obs import audit as au
+from p2p_distributed_tswap_tpu.obs import registry as _reg
+from p2p_distributed_tswap_tpu.obs.fleet_aggregator import FleetAggregator
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# digest canon + audit1 blob
+# ---------------------------------------------------------------------------
+
+def test_lane_digest_sorts_by_lane_and_counts():
+    d1, n1 = au.lane_digest([3, 1, 2], [30, 10, 20], [33, 11, 22])
+    d2, n2 = au.lane_digest([1, 2, 3], [10, 20, 30], [11, 22, 33])
+    assert (d1, n1) == (d2, 3)
+    # a single changed goal changes the digest
+    d3, _ = au.lane_digest([1, 2, 3], [10, 20, 30], [11, 22, 34])
+    assert d3 != d1
+    # empty is the FNV offset basis
+    d0, n0 = au.lane_digest([], [], [])
+    assert (d0, n0) == (au.FNV64_OFFSET, 0)
+
+
+def test_ledger_view_cells_digests():
+    tasks = [(7, au.TASK_TO_PICKUP, 4, 9), (3, au.TASK_PENDING, 1, 2)]
+    d1, n1 = au.ledger_digest(tasks)
+    d2, n2 = au.ledger_digest(list(reversed(tasks)))
+    assert (d1, n1) == (d2, 2)  # canon sorts by (task_id, state)
+    assert au.view_digest([5, 2, 9]) == au.view_digest([9, 5, 2])
+    assert au.cells_digest([8, 1]) == au.cells_digest([1, 8])
+    assert au.view_digest([1]) != au.view_digest([2])
+    assert len(au.digest_hex(d1)) == 16
+
+
+def test_audit1_roundtrip_and_rejection():
+    entries = [au.AuditEntry(au.SEC_SHADOW, 5, 42, 3, 0xDEADBEEF12345678),
+               au.AuditEntry(au.SEC_LEDGER, 0, 0, 0, 0)]
+    b64 = au.encode_audit_b64(entries)
+    assert au.decode_audit_b64(b64) == entries
+    raw = au.encode_audit(entries)
+    for bad in (raw[:-1], b"\x00" + raw[1:], raw + b"x", b""):
+        with pytest.raises(au.AuditCodecError):
+            au.decode_audit(bad)
+    with pytest.raises(au.AuditCodecError):
+        au.decode_audit_b64("!!!not-base64!!!")
+
+
+def _golden_binary():
+    from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu
+
+    return build_single_tu("mapd_codec_golden",
+                           "cpp/probes/codec_golden.cpp")
+
+
+def test_digest_and_blob_golden_vs_cpp():
+    """Fixed golden vectors through the native audit canon: digests and
+    audit1 blobs must be byte-identical py↔cpp (the shardmap golden
+    discipline)."""
+    binary = _golden_binary()
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    scripts = [
+        {"lanes": [[2, 118, 1211], [0, 5, 6], [1, 88, 99]]},
+        {"lanes": []},
+        {"ledger": [[9, 1, 100, 200], [4, 0, 7, 8], [9, 2, 100, 200]]},
+        {"view": [12, 5, 99, 3]},
+        {"cells": [1024, 7, 65535]},
+    ]
+    feed = "\n".join(json.dumps(s) for s in scripts) + "\n"
+    out = subprocess.run([str(binary), "--audit-digest"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=60)
+    got = [json.loads(l) for l in out.stdout.splitlines()]
+    want = []
+    for s in scripts:
+        if "lanes" in s:
+            tri = s["lanes"]
+            d, n = au.lane_digest([t[0] for t in tri], [t[1] for t in tri],
+                                  [t[2] for t in tri])
+        elif "ledger" in s:
+            d, n = au.ledger_digest([tuple(t) for t in s["ledger"]])
+        elif "view" in s:
+            d, n = au.view_digest(s["view"])
+        else:
+            d, n = au.cells_digest(s["cells"])
+        want.append({"digest": au.digest_hex(d), "count": n})
+    assert got == want
+    entries = [au.AuditEntry(au.SEC_MIRROR, 3, 17, 2, 0x0123456789ABCDEF)]
+    out = subprocess.run(
+        [str(binary), "--audit-encode"],
+        input=json.dumps({"entries": [[e.section, e.count, e.seq, e.epoch,
+                                       au.digest_hex(e.digest)]
+                                      for e in entries]}) + "\n",
+        capture_output=True, text=True, check=True, timeout=60)
+    assert out.stdout.strip() == au.encode_audit_b64(entries)
+
+
+# ---------------------------------------------------------------------------
+# the joiner: classification, confirmation streaks, healing
+# ---------------------------------------------------------------------------
+
+def _beacon(peer, entries, proc="p", ns="", dynamic=None, interval=2.0):
+    p = {"type": "audit_beacon", "peer_id": peer, "proc": proc, "ns": ns,
+         "interval_s": interval, "caps": [au.AUDIT_CAP],
+         "data": au.encode_audit_b64(entries)}
+    if dynamic is not None:
+        p["dynamic_world"] = dynamic
+    return p
+
+
+def _sh(seq, digest, count=3, epoch=0):
+    return au.AuditEntry(au.SEC_SHADOW, count, seq, epoch, digest)
+
+
+def _mi(seq, digest, count=3, epoch=0):
+    return au.AuditEntry(au.SEC_MIRROR, count, seq, epoch, digest)
+
+
+def test_joiner_green_on_matching_roster():
+    j = au.AuditJoiner()
+    assert j.ingest(_beacon("mgr", [_sh(5, 111)]), now_ms=1000)
+    assert j.ingest(_beacon("sol", [_mi(5, 111)]), now_ms=1000)
+    assert j.evaluate(now_ms=1000) == []
+    assert j.joins >= 1
+    assert j.verdict() == "green"
+    assert not j.ingest({"type": "metrics_beacon"})  # not an audit frame
+
+
+def test_joiner_roster_divergence_confirms_and_heals():
+    j = au.AuditJoiner()
+    j.ingest(_beacon("mgr", [_sh(5, 111)]), now_ms=1000)
+    j.ingest(_beacon("sol", [_mi(5, 222)]), now_ms=1000)
+    # one beacon pair is never enough — a restart can briefly overlay
+    # old-run and new-run seqs at the same watermark
+    assert j.evaluate(now_ms=1000) == []
+    # polling again WITHOUT fresh beacons must not advance the streak
+    assert j.evaluate(now_ms=1100) == []
+    assert j.evaluate(now_ms=1200) == []
+    j.ingest(_beacon("mgr", [_sh(6, 112)]), now_ms=2000)
+    j.ingest(_beacon("sol", [_mi(6, 223)]), now_ms=2000)
+    confirmed = j.evaluate(now_ms=2000)  # second round of evidence
+    assert [d["class"] for d in confirmed] == ["roster"]
+    assert confirmed[0]["seq"] == 6
+    assert j.verdict() == "red"
+    # heal: a later matching watermark clears the episode
+    j.ingest(_beacon("mgr", [_sh(7, 333)]), now_ms=3000)
+    j.ingest(_beacon("sol", [_mi(7, 333)]), now_ms=3000)
+    assert j.evaluate(now_ms=3000) == []
+    assert j.active() == []
+    assert j.verdict() == "green"
+    # a NEW episode re-confirms (not latched), and active() shows ONE
+    # record per key — the newest episode, not the whole history
+    for seq, ms in ((8, 5000), (9, 6000)):
+        j.ingest(_beacon("mgr", [_sh(seq, 1)]), now_ms=ms)
+        j.ingest(_beacon("sol", [_mi(seq, 2)]), now_ms=ms)
+        out = j.evaluate(now_ms=ms)
+    assert [d["class"] for d in out] == ["roster"]
+    assert len(j.active()) == 1 and j.active()[0]["seq"] == 9
+
+
+def test_joiner_manager_restart_is_not_a_roster_divergence():
+    """A replaced manager (new peer_id, plan seq back at 1) must read as
+    the OLD peer going silent — its stale shadow ring and the solverd
+    ring's old-run seqs must never join against new-run watermarks."""
+    j = au.AuditJoiner()
+    # old run: healthy at seqs around 500
+    for seq, ms in ((500, 1000), (501, 3000)):
+        j.ingest(_beacon("mgr-old", [_sh(seq, 7)], interval=1.0),
+                 now_ms=ms)
+        j.ingest(_beacon("sol", [_mi(seq, 7)], interval=1.0), now_ms=ms)
+        assert j.evaluate(now_ms=ms) == []
+    # manager restarts under a new peer_id; solverd's chain restarts at
+    # seq 1 with DIFFERENT digests than the old run had at those seqs
+    for seq, ms in ((1, 9000), (2, 10_000), (3, 11_000)):
+        j.ingest(_beacon("mgr-new", [_sh(seq, 40 + seq)], interval=1.0),
+                 now_ms=ms)
+        j.ingest(_beacon("sol", [_mi(seq, 40 + seq)], interval=1.0),
+                 now_ms=ms)
+        confirmed = j.evaluate(now_ms=ms)
+        assert all(d["class"] == "silent" for d in confirmed), confirmed
+    # the only divergence is the old manager gone quiet
+    assert {d["class"] for d in j.active()} <= {"silent"}
+    assert any(d["peer_a"] == "mgr-old" for d in j.active())
+
+
+def test_joiner_view_needs_stability_and_churn_is_not_divergence():
+    def vw(digest, count):
+        return au.AuditEntry(au.SEC_VIEW, count, 0, 0, digest)
+
+    def lg(digest):
+        return au.AuditEntry(au.SEC_LEDGER, 2, 0, 0, digest)
+
+    j = au.AuditJoiner()
+    # churning pool: view digest changes every beacon -> never judged
+    for k, ms in enumerate((1000, 3000, 5000)):
+        j.ingest(_beacon("mgr", [lg(9), vw(100, 2)]), now_ms=ms)
+        j.ingest(_beacon("pool", [vw(200 + k, 2)]), now_ms=ms)
+        assert j.evaluate(now_ms=ms) == []
+    # stuck mismatch: both sides stable across beacons -> confirmed
+    # after the view streak (3 evidence rounds) — as an AMBER advisory
+    # (the ledger-vs-agents comparison rides multi-second propagation
+    # windows, so it leads investigations rather than paging)
+    j2 = au.AuditJoiner()
+    out = []
+    for ms in (1000, 3000, 5000, 7000, 9000):
+        j2.ingest(_beacon("mgr", [lg(9), vw(100, 2)]), now_ms=ms)
+        j2.ingest(_beacon("pool", [vw(999, 3)]), now_ms=ms)
+        out += j2.evaluate(now_ms=ms)
+    assert [d["class"] for d in out] == ["view"]
+    assert j2.verdict() == "amber"
+
+
+def test_joiner_epoch_classes():
+    # stale_epoch: two epoch-aware peers disagree on the world epoch
+    j = au.AuditJoiner()
+    out = []
+    for ms in (1000, 3000, 5000, 7000):
+        j.ingest(_beacon("mgr", [_sh(5, 1, epoch=3)], dynamic=True),
+                 now_ms=ms)
+        j.ingest(_beacon("sol", [_mi(5, 1, epoch=1)], dynamic=True),
+                 now_ms=ms)
+        out += j.evaluate(now_ms=ms)
+    assert [d["class"] for d in out] == ["stale_epoch"]
+    assert j.verdict() == "amber"
+    # epoch_unaware: a dynamic-world-OFF peer in an epoch>0 fleet (the
+    # PR 9 caveat made visible)
+    j2 = au.AuditJoiner()
+    out = []
+    for ms in (1000, 3000, 5000, 7000):
+        j2.ingest(_beacon("mgr", [_sh(5, 1, epoch=2)], dynamic=True),
+                  now_ms=ms)
+        j2.ingest(_beacon("ns-mgr", [au.AuditEntry(au.SEC_LEDGER, 1, 0,
+                                                   0, 7)],
+                          dynamic=False), now_ms=ms)
+        out += j2.evaluate(now_ms=ms)
+    assert "epoch_unaware" in [d["class"] for d in out]
+
+
+def test_joiner_silent_peer_only_when_fleet_is_fresh():
+    j = au.AuditJoiner()
+    j.ingest(_beacon("sol", [_mi(5, 1)], interval=1.0), now_ms=1000)
+    j.ingest(_beacon("mgr", [_sh(5, 1)], interval=1.0), now_ms=1000)
+    # both quiet: the whole fleet paused, NOT a divergence
+    assert all(d["class"] != "silent"
+               for d in j.evaluate(now_ms=60_000))
+    # manager fresh, solverd quiet past 3 intervals: silent (streak 2)
+    j.ingest(_beacon("mgr", [_sh(6, 1)], interval=1.0), now_ms=61_000)
+    out = j.evaluate(now_ms=61_200)
+    j.ingest(_beacon("mgr", [_sh(7, 1)], interval=1.0), now_ms=62_000)
+    out += j.evaluate(now_ms=62_200)
+    assert [d["class"] for d in out] == ["silent"]
+    assert out[0]["peer_a"] == "sol"
+
+
+# ---------------------------------------------------------------------------
+# the bisect driller
+# ---------------------------------------------------------------------------
+
+def _two_sided_transport(a_state, b_state, names):
+    """Answer drill requests from two in-memory lane views."""
+    def transport(req):
+        lanes, pos, goal = a_state if req["target"] == "A" else b_state
+        return au.drill_answer(req, lanes, pos, goal, names=names,
+                               peer_id=req["target"])
+    return transport
+
+
+def test_driller_localizes_single_goal_divergence():
+    n = 37
+    lanes = np.arange(n)
+    pos = np.arange(n) * 10
+    goal_a = np.arange(n) * 10 + 5
+    goal_b = goal_a.copy()
+    goal_b[17] += 1  # the corruption
+    names = [f"ag{k:02d}" for k in range(n)]
+    dr = au.AuditDriller(transport=_two_sided_transport(
+        (lanes, pos, goal_a), (lanes, pos, goal_b), names))
+    res = dr.drill_lanes("A", "shadow", "B", "mirror", span=64)
+    assert res["findings"] == [{"lane": 17, "peer": "ag17",
+                                "field": "goal",
+                                "a": int(goal_a[17]),
+                                "b": int(goal_b[17])}]
+    # ~2 requests per level plus the top and leaf pairs
+    assert res["requests"] <= 2 * (2 + 2 * 6)
+    s = au.render_finding(res["findings"][0], width=100)
+    assert "ag17" in s and "goal" in s
+
+
+def test_driller_detects_missing_lane_and_no_divergence():
+    lanes = np.arange(8)
+    pos = np.arange(8)
+    goal = np.arange(8) + 100
+    # side B lost lane 3 entirely
+    keep = lanes != 3
+    dr = au.AuditDriller(transport=_two_sided_transport(
+        (lanes, pos, goal), (lanes[keep], pos[keep], goal[keep]), None))
+    res = dr.drill_lanes("A", "shadow", "B", "mirror", span=16)
+    assert {"lane": 3, "peer": "", "field": "active",
+            "a": 1, "b": None} in res["findings"]
+    # identical sides: honest empty answer
+    dr2 = au.AuditDriller(transport=_two_sided_transport(
+        (lanes, pos, goal), (lanes, pos, goal), None))
+    assert dr2.drill_lanes("A", "shadow", "B", "mirror",
+                           span=16)["findings"] == []
+
+
+def test_driller_reports_no_response():
+    dr = au.AuditDriller(transport=lambda req: None)
+    assert dr.drill_lanes("A", "shadow", "B", "mirror",
+                          span=8)["error"] == "no_response"
+
+
+# ---------------------------------------------------------------------------
+# solverd: corruption hook + audit entries (resident state)
+# ---------------------------------------------------------------------------
+
+def _resident_runner(monkeypatch, n=4, side=16):
+    from p2p_distributed_tswap_tpu.runtime.solverd import (
+        PlanService, TickRunner)
+
+    monkeypatch.setenv("JG_AUDIT_TEST_HOOKS", "1")
+    grid = Grid(np.ones((side, side), np.bool_))
+    runner = TickRunner(PlanService(grid, capacity_min=4), grid)
+    enc = pc.PackedFleetEncoder(snapshot_every=64)
+    fleet = [(f"ag{k}", 10 * k + 1, 10 * k + 3) for k in range(n)]
+    pkt = enc.encode_tick(1, fleet)
+    assert runner.ingest({"type": "plan_request", "seq": 1,
+                          "codec": pc.CODEC_NAME, "caps": [pc.CODEC_NAME],
+                          "data": pc.encode_b64(pkt)})
+    return runner, enc, fleet
+
+
+def test_corruption_hook_both_view_diverges_mirror_from_truth(monkeypatch):
+    from p2p_distributed_tswap_tpu.runtime.solverd import audit_entries
+
+    runner, enc, fleet = _resident_runner(monkeypatch)
+    svc = runner.service
+    truth_lanes, truth_pos, truth_goal = svc.audit_views("mirror")
+    truth_d, _ = au.lane_digest(truth_lanes, truth_pos, truth_goal)
+    assert svc.set_corruption(1, field="goal", delta=1, view="both")
+    m_lanes, m_pos, m_goal = svc.audit_views("mirror")
+    m_d, _ = au.lane_digest(m_lanes, m_pos, m_goal)
+    assert m_d != truth_d  # mirror forked from the manager's truth
+    d_lanes, d_pos, d_goal = svc.audit_views("device")
+    d_d, _ = au.lane_digest(d_lanes, d_pos, d_goal)
+    assert d_d == m_d  # view=both keeps device == mirror
+    # the fault STICKS across the next state application
+    pkt2 = enc.encode_tick(2, [(n, p + 1, g) for n, p, g in fleet])
+    assert runner.ingest({"type": "plan_request", "seq": 2,
+                          "codec": pc.CODEC_NAME, "caps": [pc.CODEC_NAME],
+                          "data": pc.encode_b64(pkt2)})
+    assert int(svc.h_goal[1]) == fleet[1][2] + 1
+    entries, extra = audit_entries(svc, 2)
+    secs = {e.section for e in entries}
+    assert {au.SEC_MIRROR, au.SEC_DEVICE, au.SEC_FIELDS} <= secs
+    assert all(e.seq == 2 for e in entries)
+
+
+def test_corruption_hook_device_view_drifts_device_from_mirror(monkeypatch):
+    runner, enc, fleet = _resident_runner(monkeypatch)
+    svc = runner.service
+    assert svc.set_corruption(0, field="pos", delta=2, view="device")
+    m = au.lane_digest(*svc.audit_views("mirror"))
+    d = au.lane_digest(*svc.audit_views("device"))
+    assert m != d  # device slab drifted under an intact host mirror
+    # guard rails: bad field/view/inactive lane refused
+    assert not svc.set_corruption(0, field="slot")
+    assert not svc.set_corruption(0, view="nope")
+    assert not svc.set_corruption(999)
+
+
+def test_handle_audit_frame_drill_and_hook_gating(monkeypatch):
+    from p2p_distributed_tswap_tpu.runtime.solverd import handle_audit_frame
+
+    runner, enc, fleet = _resident_runner(monkeypatch)
+
+    class FakeBus:
+        def __init__(self):
+            self.sent = []
+
+        def publish(self, topic, data, raw=False):
+            self.sent.append((topic, data))
+
+    bus = FakeBus()
+    reg = _reg.get_registry()
+    names = list(runner.packed.names)
+    # drill request for the whole span answers with digest + count
+    assert handle_audit_frame({"type": "audit_drill_request",
+                               "target": "solverd", "req_id": 1,
+                               "view": "mirror", "lo": 0, "hi": 1024},
+                              runner.service, names, bus, reg)
+    topic, resp = bus.sent[-1]
+    assert topic == au.AUDIT_TOPIC
+    assert resp["type"] == "audit_drill_response"
+    assert resp["count"] == len(fleet)
+    want_d, _ = au.lane_digest(*runner.service.audit_views("mirror"))
+    assert resp["digest"] == au.digest_hex(want_d)
+    # a leaf request names the agent
+    handle_audit_frame({"type": "audit_drill_request", "target": "solverd",
+                        "req_id": 2, "view": "mirror", "lo": 1, "hi": 2,
+                        "rows": True},
+                       runner.service, names, bus, reg)
+    rows = bus.sent[-1][1]["rows"]
+    assert rows == [[1, fleet[1][1], fleet[1][2], 1, "ag1"]]
+    # another peer's drill is consumed but unanswered
+    n_before = len(bus.sent)
+    assert handle_audit_frame({"type": "audit_drill_request",
+                               "target": "manager_centralized"},
+                              runner.service, names, bus, reg)
+    assert len(bus.sent) == n_before
+    # hooks disarmed: audit_corrupt refused loudly, never applied
+    monkeypatch.setenv("JG_AUDIT_TEST_HOOKS", "0")
+    before = au.lane_digest(*runner.service.audit_views("mirror"))
+    assert handle_audit_frame({"type": "audit_corrupt", "lane": 0},
+                              runner.service, names, bus, reg)
+    assert au.lane_digest(*runner.service.audit_views("mirror")) == before
+
+
+# ---------------------------------------------------------------------------
+# beacon, aggregator + fleet_top surfaces, blackbox merge
+# ---------------------------------------------------------------------------
+
+def test_audit_beacon_payload_and_cadence():
+    class FakeBus:
+        peer_id = "mgr-1"
+
+        def __init__(self):
+            self.sent = []
+
+        def publish(self, topic, data, raw=False):
+            self.sent.append((topic, data, raw))
+
+    bus = FakeBus()
+    entries = [au.AuditEntry(au.SEC_LEDGER, 2, 9, 1, 77)]
+    b = au.AuditBeacon(bus, "mgr", lambda: (entries, {"epoch": 1}),
+                       interval=10.0)
+    p = b.maybe_beat(now=100.0)
+    assert p is not None and b.published == 1
+    topic, data, raw = bus.sent[0]
+    assert (topic, raw) == (au.AUDIT_TOPIC, True)
+    assert data["caps"] == [au.AUDIT_CAP] and data["epoch"] == 1
+    assert au.decode_audit_b64(data["data"]) == entries
+    assert b.maybe_beat(now=105.0) is None  # inside the interval
+    assert b.maybe_beat(now=111.0) is not None
+
+
+def test_aggregator_audit_section_and_world_line():
+    from analysis.fleet_top import render
+
+    agg = FleetAggregator()
+    # a metrics beacon with world gauges -> per-peer world section
+    assert agg.ingest({
+        "type": "metrics_beacon", "peer_id": "mgr-1", "proc":
+        "manager_centralized", "interval_s": 2.0,
+        "metrics": {"counters": {}, "gauges": {"manager.world_seq": 4.0,
+                                               "manager.dynamic_world": 0.0},
+                    "hists": {}, "uptime_s": 10.0}})
+    # mismatched roster digests across two beacon rounds -> red audit
+    # section (one round is never confirmed — restart-overlay guard)
+    assert agg.ingest(_beacon("mgr-1", [_sh(5, 1, epoch=4)]))
+    assert agg.ingest(_beacon("sol", [_mi(5, 2, epoch=4)]))
+    agg.rollup()
+    assert agg.ingest(_beacon("mgr-1", [_sh(6, 1, epoch=4)]))
+    assert agg.ingest(_beacon("sol", [_mi(6, 2, epoch=4)]))
+    rollup = agg.rollup()
+    assert rollup["peers"]["mgr-1"]["world"] == {"seq": 4,
+                                                "dynamic": False}
+    assert rollup["audit"]["verdict"] == "red"
+    assert rollup["audit"]["classes"].get("roster", 0) >= 1
+    text = render(rollup)
+    assert "WORLD" in text and "OFF!" in text
+    assert "AUDIT RED" in text and "roster" in text
+    # no audit beacons -> audit must read unknown (None), never green
+    assert FleetAggregator().rollup()["audit"] is None
+
+
+def test_blackbox_audit_merge(tmp_path, capsys):
+    from analysis import blackbox
+
+    (tmp_path / "mgr-1.flight.jsonl").write_text(
+        json.dumps({"meta": "flight", "proc": "mgr", "pid": 1,
+                    "reason": "exit", "events": 1}) + "\n"
+        + json.dumps({"ts_ms": 1000, "proc": "mgr", "pid": 1,
+                      "event": "task.dispatch", "task_id": 7}) + "\n")
+    (tmp_path / "auditor.audit.jsonl").write_text(
+        json.dumps({"ts_ms": 1500, "class": "roster", "ns": "",
+                    "peer_a": "mgr-1", "peer_b": "sol", "seq": 5,
+                    "epoch": 0, "detail": "shadow != mirror"}) + "\n")
+    rc = blackbox.main(["--dir", str(tmp_path), "--audit", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["audit_divergences"] == 1
+    kinds = [e["event"] for e in out["events"]]
+    assert "audit.divergence" in kinds and "task.dispatch" in kinds
+    # divergence records surface even without --audit? no — opt-in
+    rc = blackbox.main(["--dir", str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert all(e["event"] != "audit.divergence" for e in out["events"])
+
+
+# ---------------------------------------------------------------------------
+# live: JG_AUDIT=0 raw-socket wire pin + the injected-corruption drill
+# ---------------------------------------------------------------------------
+
+TINY16 = "\n".join(["." * 16] * 16) + "\n"
+
+
+@pytest.fixture(scope="module")
+def built():
+    from p2p_distributed_tswap_tpu.runtime.fleet import ensure_built
+
+    ensure_built()
+
+
+def _capture_manager_bytes(tmp_path, env_extra, seconds=2.5):
+    """Spawn the C++ centralized manager against a raw fake-busd socket
+    and return every byte it writes — the wire-pin harness."""
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    mapf = tmp_path / "t16.map.txt"
+    mapf.write_text(TINY16)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    received = []
+
+    def server():
+        conn, _ = srv.accept()
+        conn.sendall(b'{"op":"welcome","peer_id":"x",'
+                     b'"caps":["relay1"]}\n')
+        end = time.monotonic() + seconds
+        buf = b""
+        conn.settimeout(0.25)
+        while time.monotonic() < end:
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            if not chunk:
+                break
+            buf += chunk
+        received.append(buf)
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    mgr = subprocess.Popen(
+        [str(Path(BUILD_DIR) / "mapd_manager_centralized"),
+         "--port", str(port), "--map", str(mapf)],
+        stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        env={**os.environ, "JG_TRACE_CTX": "0", **env_extra})
+    try:
+        t.join(timeout=seconds + 15)
+    finally:
+        mgr.terminate()
+        mgr.wait(timeout=10)
+        srv.close()
+    assert received, "manager never connected to the pin socket"
+    return received[0]
+
+
+def test_audit_kill_switch_pins_wire(built, tmp_path):
+    """JG_AUDIT=0 keeps the manager's byte stream free of ANY audit
+    traffic (no mapd.audit subscription, no beacon, no caps token);
+    JG_AUDIT=1 publishes audit_beacon frames on mapd.audit."""
+    quiet = _capture_manager_bytes(
+        tmp_path, {"JG_AUDIT": "0", "JG_AUDIT_INTERVAL_MS": "300"})
+    assert b"audit" not in quiet, quiet[:2000]
+    loud = _capture_manager_bytes(
+        tmp_path, {"JG_AUDIT": "1", "JG_AUDIT_INTERVAL_MS": "300"})
+    assert b"mapd.audit" in loud  # the subscription
+    assert b"audit_beacon" in loud  # the digest beacon
+    assert b'"audit1"' in loud  # the caps token
+
+
+def _spawn_bus(port):
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    return subprocess.Popen([str(Path(BUILD_DIR) / "mapd_bus"), str(port)],
+                            stdout=subprocess.DEVNULL)
+
+
+def test_decentralized_manager_answers_ledger_and_view_drills(
+        built, tmp_path):
+    """Both C++ managers answer drills: the decentralized manager's
+    ledger (requeue + in-flight tuples) and in-flight view are range-
+    drillable.  A full-range drill must hash to the SAME digest its
+    beacon advertised (drill responder and beacon share one canon), and
+    an empty range hashes to the empty chain."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    mapf = tmp_path / "t16.map.txt"
+    mapf.write_text(TINY16)
+    port = _free_port()
+    bus = _spawn_bus(port)
+    mgr = cli = None
+    try:
+        time.sleep(0.3)
+        # the fake agent: subscribing "mapd" makes it a dispatchable
+        # peer (peer_joined), but it never claims — the assigned task
+        # stays in-flight, so the ledger holds still for the drills
+        cli = BusClient(port=port, peer_id="drill-fake-agent")
+        cli.subscribe("mapd")
+        cli.subscribe(au.AUDIT_TOPIC, raw=True)
+        mgr = subprocess.Popen(
+            [str(Path(BUILD_DIR) / "mapd_manager_decentralized"),
+             "--port", str(port), "--map", str(mapf)],
+            stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+            env={**os.environ, "JG_AUDIT_INTERVAL_MS": "300"})
+        beacon = None
+        deadline = time.monotonic() + 30
+        last_cmd = 0.0
+        while beacon is None and time.monotonic() < deadline:
+            # re-issue until discovery lands: once the peer is busy the
+            # command is a no-op, so at most one task is ever in flight
+            if time.monotonic() - last_cmd > 1.0:
+                mgr.stdin.write(b"tasks 1\n")
+                mgr.stdin.flush()
+                last_cmd = time.monotonic()
+            f = cli.recv(timeout=0.25)
+            if f and f.get("op") == "msg":
+                d = f.get("data") or {}
+                if d.get("type") == "audit_beacon" \
+                        and d.get("proc") == "manager_decentralized" \
+                        and (d.get("buckets") or {}).get("in_flight") == 1:
+                    beacon = d
+        assert beacon, "no decentralized audit beacon with an in-flight task"
+        secs = {e.section: e for e in au.decode_audit_b64(beacon["data"])}
+        driller = au.AuditDriller(bus=cli, timeout=5.0)
+        led = driller._ask(beacon["peer_id"], "ledger", 0, 1 << 53)
+        assert led is not None, "no ledger drill response"
+        assert led["count"] == 1
+        assert led["digest"] == au.digest_hex(secs[au.SEC_LEDGER].digest)
+        view = driller._ask(beacon["peer_id"], "view", 0, 1 << 53)
+        assert view is not None, "no view drill response"
+        assert view["count"] == 1
+        assert view["digest"] == au.digest_hex(secs[au.SEC_VIEW].digest)
+        # the proc-name target alias + an empty range -> the empty chain
+        empty = driller._ask("manager_decentralized", "ledger",
+                             1 << 40, 1 << 41)
+        assert empty is not None and empty["count"] == 0
+        assert empty["digest"] == au.digest_hex(au.ledger_digest([])[0])
+    finally:
+        if cli is not None:
+            cli.close()
+        if mgr is not None:
+            mgr.terminate()
+            mgr.wait(timeout=10)
+        bus.terminate()
+
+
+def _pump_joiner(cli, joiner, seconds):
+    end = time.monotonic() + seconds
+    confirmed = []
+    while time.monotonic() < end:
+        f = cli.recv(timeout=0.25)
+        if f and f.get("op") == "msg":
+            joiner.ingest(f.get("data") or {})
+        confirmed += joiner.evaluate()
+    return confirmed
+
+
+def test_injected_corruption_detected_and_bisected(built, tmp_path):
+    """ISSUE 10 acceptance: flip one device lane via the test hook; the
+    auditor must confirm a roster divergence within 3 digest intervals
+    and the bisect drill must localize it to the exact agent + field."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime.fleet import (
+        BUILD_DIR, wait_for_log)
+    from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool
+
+    mapf = tmp_path / "t16.map.txt"
+    mapf.write_text(TINY16)
+    port = _free_port()
+    bus = _spawn_bus(port)
+    sd = mgr = pool = None
+    sd_log = open(tmp_path / "solverd.log", "w")
+    env = {**os.environ, "JG_AUDIT_TEST_HOOKS": "1",
+           "JG_AUDIT_INTERVAL_MS": "400", "JG_AUDIT_INTERVAL_S": "0.4"}
+    try:
+        time.sleep(0.3)
+        # --warm: first-use JAX compiles stall the daemon loop for
+        # seconds on a small host and would read as a `silent` beacon
+        # gap during the clean phase
+        sd = subprocess.Popen(
+            [sys.executable, "-m",
+             "p2p_distributed_tswap_tpu.runtime.solverd",
+             "--port", str(port), "--cpu", "--map", str(mapf),
+             "--warm", "4"],
+            stdout=sd_log, stderr=subprocess.STDOUT, env=env)
+        assert wait_for_log(tmp_path / "solverd.log", "solverd up", 120,
+                            proc=sd)
+        mgr = subprocess.Popen(
+            [str(Path(BUILD_DIR) / "mapd_manager_centralized"),
+             "--port", str(port), "--map", str(mapf), "--solver", "tpu",
+             "--planning-interval-ms", "250"],
+            stdin=subprocess.PIPE, stdout=subprocess.DEVNULL, env=env)
+        time.sleep(0.5)
+        n = 4
+        pool = SimAgentPool(n, 16, port=port, seed=5)
+        pool.heartbeat_all()
+        pool.pump(1.5)
+        mgr.stdin.write(f"tasks {n}\n".encode())
+        mgr.stdin.flush()
+        deadline = time.monotonic() + 45
+        while pool.adopted < n and time.monotonic() < deadline:
+            pool.pump(0.5)
+        assert pool.adopted >= n, pool.stats()
+
+        cli = BusClient(port=port, peer_id="auditor-test")
+        cli.subscribe(au.AUDIT_TOPIC, raw=True)
+        joiner = au.AuditJoiner()
+        # pre-corruption: beacons flow and the fleet judges clean
+        _pump_joiner(cli, joiner, 2.5)
+        assert joiner.beacons >= 2, "no audit beacons observed"
+        assert joiner.active() == []
+
+        # flip one lane's goal on BOTH device and mirror: manager truth
+        # vs solverd state forks
+        t_inject = time.monotonic()
+        cli.publish(au.AUDIT_TOPIC, {"type": "audit_corrupt", "lane": 1,
+                                     "field": "goal", "delta": 1,
+                                     "view": "both"}, raw=True)
+        # keep the plan wire ticking so fresh digests flow
+        confirmed = []
+        deadline = time.monotonic() + 15
+        while not any(d["class"] == "roster" for d in confirmed) \
+                and time.monotonic() < deadline:
+            pool.pump(0.2)
+            confirmed += _pump_joiner(cli, joiner, 0.4)
+        detect_s = time.monotonic() - t_inject
+        assert any(d["class"] == "roster" for d in confirmed), \
+            (confirmed, joiner.status())
+        # within 3 digest intervals (0.4 s each) + join/tick slack
+        assert detect_s < 3 * 0.4 + 4.0, detect_s
+
+        # bisect to the exact lane + field without shipping state
+        driller = au.AuditDriller(bus=cli, timeout=5.0)
+        res = driller.drill_lanes("manager_centralized", "shadow",
+                                  "solverd", "mirror", span=1 << 10)
+        assert res.get("findings"), res
+        goal_findings = [f for f in res["findings"]
+                         if f["field"] == "goal"]
+        assert len(goal_findings) == 1, res
+        f = goal_findings[0]
+        assert f["lane"] == 1
+        assert f["b"] == f["a"] + 1  # delta=+1 on the solverd side
+        assert f["peer"].startswith("12D3KooW")  # the exact agent id
+        cli.close()
+    finally:
+        for p in (mgr, sd):
+            if p is not None:
+                p.terminate()
+        if pool is not None:
+            pool.close()
+        bus.terminate()
+        sd_log.close()
+
+
+def test_sigkill_solverd_flags_divergence_then_reconverges(built, tmp_path):
+    """ISSUE 10 satellite e2e: SIGKILL solverd mid-dynamic-world run —
+    the auditor flags the gap (silent class), and after a restarted
+    daemon's plan_snapshot_request resync (which now REPLAYS the
+    accumulated world toggles) the fleet judges clean again at the
+    manager's epoch."""
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime.fleet import (
+        BUILD_DIR, wait_for_log)
+    from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool
+
+    mapf = tmp_path / "t16.map.txt"
+    mapf.write_text(TINY16)
+    port = _free_port()
+    bus = _spawn_bus(port)
+    sd = mgr = pool = None
+    env = {**os.environ, "JG_DYNAMIC_WORLD": "1",
+           "JG_AUDIT_INTERVAL_MS": "400", "JG_AUDIT_INTERVAL_S": "0.4"}
+
+    def start_solverd(log_name):
+        log = open(tmp_path / log_name, "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m",
+             "p2p_distributed_tswap_tpu.runtime.solverd",
+             "--port", str(port), "--cpu", "--map", str(mapf),
+             "--warm", "4"],
+            stdout=log, stderr=subprocess.STDOUT, env=env)
+        assert wait_for_log(tmp_path / log_name, "solverd up", 120, proc=p)
+        return p, log
+
+    logs = []
+    try:
+        time.sleep(0.3)
+        sd, log = start_solverd("solverd1.log")
+        logs.append(log)
+        mgr = subprocess.Popen(
+            [str(Path(BUILD_DIR) / "mapd_manager_centralized"),
+             "--port", str(port), "--map", str(mapf), "--solver", "tpu",
+             "--planning-interval-ms", "250"],
+            stdin=subprocess.PIPE, stdout=subprocess.DEVNULL, env=env)
+        time.sleep(0.5)
+        n = 4
+        pool = SimAgentPool(n, 16, port=port, seed=7)
+        pool.heartbeat_all()
+        pool.pump(1.5)
+        mgr.stdin.write(f"tasks {n}\n".encode())
+        mgr.stdin.flush()
+        deadline = time.monotonic() + 45
+        while pool.adopted < n and time.monotonic() < deadline:
+            pool.pump(0.5)
+        assert pool.adopted >= n, pool.stats()
+        # mid-run world toggle: the manager's epoch moves to >= 1
+        pool.bus.publish("mapd", {"type": "world_update_request",
+                                  "toggles": [[15, 15, 1]]})
+        deadline = time.monotonic() + 20
+        while pool.world_accepted < 1 and time.monotonic() < deadline:
+            pool.pump(0.5)
+        assert pool.world_accepted >= 1, pool.stats()
+
+        cli = BusClient(port=port, peer_id="auditor-test")
+        cli.subscribe(au.AUDIT_TOPIC, raw=True)
+        joiner = au.AuditJoiner()
+        _pump_joiner(cli, joiner, 2.5)
+        assert joiner.beacons >= 2
+
+        sd.send_signal(9)  # SIGKILL: no dying gasp, just silence
+        sd.wait(timeout=10)
+        confirmed = []
+        deadline = time.monotonic() + 20
+        while not any(d["class"] == "silent" for d in confirmed) \
+                and time.monotonic() < deadline:
+            pool.pump(0.2)
+            confirmed += _pump_joiner(cli, joiner, 0.4)
+        assert any(d["class"] == "silent" and "solverd" in d["peer_a"]
+                   for d in confirmed), confirmed
+
+        sd, log = start_solverd("solverd2.log")
+        logs.append(log)
+        # the restarted daemon seq-gaps -> plan_snapshot_request ->
+        # snapshot + world replay; divergences must HEAL (silent clears,
+        # epochs re-align via frame adoption)
+        deadline = time.monotonic() + 40
+        clean = False
+        while time.monotonic() < deadline:
+            pool.pump(0.3)
+            _pump_joiner(cli, joiner, 0.4)
+            st = joiner.status()
+            # clean = no RED divergence (an amber view advisory may ride
+            # the restart's propagation window) AND the CURRENT mirror
+            # digest carries the adopted epoch — the joiner's per-peer
+            # epoch field is max-merged over time and would pass on the
+            # pre-kill daemon's beacons alone
+            red = [d for d in st["active"]
+                   if d["class"] in au.RED_CLASSES]
+            peer = joiner._peers.get("solverd")
+            mir = peer.latest.get(au.SEC_MIRROR) if peer else None
+            if not red and mir is not None and mir.epoch >= 1:
+                clean = True
+                break
+        assert clean, joiner.status()
+        assert (tmp_path / "solverd2.log").read_text().count(
+            "requested full snapshot") >= 1
+        cli.close()
+    finally:
+        for p in (mgr, sd):
+            if p is not None:
+                p.terminate()
+        if pool is not None:
+            pool.close()
+        bus.terminate()
+        for log in logs:
+            log.close()
