@@ -15,10 +15,18 @@ divergence records (``*.audit.jsonl``, written by the standalone auditor
 distributed state forked relative to the last seconds of lifecycle
 events, not just that it did.
 
+``--capture OUT`` (ISSUE 11) rebuilds a replayable ``capture1``
+artifact from the same flight rings: the sim pool's ``capture.meta`` /
+``task.spec`` / ``world.update`` evidence events become the fleet
+config, the task list with arrival offsets, and the world-toggle
+timeline — so a crash's last window re-drives on demand via
+``analysis/fleetsim.py --replay OUT``.
+
 Usage:
   python analysis/blackbox.py --dir <fleet log dir> [--last 30] [--json]
   python analysis/blackbox.py --dir results/trace --grep task.dispatch
   python analysis/blackbox.py --dir <fleet log dir> --audit
+  python analysis/blackbox.py --dir <fleet log dir> --capture out.json
 """
 
 from __future__ import annotations
@@ -27,6 +35,8 @@ import argparse
 import json
 import sys
 from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 
 def load_dumps(directory: Path) -> tuple:
@@ -68,7 +78,7 @@ def load_audit(directory: Path) -> list:
                 continue
             if not isinstance(rec, dict) or "ts_ms" not in rec:
                 continue
-            out.append({
+            ev = {
                 "ts_ms": rec["ts_ms"],
                 "proc": "auditor",
                 "pid": path.stem.split(".")[0],
@@ -80,7 +90,12 @@ def load_audit(directory: Path) -> list:
                 "seq": rec.get("seq"),
                 "epoch": rec.get("epoch"),
                 "error": rec.get("detail"),
-            })
+            }
+            if rec.get("capture"):
+                # the auto-dumped replayable capture (ISSUE 11): the
+                # post-mortem names the file that reproduces the window
+                ev["capture"] = rec["capture"]
+            out.append(ev)
     return out
 
 
@@ -90,7 +105,7 @@ def render_event(ev: dict, t_end_ms: int) -> str:
     detail = " ".join(
         f"{k}={ev[k]}" for k in ("task_id", "trace_id", "hop", "peer",
                                  "wire_ms", "seq", "epoch", "class",
-                                 "error")
+                                 "error", "capture")
         if k in ev)
     mark = "🔴 " if ev.get("event") == "audit.divergence" else "  "
     return (f"{mark}{rel:+9.3f}s  {who:<28} "
@@ -109,10 +124,40 @@ def main(argv=None) -> int:
     ap.add_argument("--audit", action="store_true",
                     help="merge auditor divergence records "
                          "(*.audit.jsonl) into the timeline (ISSUE 10)")
+    ap.add_argument("--capture", default=None, metavar="OUT",
+                    help="rebuild a replayable capture1 artifact from "
+                         "the flight rings' evidence events (ISSUE 11) "
+                         "and write it to OUT")
+    ap.add_argument("--capture-agents", type=int, default=None,
+                    help="fleet-config override when the rings' "
+                         "capture.meta rotated out")
+    ap.add_argument("--capture-side", type=int, default=None)
+    ap.add_argument("--capture-seed", type=int, default=None)
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
     directory = Path(args.dir)
+    if args.capture:
+        from p2p_distributed_tswap_tpu.obs import capture as _capture
+
+        overrides = {k: v for k, v in
+                     (("agents", args.capture_agents),
+                      ("side", args.capture_side),
+                      ("seed", args.capture_seed)) if v is not None}
+        try:
+            doc = _capture.from_flight_dir(directory,
+                                           fleet_overrides=overrides)
+        except _capture.CaptureError as e:
+            print(f"blackbox: cannot assemble a capture from "
+                  f"{directory}: {e}", file=sys.stderr)
+            return 1
+        path = _capture.save(args.capture, doc)
+        print(f"capture1 written to {path}: {len(doc['tasks'])} task(s), "
+              f"{len(doc['world'])} world event(s), fleet "
+              f"{doc['fleet']['agents']} agents on "
+              f"{doc['fleet']['side']}x{doc['fleet']['side']} — replay "
+              f"with: python analysis/fleetsim.py --replay {path}")
+        return 0
     metas, events = load_dumps(directory)
     audit_events = load_audit(directory) if args.audit else []
     if audit_events:

@@ -144,6 +144,31 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
                      + (f"↔{d['peer_b']}" if d.get("peer_b") else "")
                      + f": {d['detail']}")
         lines.append(head)
+    # replay drift (ISSUE 11): the replay driver's progress beacons —
+    # injection progress, completions, duplicates, and tasks/s vs the
+    # captured original (the live answer to "is this replay faithful?")
+    rp = rollup.get("replay")
+    if rp:
+        line = (f"REPLAY [{rp.get('capture_source') or '?'}] "
+                f"inj {_fmt(rp.get('injected'))}/{_fmt(rp.get('total'))}"
+                f" done {_fmt(rp.get('done'))}")
+        if rp.get("done_dups"):
+            line += f" DUPS {rp['done_dups']}!"
+        if rp.get("world_injected"):
+            line += f" world {rp['world_injected']}"
+        line += f"  tasks/s {_fmt(rp.get('tasks_per_s'))}"
+        if rp.get("tasks_per_s_delta") is not None:
+            line += (f" vs orig {_fmt(rp.get('orig_tasks_per_s'))}"
+                     f" (Δ{rp['tasks_per_s_delta']:+g})")
+        if rp.get("drift_pct") is not None:
+            line += f" drift {rp['drift_pct']:+g}%"
+        if rp.get("phase_p95_delta_ms"):
+            line += " Δp95 " + " ".join(
+                f"{ph}{v:+g}ms"
+                for ph, v in sorted(rp["phase_p95_delta_ms"].items()))
+        if rp.get("final"):
+            line += " (final)"
+        lines.append(line)
     # fleet task throughput (ISSUE 7): manager done-counter derivations
     if f.get("tasks_dispatched") is not None:
         ratio = f.get("completion_ratio")

@@ -52,7 +52,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from p2p_distributed_tswap_tpu.obs import audit as _audit  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import capture as _capture  # noqa: E402
 from p2p_distributed_tswap_tpu.obs import events as _events  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import flightrec as _flightrec  # noqa: E402
 from p2p_distributed_tswap_tpu.obs import registry as _reg  # noqa: E402
 from p2p_distributed_tswap_tpu.obs import trace as _trace  # noqa: E402
 from p2p_distributed_tswap_tpu.obs import slo as _slo  # noqa: E402
@@ -85,9 +88,13 @@ class MetricsWindow:
     divide by the FIRST→LAST BEACON span, not the harness's window
     wall clock (beacons land up to an interval late on either edge)."""
 
-    def __init__(self, port: int):
+    def __init__(self, port: int, audit: bool = False):
         self.bus = BusClient(port=port, peer_id="fleetsim-watch")
         self.bus.subscribe(METRICS_TOPIC)
+        if audit and _audit.enabled():
+            # replay mode joins the audit plane too: final-watermark
+            # ledger/view digests are the determinism proof (ISSUE 11)
+            self.bus.subscribe(_audit.AUDIT_TOPIC, raw=True)
         self.agg = FleetAggregator()
         self._peers = {}  # peer_id -> _PeerWindow
 
@@ -101,6 +108,12 @@ class MetricsWindow:
             if not f or f.get("op") != "msg":
                 continue
             d = f.get("data") or {}
+            if d.get("type") != "metrics_beacon":
+                # audit beacons (and the replay driver's own beacons)
+                # route into the aggregator but never into the per-peer
+                # metrics windows — their payloads carry no counters
+                self.agg.ingest(d)
+                continue
             if not self.agg.ingest(d):
                 continue
             proc = d.get("proc", "?")
@@ -263,11 +276,32 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
              "--port", str(home_port), "--map", args.map_file,
              "--solver", "cpu" if args.solver == "native" else "tpu",
              "--planning-interval-ms", str(tick_ms),
-             "--max-tracked-agents", str(agents + 16)],
+             "--max-tracked-agents", str(agents + 16),
+             # seed audit (ISSUE 11): the manager's task sampling is the
+             # last stochastic path fleetsim touches — thread the one
+             # harness seed through it so a rung is re-runnable
+             "--seed", str(args.seed)],
             stdin=subprocess.PIPE)
         time.sleep(0.5)
         sim = SimAgentPool(agents, args.side, port=home_port,
                            seed=args.seed, heartbeat_s=args.heartbeat_s)
+        recorder = None
+        if getattr(args, "capture", None):
+            # traffic capture (ISSUE 11): record every dispatched task
+            # and accepted world update as replayable traffic, anchored
+            # at pool creation so the ramp is part of the window
+            recorder = _capture.CaptureRecorder({
+                "agents": agents, "side": args.side, "seed": args.seed,
+                "shards": args.shards, "solver": args.solver,
+                "tick_ms": tick_ms, "heartbeat_s": args.heartbeat_s,
+                "manager_seed": args.seed})
+            sim.capture = recorder
+            # harness-side config into the flight ring: the post-mortem
+            # assembly path (blackbox --capture) merges it with the
+            # pool's own capture.meta
+            _events.emit("capture.meta", shards=args.shards,
+                         solver=args.solver, tick_ms=tick_ms,
+                         manager_seed=args.seed)
         watch = MetricsWindow(home_port)
         sim.heartbeat_all()
         sim.pump(1.5)
@@ -410,6 +444,35 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             }
         if timeline is not None:
             rung["timeline"] = timeline
+        if recorder is not None:
+            phase_p95 = {
+                ph: pcts.get("p95")
+                for ph, pcts in ((timeline or {}).get("fleet_phases_ms")
+                                 or {}).items()
+                if isinstance(pcts, dict)
+                and pcts.get("p95") is not None}
+            baseline = {
+                "window_s": round(wall, 1),
+                "tasks_per_s": signals.get("fleet.tasks_per_s"),
+                "completion_ratio": signals.get("fleet.completion_ratio"),
+                "claim_wire_p99_ms": signals.get("sim.claim_wire_p99_ms"),
+                "phase_p95_ms": phase_p95 or None,
+            }
+            try:
+                path = _capture.save(args.capture,
+                                     recorder.finalize(baseline=baseline))
+            except _capture.CaptureError as e:
+                # an unreplayable window (e.g. no task ever dispatched)
+                # must not discard the rung's own verdicts/signals — the
+                # window is still diagnosable, just not re-drivable
+                rung["capture_error"] = str(e)
+                print(f"fleetsim: capture SKIPPED: {e}", flush=True)
+            else:
+                rung["capture"] = str(path)
+                print(f"fleetsim: capture1 written to {path} "
+                      f"({len(recorder.tasks)} task(s), "
+                      f"{len(recorder.world)} world event(s))",
+                      flush=True)
         return rung
     finally:
         for obj in (sim, watch):
@@ -426,9 +489,394 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             pool.close()
         for log in logs:
             log.close()
+        # the harness's own flight ring holds the capture evidence
+        # (capture.meta / task.spec / world.update, ISSUE 11) — dump it
+        # into the run's log dir NOW, while we still know which run this
+        # was: the atexit dump fires after the env restore below and
+        # would land in the default dir, stranding the post-mortem
+        # `blackbox.py --capture` path for in-process windows
+        _rec = _flightrec.get_recorder()
+        _flightrec.dump(str(log_dir / f"{_rec.proc}-{_rec.pid}"
+                                      ".flight.jsonl"),
+                        reason="rung_teardown")
         os.environ.clear()
         os.environ.update(saved_env)
         # re-bind the sinks to the restored environment
+        _trace.configure(proc="simfleet")
+        _events.configure("simfleet")
+
+
+class ReplayCtx:
+    """The live handles a chaos fault script pokes at (ISSUE 11,
+    scripts/chaos_gate.py): the busd pool (kill_shard / SIGSTOP a
+    member), the manager and solverd processes (signals), the sim pool,
+    and a solverd respawner for kill-and-recover faults.  ``notes``
+    accumulates a human-readable fault log that rides the replay
+    artifact."""
+
+    def __init__(self, pool, mgr, sim, solverd, start_solverd):
+        self.pool = pool
+        self.manager = mgr
+        self.sim = sim
+        self.solverd = solverd
+        self._start_solverd = start_solverd
+        self._solverd_generation = 0
+        self.notes: list = []
+
+    def note(self, text: str) -> None:
+        self.notes.append(text)
+        print(f"chaos: {text}", flush=True)
+
+    def restart_solverd(self, wait: bool = False):
+        """Respawn solverd (default non-blocking: a chaos recovery must
+        not stall the replay loop for the whole JAX warmup — the fleet's
+        own resync machinery picks the daemon up when it's ready)."""
+        self._solverd_generation += 1
+        self.solverd = self._start_solverd(
+            f"_r{self._solverd_generation}", wait=wait)
+        return self.solverd
+
+
+def _final_digests(joiner) -> dict:
+    """The determinism proof's raw material: each role's NEWEST audit
+    digests at the drained watermark — the manager's ledger / in-flight
+    view / lane shadow, the agent pool's held view, solverd's mirror.
+    Ledger and view must be equal across two replays of one capture
+    (both sides fully drained); lane digests (positions) are recorded
+    for diagnosis only — assignment interleaving is the live planner's."""
+    out = {}
+    for name, st in joiner._peers.items():
+        sections = []
+        if st.proc.startswith("manager"):
+            sections = [(_audit.SEC_LEDGER, "ledger"),
+                        (_audit.SEC_VIEW, "view"),
+                        (_audit.SEC_SHADOW, "lanes")]
+        elif st.proc.startswith("solverd"):
+            sections = [(_audit.SEC_MIRROR, "mirror")]
+        elif st.proc == "simagent_pool":
+            sections = [(_audit.SEC_VIEW, "view_agents")]
+        for sec, key in sections:
+            e = st.latest.get(sec)
+            if e is not None:
+                out[key] = {"peer": name,
+                            "digest": _audit.digest_hex(e.digest),
+                            "count": e.count, "seq": e.seq,
+                            "epoch": e.epoch}
+    return out
+
+
+def run_replay(capture: dict, log_dir, solver=None, shards=None,
+               no_trace: bool = False, chaos=None, drain_s=None,
+               label: str = "replay") -> dict:
+    """Re-drive a captured window open-loop as a DETERMINISTIC load
+    (ISSUE 11): a fresh fleet (seeded from the capture), the captured
+    tasks injected via the manager's ``taskat`` command at their
+    original arrival offsets with their original ids and endpoints, the
+    captured world toggles re-requested at their offsets — then drain
+    until every captured task completed (or timeout).  ``chaos``, when
+    given, is polled with ``(ctx, t_rel_s)`` throughout and may kill /
+    stop / restart fleet members (scripts/chaos_gate.py).
+
+    Returns the replay record: outcome ledger (completed ids, missing,
+    duplicates), final-watermark audit digests, the auditor's confirmed
+    divergences, and fidelity drift vs the capture baseline."""
+    import shutil
+
+    capture = _capture.validate(capture)
+    fleet = capture["fleet"]
+    agents, side = fleet["agents"], fleet["side"]
+    solver = solver or fleet.get("solver") or "native"
+    shards = int(shards or fleet.get("shards") or 1)
+    tick_ms = int(fleet.get("tick_ms") or 250)
+    seed = int(fleet.get("seed") or 1)
+    mseed = fleet.get("manager_seed")
+    mseed = seed if mseed is None else int(mseed)
+    heartbeat_s = float(fleet.get("heartbeat_s") or 2.0)
+
+    ensure_built()
+    map_file = f"/tmp/fleetsim_replay_{side}.map.txt"
+    Path(map_file).write_text("\n".join(["." * side] * side) + "\n")
+    home_port = buspool.free_port()
+    log_dir = Path(log_dir) / label
+    if log_dir.exists():
+        shutil.rmtree(log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    trace_dir = log_dir / "trace"
+    saved_env = dict(os.environ)
+    procs, logs = [], []
+
+    def spawn(name, cmd, stdin=None, env=None):
+        log = open(log_dir / f"{name}.log", "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, stdin=stdin, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             env=dict(os.environ, **(env or {})))
+        procs.append(p)
+        return p
+
+    pool = watch = sim = None
+    _reg.get_registry().clear()
+    try:
+        pool = buspool.BusPool(
+            BUILD_DIR / "mapd_bus", num_shards=shards,
+            home_port=home_port, spawn=spawn)
+        time.sleep(0.4)
+        os.environ.update(pool.env())
+        if not no_trace:
+            os.environ["JG_TRACE"] = "1"
+            os.environ["JG_TRACE_DIR"] = str(trace_dir)
+            os.environ.setdefault("JG_TRACE_SAMPLE", "1.0")
+        os.environ.setdefault("JG_FLIGHT_DIR", str(log_dir))
+        # fast audit cadence: the final-watermark digests are the
+        # determinism proof, and the chaos judge needs silent-peer
+        # detection well inside the drain budget
+        os.environ.setdefault("JG_AUDIT_INTERVAL_MS", "400")
+        os.environ.setdefault("JG_AUDIT_INTERVAL_S", "0.4")
+        if capture.get("world"):
+            # replayed toggles must reach solverd from tick one
+            os.environ.setdefault("JG_DYNAMIC_WORLD", "1")
+        _trace.configure(proc="simfleet")
+        _events.configure("simfleet")
+
+        def start_solverd(tag: str = "", wait: bool = True):
+            name = f"solverd{tag}"
+            p = spawn(name, [sys.executable, "-m",
+                             "p2p_distributed_tswap_tpu.runtime.solverd",
+                             "--port", str(home_port), "--map", map_file,
+                             "--warm", str(agents), "--cpu"])
+            if wait and not wait_for_log(log_dir / f"{name}.log",
+                                         "solverd up", 900, proc=p):
+                raise RuntimeError(f"{name} never became ready")
+            return p
+
+        sd = start_solverd() if solver == "tpu" else None
+        mgr = spawn(
+            "manager",
+            [str(BUILD_DIR / "mapd_manager_centralized"),
+             "--port", str(home_port), "--map", map_file,
+             "--solver", "cpu" if solver == "native" else "tpu",
+             "--planning-interval-ms", str(tick_ms),
+             "--max-tracked-agents", str(agents + 16),
+             "--seed", str(mseed),
+             # open-loop: completions must NOT mint fresh rng tasks —
+             # the load is exactly the captured taskat stream
+             "--open-loop"],
+            stdin=subprocess.PIPE)
+        time.sleep(0.5)
+        sim = SimAgentPool(agents, side, port=home_port, seed=seed,
+                           heartbeat_s=heartbeat_s)
+        watch = MetricsWindow(home_port, audit=True)
+        sim.heartbeat_all()
+        sim.pump(1.5)
+        watch.pump(0.5)
+
+        ctx = ReplayCtx(pool, mgr, sim, sd, start_solverd)
+        events = _capture.schedule(capture)
+        expected = set(_capture.task_ids(capture))
+        baseline = capture.get("baseline") or {}
+        orig_tps = baseline.get("tasks_per_s")
+        injected = world_injected = 0
+        last_beacon = [0.0]
+        last_eval = [0.0]
+        t0 = time.monotonic()
+
+        def replay_beacon(final: bool = False, extra: dict = None):
+            """Progress on the metrics plane: fleet_top's REPLAY line
+            and the aggregator's replay section render this."""
+            elapsed = max(time.monotonic() - t0, 1e-9)
+            done = len(sim.done_ids & expected)
+            payload = {"type": "replay_beacon",
+                       "peer_id": "replay-driver",
+                       "proc": "replay",
+                       "capture_source": capture.get("source"),
+                       "t_s": round(elapsed, 1),
+                       "injected": injected,
+                       "total": len(expected),
+                       "world_injected": world_injected,
+                       "done": done,
+                       "done_dups": sim.done_dups,
+                       "tasks_per_s": round(done / elapsed, 3),
+                       "orig_tasks_per_s": orig_tps,
+                       "final": final}
+            payload.update(extra or {})
+            sim.bus.publish(METRICS_TOPIC, payload)
+            return payload
+
+        def tick(slice_s: float):
+            now = time.monotonic()
+            if chaos is not None:
+                chaos.poll(ctx, now - t0)
+            sim.pump(slice_s)
+            watch.pump(0.02)
+            if now - last_eval[0] >= 0.5:
+                last_eval[0] = now
+                watch.agg.audit.evaluate()
+            if now - last_beacon[0] >= 2.0:
+                last_beacon[0] = now
+                replay_beacon()
+
+        for t_ms, kind, payload in events:
+            target = t0 + t_ms / 1000.0
+            while True:
+                remaining = target - time.monotonic()
+                if remaining <= 0:
+                    break
+                tick(min(0.1, remaining))
+            if kind == "task":
+                px, py = payload["pickup"]
+                dx, dy = payload["delivery"]
+                mgr.stdin.write(
+                    f"taskat {px} {py} {dx} {dy} "
+                    f"{payload['id']}\n".encode())
+                mgr.stdin.flush()
+                injected += 1
+            else:
+                sim.bus.publish("mapd", {"type": "world_update_request",
+                                         "toggles": payload["toggles"]})
+                world_injected += 1
+        inject_wall_s = time.monotonic() - t0
+        dur_s = capture["duration_ms"] / 1000.0
+        budget = (drain_s if drain_s is not None else max(30.0, dur_s))
+        budget += getattr(chaos, "extra_drain_s", 0.0) or 0.0
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline \
+                and not expected <= sim.done_ids:
+            tick(0.25)
+        drained = expected <= sim.done_ids
+        # the final watermark: stop injecting, let every role beacon its
+        # drained digests (>= 3 audit intervals), judge one last time
+        end_pump = time.monotonic() + 2.5
+        while time.monotonic() < end_pump:
+            tick(0.2)
+        watch.pump(1.0)
+        watch.agg.audit.evaluate()
+
+        completed = sorted(sim.done_ids & expected)
+        missing = sorted(expected - sim.done_ids)
+        extra_done = sorted(sim.done_ids - expected)
+        # ledger-level completion count: the manager's dedup-guarded
+        # counter — each id counts at most once, so > expected means the
+        # system of record double-counted (a real duplication), while
+        # pool-side done_dups also catches the benign positional-done /
+        # goal-exchange race the reference architecture carries
+        mgr_proc = "manager_centralized"
+        mgr_completed = int(watch.delta(mgr_proc,
+                                        "manager.tasks_completed"))
+        mgr_dispatched = int(watch.delta(mgr_proc,
+                                         "manager.tasks_dispatched"))
+        wall = time.monotonic() - t0
+        window_done = len(completed)
+        tps_wall = round(window_done / max(wall, 1e-9), 3)
+        # fidelity vs baseline: completions over the capture's own
+        # duration is the comparable rate (the drain tail would bias
+        # the wall-clock rate low vs a steady-state window)
+        tps_window = round(window_done / max(dur_s, 1e-9), 3)
+        drift = None
+        if orig_tps:
+            drift = round(100.0 * (tps_window - orig_tps) / orig_tps, 1)
+
+        timeline = None
+        phase_drift = None
+        if not no_trace and trace_dir.exists():
+            try:
+                timeline = _timeline_summary(trace_dir)
+            except Exception as e:  # timeline is fidelity evidence,
+                timeline = {"error": str(e)}  # never a replay failure
+            base_p95 = baseline.get("phase_p95_ms") or {}
+            got = (timeline or {}).get("fleet_phases_ms") or {}
+            if base_p95 and got:
+                phase_drift = {
+                    ph: round(got[ph]["p95"] - v, 1)
+                    for ph, v in base_p95.items()
+                    if isinstance(got.get(ph), dict)
+                    and got[ph].get("p95") is not None}
+
+        joiner = watch.agg.audit
+        audit_status = joiner.status()
+        confirmed = [{k: d.get(k) for k in
+                      ("class", "ns", "peer_a", "peer_b", "detail")}
+                     for d in joiner.divergences]
+        result = {
+            "label": label,
+            "capture_source": capture.get("source"),
+            "fleet": dict(fleet),
+            "solver": solver,
+            "shards": shards,
+            "injected": injected,
+            "world_injected": world_injected,
+            "expected": len(expected),
+            "completed": len(completed),
+            "completed_ids": completed,
+            "missing": missing,
+            "extra_done": extra_done,
+            "done_dups": sim.done_dups,
+            "mgr_completed": mgr_completed,
+            "mgr_dispatched": mgr_dispatched,
+            "completion_ratio": round(
+                len(completed) / max(1, len(expected)), 4),
+            "drained": drained,
+            "wall_s": round(wall, 1),
+            "inject_wall_s": round(inject_wall_s, 1),
+            "tasks_per_s": tps_wall,
+            "window_tasks_per_s": tps_window,
+            "baseline": baseline or None,
+            "drift": {"tasks_per_s_pct": drift,
+                      "phase_p95_ms": phase_drift},
+            "digests": _final_digests(joiner),
+            "audit": {"verdict": audit_status["verdict"],
+                      "joins": audit_status["joins"],
+                      "beacons": audit_status["beacons"],
+                      "active": audit_status["active"],
+                      "confirmed": confirmed,
+                      # peer -> {proc, ns, epoch}: the chaos judge maps
+                      # a divergence record's peer id to its role
+                      "epochs": audit_status["epochs"]},
+            "sim": sim.stats(),
+            "world": {"updates_seen": sim.world_updates,
+                      "toggles_accepted": sim.world_accepted,
+                      "toggles_rejected": sim.world_rejected},
+            "chaos": (chaos.summary() if chaos is not None else None),
+            "chaos_notes": list(ctx.notes),
+            # the outcome contract: every captured task completed (none
+            # lost), no id the capture never issued completed, and the
+            # system of record never double-counted.  Pool-side
+            # done_dups stays EVIDENCE, not a failure: the positional-
+            # done/goal-exchange race double-delivers occasionally by
+            # reference design, and the manager's ledger dedups it.
+            "ok": (not missing and not extra_done
+                   and mgr_completed <= len(expected)),
+        }
+        replay_beacon(final=True, extra={
+            "drift_pct": drift,
+            "phase_p95_delta_ms": phase_drift})
+        if timeline is not None:
+            result["timeline"] = timeline
+        return result
+    finally:
+        for obj in (sim, watch):
+            if obj is not None:
+                obj.close()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if pool is not None:
+            pool.close()
+        for log in logs:
+            log.close()
+        # dump the in-process ring into the replay's log dir (same
+        # rationale as run_rung: the atexit dump fires after the env
+        # restore and would strand the evidence elsewhere)
+        _rec = _flightrec.get_recorder()
+        _flightrec.dump(str(log_dir / f"{_rec.proc}-{_rec.pid}"
+                                      ".flight.jsonl"),
+                        reason="replay_teardown")
+        os.environ.clear()
+        os.environ.update(saved_env)
         _trace.configure(proc="simfleet")
         _events.configure("simfleet")
 
@@ -503,7 +951,8 @@ def run_tenant_smoke(args) -> int:
                 [str(BUILD_DIR / "mapd_manager_centralized"),
                  "--port", str(port), "--map", args.map_file,
                  "--solver", "tpu",
-                 "--max-tracked-agents", str(args.agents + 8)],
+                 "--max-tracked-agents", str(args.agents + 8),
+                 "--seed", str(args.seed)],
                 stdin=subprocess.PIPE, env={"JG_BUS_NS": ns})
         time.sleep(0.5)
         for i, ns in enumerate(tenants):
@@ -546,6 +995,34 @@ def run_tenant_smoke(args) -> int:
         for log in logs:
             log.close()
         os.environ.pop(buspool.SHARD_PORTS_ENV, None)
+
+
+def write_replay_artifact(out: Path, res: dict, capture_path) -> None:
+    """One replay's record (json + md): outcome ledger, final digests,
+    fidelity drift, audit verdict."""
+    out.parent.mkdir(parents=True, exist_ok=True)
+    doc = {"experiment": "fleetsim replay: captured window re-driven "
+                         "open-loop as a deterministic load",
+           "capture": str(capture_path),
+           "replay": res}
+    out.write_text(json.dumps(doc, indent=2) + "\n")
+    dg = res["digests"]
+    drift = res.get("drift") or {}
+    md = [f"# fleetsim replay — {capture_path}", "",
+          f"- outcome: **{res['completed']}/{res['expected']} tasks "
+          f"completed**, {len(res['missing'])} missing, "
+          f"{res['done_dups']} duplicated "
+          f"({'OK' if res['ok'] else 'FAILED'})",
+          f"- fidelity: {res['window_tasks_per_s']} tasks/s vs original "
+          f"{(res.get('baseline') or {}).get('tasks_per_s')} "
+          f"(drift {drift.get('tasks_per_s_pct')}%)",
+          f"- audit: {res['audit']['verdict']} "
+          f"({len(res['audit']['confirmed'])} confirmed divergence(s))",
+          "", "| digest | value | count | seq | epoch |", "|---|---|---|---|---|"]
+    for k, v in dg.items():
+        md.append(f"| {k} | `{v['digest']}` | {v['count']} | {v['seq']} "
+                  f"| {v['epoch']} |")
+    out.with_name(out.name + ".md").write_text("\n".join(md) + "\n")
 
 
 def write_artifact(out: Path, doc: dict) -> None:
@@ -626,6 +1103,28 @@ def main(argv=None) -> int:
                          "their verdicts and breaching phases)")
     ap.add_argument("--out", default=None)
     ap.add_argument("--log-dir", default="/tmp/fleetsim_logs")
+    ap.add_argument("--capture", default=None, metavar="FILE",
+                    help="record this run's traffic as a versioned "
+                         "capture1 artifact (ISSUE 11): task ids + "
+                         "arrival offsets + endpoints, accepted world "
+                         "toggles, fleet config, baseline signals — "
+                         "replayable via --replay")
+    ap.add_argument("--replay", default=None, metavar="FILE",
+                    help="re-drive a capture1 file open-loop as a "
+                         "deterministic load (same task ids, arrival "
+                         "offsets, world toggles) and judge the "
+                         "outcome: exit 0 iff every captured task "
+                         "completed, nothing uncaptured completed, and "
+                         "the manager ledger never double-counted")
+    ap.add_argument("--replay-solver", choices=["native", "tpu"],
+                    default=None,
+                    help="override the capture's solver for --replay")
+    ap.add_argument("--replay-shards", type=int, default=None,
+                    help="override the capture's bus shard count for "
+                         "--replay")
+    ap.add_argument("--replay-drain-s", type=float, default=None,
+                    help="post-injection completion budget (default: "
+                         "max(30, capture duration))")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip JG_TRACE (phase-attribution SLOs read "
                          "unknown)")
@@ -643,6 +1142,31 @@ def main(argv=None) -> int:
                          "exit 0 iff every tenant gets a welcome and "
                          "completes >= 1 task")
     args = ap.parse_args(argv)
+
+    if args.replay:
+        try:
+            capture = _capture.load(args.replay)
+        except _capture.CaptureError as e:
+            print(f"fleetsim: cannot replay {args.replay}: {e}",
+                  file=sys.stderr)
+            return 2
+        res = run_replay(capture, args.log_dir,
+                         solver=args.replay_solver,
+                         shards=args.replay_shards,
+                         no_trace=args.no_trace,
+                         drain_s=args.replay_drain_s)
+        print(json.dumps({k: res[k] for k in
+                          ("expected", "completed", "missing",
+                           "extra_done", "done_dups", "mgr_completed",
+                           "window_tasks_per_s", "drift", "ok")}),
+              flush=True)
+        dg = res["digests"]
+        print("replay digests: " + ", ".join(
+            f"{k}={v['digest']}/{v['count']}" for k, v in dg.items()),
+            flush=True)
+        if args.out:
+            write_replay_artifact(Path(args.out), res, args.replay)
+        return 0 if res["ok"] else 1
 
     if args.tenants >= 1:
         args.map_file = f"/tmp/fleetsim_{args.side}.map.txt"

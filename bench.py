@@ -83,6 +83,14 @@ live divergence-detection latency (corruption -> confirmed roster
 divergence, in digest intervals) and drill cost from a scaled-down
 ``scripts/audit_smoke.py`` run — so the always-on audit cost stays on
 the BENCH trajectory.
+
+Replay axis (ISSUE 11): unless BENCH_REPLAY=0, the headline carries a
+``replay`` record — replay FIDELITY of the committed CI capture
+(results/captures/ci_small.capture.json re-driven open-loop through
+``analysis/fleetsim.py --replay``): tasks/s drift vs the captured
+original, outcome intactness (nothing lost/duplicated), and the final
+ledger/view digests — so deterministic reproducibility stays measured
+on the BENCH trajectory.
 """
 
 from __future__ import annotations
@@ -686,6 +694,61 @@ def run_field_engine_axis() -> dict:
     }
 
 
+def run_replay_axis() -> dict:
+    """Replay-fidelity rung (ISSUE 11): re-drive the committed CI
+    capture open-loop and report drift vs the captured original —
+    tasks/s delta, outcome intactness, final ledger/view digests.
+    Failures are recorded, never fatal."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    capture = os.path.join(root, "results", "captures",
+                           "ci_small.capture.json")
+    if not os.path.exists(capture):
+        return {"skipped": "no committed capture"}
+    if not (BUILD_DIR / "mapd_bus").exists() \
+            and (shutil.which("cmake") is None
+                 or shutil.which("ninja") is None):
+        return {"skipped": "C++ runtime unavailable"}
+    out = Path(tempfile.mkdtemp(prefix="jg-bench-replay-")) / "rp.json"
+    cmd = [sys.executable, os.path.join(root, "analysis", "fleetsim.py"),
+           "--replay", capture, "--no-trace", "--out", str(out),
+           "--log-dir", str(out.parent / "logs")]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=420,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        return {"error": "replay timeout"}
+    if not out.exists():
+        return {"error": (proc.stderr or proc.stdout or "no output")[-300:]}
+    try:
+        res = json.loads(out.read_text())["replay"]
+    except (json.JSONDecodeError, KeyError) as e:
+        return {"error": f"artifact parse: {e}"}
+    digests = res.get("digests") or {}
+    return {
+        "capture": "results/captures/ci_small.capture.json",
+        "expected": res.get("expected"),
+        "completed": res.get("completed"),
+        "missing": len(res.get("missing") or []),
+        "done_dups": res.get("done_dups"),
+        "outcome_ok": res.get("ok"),
+        "tasks_per_s": res.get("window_tasks_per_s"),
+        "orig_tasks_per_s": (res.get("baseline") or {}).get("tasks_per_s"),
+        "tasks_per_s_drift_pct": (res.get("drift") or {}).get(
+            "tasks_per_s_pct"),
+        "ledger_digest": (digests.get("ledger") or {}).get("digest"),
+        "view_digest": (digests.get("view") or {}).get("digest"),
+        "audit_verdict": (res.get("audit") or {}).get("verdict"),
+    }
+
+
 def run_audit_axis() -> dict:
     """Audit-plane rung (ISSUE 10): digest-computation µs per beacon
     body — a flat resident fleet vs 8 tenant slab rows, measured
@@ -840,6 +903,9 @@ def main():
     if os.environ.get("BENCH_AUDIT", "1") != "0":
         # audit axis (ISSUE 10): digest µs/beacon + detection latency
         head["audit"] = run_audit_axis()
+    if os.environ.get("BENCH_REPLAY", "1") != "0":
+        # replay axis (ISSUE 11): fidelity of the committed CI capture
+        head["replay"] = run_replay_axis()
     print(json.dumps(head), flush=True)
 
 

@@ -80,6 +80,11 @@ int main(int argc, char** argv) {
   const std::string map_file = knobs.get_str("--map", "MAPD_MAP", "");
   const std::string solver = knobs.get_str("--solver", "MAPD_SOLVER", "cpu");
   const bool clean = knobs.get_bool("--clean", "MAPD_CLEAN");
+  // open-loop mode (ISSUE 11): no auto-refill on completion — the load
+  // is exactly what the operator injects (task/tasks/taskat).  Replay
+  // (fleetsim --replay) requires this, or every done would mint a fresh
+  // rng task the captured window never contained.
+  const bool open_loop = knobs.get_bool("--open-loop", "MAPD_OPEN_LOOP");
   const uint64_t seed = static_cast<uint64_t>(knobs.get_int(
       "--seed", "MAPD_SEED",
       static_cast<int64_t>(std::random_device{}())));
@@ -1034,6 +1039,39 @@ int main(int argc, char** argv) {
       for (size_t k = 0; k < n; ++k) queue_task();
       try_assign_pending();
       log_info("📦 queued %zu tasks (%zu pending)\n", n, pending_tasks.size());
+    } else if (cmd == "taskat") {
+      // replay injection (ISSUE 11): queue a task with EXPLICIT
+      // endpoints and (optionally) an explicit id, so a captured
+      // window re-drives as a deterministic load instead of a fresh
+      // rng sample.  fleetsim --replay writes these lines.
+      long long px = -1, py = -1, dx = -1, dy = -1, id = -1;
+      in >> px >> py >> dx >> dy;
+      if (!(in >> id)) id = -1;
+      if (!grid.in_bounds(static_cast<int>(px), static_cast<int>(py)) ||
+          !grid.in_bounds(static_cast<int>(dx), static_cast<int>(dy))) {
+        log_warn("⚠️  taskat: out-of-bounds (%lld,%lld)->(%lld,%lld)\n",
+                 px, py, dx, dy);
+        metrics_count("manager.taskat_rejected");
+      } else {
+        if (id >= 0 && static_cast<uint64_t>(id) >= next_task_id)
+          next_task_id = static_cast<uint64_t>(id) + 1;
+        const uint64_t tid =
+            id >= 0 ? static_cast<uint64_t>(id) : next_task_id++;
+        Json t;
+        t.set("pickup", point_json(grid.cell(static_cast<int>(px),
+                                             static_cast<int>(py))))
+            .set("delivery", point_json(grid.cell(static_cast<int>(dx),
+                                                  static_cast<int>(dy))))
+            .set("peer_id", Json())
+            .set("task_id", static_cast<int64_t>(tid));
+        if (tctx) {
+          codec::TraceCtx t0{trace_epoch | static_cast<long long>(tid), 0,
+                             unix_ms()};
+          event_emit("task.queue", &t0, static_cast<long long>(tid));
+        }
+        pending_tasks.push_back(std::move(t));
+        try_assign_pending();
+      }
     } else if (cmd == "metrics") {
       log_info("%s\n", task_metrics.statistics().to_string().c_str());
       if (auto ps = path_metrics.statistics())
@@ -1287,7 +1325,7 @@ int main(int argc, char** argv) {
               // task (original agent of a requeued task reporting after
               // re-dispatch) must not overwrite an in-flight assignment
               if (it != agents.end() && !it->second.task
-                  && pending_tasks.empty())
+                  && pending_tasks.empty() && !open_loop)
                 assign_task(peer, make_task());
               try_assign_pending();
             } else {
@@ -1318,7 +1356,7 @@ int main(int argc, char** argv) {
               // guarded on !task for the same late-duplicate-done reason
               // as the branch above: never clobber an in-flight assignment
               if (it != agents.end() && !it->second.task
-                  && pending_tasks.empty())
+                  && pending_tasks.empty() && !open_loop)
                 assign_task(peer, make_task());
               try_assign_pending();
             }

@@ -507,14 +507,20 @@ class AuditJoiner:
     def _record(self, rec: dict) -> None:
         self.divergences.append(rec)
         del self.divergences[:-256]
+        # callback BEFORE the jsonl write: on_divergence may enrich the
+        # record (the standalone auditor attaches an automatic capture1
+        # pointer, ISSUE 11) and the persisted line must carry it
+        if self.on_divergence is not None:
+            try:
+                self.on_divergence(rec)
+            except Exception:
+                pass  # a side-channel must never lose the record itself
         if self.record_path:
             try:
                 with open(self.record_path, "a") as f:
                     f.write(json.dumps(rec) + "\n")
             except OSError:
                 pass
-        if self.on_divergence is not None:
-            self.on_divergence(rec)
 
     def _fresh(self, st: _AuditPeer, now_ms: int) -> bool:
         """A peer still beaconing inside its silent threshold.  Only
@@ -884,6 +890,14 @@ def main(argv=None) -> int:
                     help="append confirmed divergences to "
                          "DIR/auditor.audit.jsonl (blackbox --audit "
                          "merges them)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="where the fleet's flight rings dump "
+                         "(default: $JG_FLIGHT_DIR, then --record). A "
+                         "confirmed RED divergence then also dumps an "
+                         "automatic capture1 next to the rings (ISSUE "
+                         "11) and the audit record gains a `capture` "
+                         "pointer — the incident arrives pre-packaged "
+                         "for fleetsim --replay")
     args = ap.parse_args(argv)
 
     try:
@@ -902,11 +916,40 @@ def main(argv=None) -> int:
         record_path = os.path.join(args.record, "auditor.audit.jsonl")
 
     dump = flight_dump_trigger(bus)
+    flight_dir = (args.flight_dir or os.environ.get("JG_FLIGHT_DIR")
+                  or args.record)
+    cap_state = {"at": 0.0}
+
+    def maybe_capture(rec: dict) -> None:
+        """RED episode -> automatic capture dump (ISSUE 11 satellite):
+        once the pulled flight rings land, rebuild the window as a
+        replayable capture1 next to them; the jsonl record (written
+        after this callback) carries the pointer.  Throttled like the
+        flight dump — one capture per episode window."""
+        if not flight_dir or rec.get("class") not in RED_CLASSES:
+            return
+        now = time.monotonic()
+        if now - cap_state["at"] < 30.0:
+            return
+        cap_state["at"] = now
+        time.sleep(1.2)  # flight_dump responses need a beat to land
+        from p2p_distributed_tswap_tpu.obs import capture as _capture
+        try:
+            doc = _capture.from_flight_dir(flight_dir, source="auto_red")
+            path = _capture.save(
+                os.path.join(flight_dir, "auditor.capture.json"), doc)
+            rec["capture"] = str(path)
+            print(f"📼 capture1 dumped to {path} "
+                  f"({len(doc['tasks'])} task(s)) — replay with "
+                  f"fleetsim --replay", flush=True)
+        except (_capture.CaptureError, OSError) as e:
+            print(f"📼 capture dump skipped: {e}", flush=True)
 
     def on_div(rec: dict) -> None:
         # sustained divergence: pull the fleet's black boxes (throttled)
         # so the moments before the fork survive
         dump(rec)
+        maybe_capture(rec)
         print(f"🔴 AUDIT divergence [{rec['class']}] "
               f"{rec.get('peer_a')}↔{rec.get('peer_b')} "
               f"seq={rec.get('seq')} epoch={rec.get('epoch')}: "

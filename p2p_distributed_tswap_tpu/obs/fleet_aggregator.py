@@ -125,6 +125,10 @@ class FleetAggregator:
         # fires once per confirmed divergence episode (fleet_top's live
         # mode uses it to pull the fleet's black boxes).
         self.audit = _audit.AuditJoiner(on_divergence=on_divergence)
+        # replay driver progress (ISSUE 11): newest replay_beacon — the
+        # rollup's `replay` section and fleet_top's REPLAY line
+        self._replay: Optional[dict] = None
+        self._replay_seen_ms = 0
 
     # cumulative counters watched for restarts (a shrink between two
     # consecutive beacons of one peer = the process restarted with a
@@ -142,6 +146,14 @@ class FleetAggregator:
             # the embedded auditor's feed (ISSUE 10): digest beacons
             # merge into the joiner, not the metrics peer table
             return self.audit.ingest(payload, now_ms=now_ms)
+        if isinstance(payload, dict) \
+                and payload.get("type") == "replay_beacon":
+            # the replay driver's progress frames (ISSUE 11): drift vs
+            # the captured original, rendered by fleet_top's REPLAY line
+            self._replay = payload
+            self._replay_seen_ms = _now_ms() if now_ms is None else now_ms
+            self.beacons_ingested += 1
+            return True
         if not isinstance(payload, dict) \
                 or payload.get("type") != "metrics_beacon":
             return False
@@ -349,6 +361,35 @@ class FleetAggregator:
             }
         return out
 
+    def _replay_rollup(self, now_ms: int) -> Optional[dict]:
+        """The replay drift section (ISSUE 11 satellite): progress plus
+        tasks/s delta vs the captured original and — once the driver's
+        final beacon landed — the per-phase p95 deltas."""
+        if self._replay is None:
+            return None
+        age_s = max(0.0, (now_ms - self._replay_seen_ms) / 1000.0)
+        if age_s > 60.0:
+            # the driver beacons every ~2 s and exits after its final
+            # frame: a minute-old section is a FINISHED (or dead) replay
+            # — drop it so a long-lived fleet_top stops rendering stale
+            # replay numbers against live traffic
+            self._replay = None
+            return None
+        p = self._replay
+        out = {k: p.get(k) for k in
+               ("capture_source", "t_s", "injected", "total",
+                "world_injected", "done", "done_dups", "tasks_per_s",
+                "orig_tasks_per_s", "final")}
+        out["age_s"] = round(age_s, 1)
+        now_tps, orig_tps = p.get("tasks_per_s"), p.get("orig_tasks_per_s")
+        if isinstance(now_tps, (int, float)) \
+                and isinstance(orig_tps, (int, float)):
+            out["tasks_per_s_delta"] = round(now_tps - orig_tps, 3)
+        for k in ("drift_pct", "phase_p95_delta_ms"):
+            if p.get(k) is not None:
+                out[k] = p[k]
+        return out
+
     def rollup(self, now_ms: Optional[int] = None) -> dict:
         """The fleet-wide snapshot fleet_top renders / dumps as JSON."""
         now_ms = _now_ms() if now_ms is None else now_ms
@@ -372,6 +413,7 @@ class FleetAggregator:
             # None until the first audit beacon: "no auditor evidence"
             # must read unknown, never a silent green
             "audit": self.audit.status() if self.audit.beacons else None,
+            "replay": self._replay_rollup(now_ms),
             "peers": peers,
             "fleet": {
                 "peers": len(peers),

@@ -143,6 +143,17 @@ class SimAgentPool:
         self.moves = 0
         self.withdrawn = 0
         self.acked = 0
+        # replay plane (ISSUE 11): the outcome ledger the determinism
+        # proof compares — WHICH task ids completed, and whether any id
+        # completed more than once (two agents both delivering one task
+        # is the "duplicated" half of "zero tasks lost or duplicated")
+        self.done_ids: set = set()
+        self.done_dups = 0
+        self._task_specs_seen: set = set()
+        # capture recorder (obs/capture.py): when attached, every
+        # first-seen task and accepted world update is recorded as
+        # replayable traffic
+        self.capture = None
         # audit plane (ISSUE 10): the pool is the agent-side state
         # replica — it publishes a view digest (sorted held task ids)
         # on mapd.audit so the auditor can join it against the
@@ -160,6 +171,13 @@ class SimAgentPool:
         self.world_updates = 0
         self.world_accepted = 0
         self.world_rejected = 0
+        # capture evidence (ISSUE 11): the pool's run configuration goes
+        # into the always-on flight ring, so a post-mortem capture
+        # (blackbox --capture) can rebuild a replayable fleet config
+        # from the rings alone — no trace_id means the ring records it
+        # regardless of JG_TRACE/JG_TRACE_CTX
+        _events.emit("capture.meta", agents=n, side=side, seed=seed,
+                     heartbeat_s=heartbeat_s)
 
     # -- geometry ---------------------------------------------------------
     def _pt(self, c: int) -> List[int]:
@@ -246,6 +264,13 @@ class SimAgentPool:
             a.tc = None
             a.exec_emitted = False
             self.done_count += 1
+            # outcome ledger (ISSUE 11): a second completion of the same
+            # id is a DUPLICATED task — the chaos judge's red line
+            if tid in self.done_ids:
+                self.done_dups += 1
+                _reg.count("sim.tasks_done_dup")
+            else:
+                self.done_ids.add(tid)
             _reg.count("sim.tasks_done")
 
     # -- inbound ----------------------------------------------------------
@@ -289,6 +314,23 @@ class SimAgentPool:
         a.task = d
         a.picked = False
         a.exec_emitted = False
+        if tid not in self._task_specs_seen:
+            # capture evidence (ISSUE 11): first sighting of a task id =
+            # its arrival in the window.  The spec event (id + endpoint
+            # cells, no trace_id so the flight ring always keeps it)
+            # plus the recorder hook make this the single point both
+            # capture paths source task traffic from.
+            self._task_specs_seen.add(tid)
+            try:
+                pickup = [int(d["pickup"][0]), int(d["pickup"][1])]
+                delivery = [int(d["delivery"][0]), int(d["delivery"][1])]
+            except (KeyError, IndexError, TypeError, ValueError):
+                pickup = delivery = None
+            if pickup is not None:
+                _events.emit("task.spec", task_id=tid, pickup=pickup,
+                             delivery=delivery)
+                if self.capture is not None:
+                    self.capture.record_task(tid, pickup, delivery)
         tc = _events.parse_tc(d)
         a.tc = pc.TraceCtx(*tc) if tc is not None else None
         self.adopted += 1
@@ -328,6 +370,16 @@ class SimAgentPool:
         elif typ == "world_update":
             self.world_updates += 1
             _reg.count("sim.world_updates")
+            # capture evidence (ISSUE 11): the ACCEPTED toggle list (the
+            # manager broadcasts only what it applied) is the replayable
+            # world traffic — requests that were rejected never were
+            # part of the world the fleet experienced
+            toggles = d.get("toggles")
+            seq = int(d.get("world_seq") or 0)
+            if toggles:
+                _events.emit("world.update", seq=seq, toggles=toggles)
+                if self.capture is not None:
+                    self.capture.record_world(seq, toggles)
         elif typ == "world_update_applied":
             self.world_accepted += int(d.get("accepted") or 0)
             self.world_rejected += len(d.get("rejected") or [])
@@ -402,6 +454,8 @@ class SimAgentPool:
                "done": self.done_count, "acked": self.acked,
                "moves": self.moves, "withdrawn": self.withdrawn,
                "busy": self.busy()}
+        if self.done_dups:
+            out["done_dups"] = self.done_dups
         if self.world_updates or self.world_accepted or self.world_rejected:
             out["world_updates"] = self.world_updates
             out["world_accepted"] = self.world_accepted

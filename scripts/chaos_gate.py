@@ -1,0 +1,501 @@
+"""Chaos matrix on top of deterministic replay (ISSUE 11, scripts/ci.sh).
+
+Replay (``analysis/fleetsim.py --replay``) makes the LOAD deterministic;
+this gate schedules faults ON TOP of it and judges each run with the
+audit plane (ISSUE 10) and the SLO engine (ISSUE 7) — chaos engineering
+with a reproducible trigger and an automated judge:
+
+- ``clean`` — no fault: the replay itself must complete every captured
+  task exactly once with zero confirmed RED divergences (the control
+  row, and — run twice — the DETERMINISM PROOF: identical completed-task
+  sets and equal audit ledger/view digests at the final watermark);
+- ``bus_shard_kill`` — hard-kill a non-home busd pool member mid-window
+  (runtime/buspool.py kill_shard): a dead shard must cost its regions,
+  not the fleet (PR 6 contract, now chaos-gated on every run);
+- ``solverd_sigkill`` — SIGKILL solverd mid-window (mid-dynamic-world
+  when the capture carries toggles) and respawn it: the auditor must
+  DETECT the gap (a confirmed ``silent`` record naming solverd — the
+  localization), and the restarted daemon's snapshot+world-replay
+  resync must reconverge with nothing lost or duplicated;
+- ``manager_sigstop`` — SIGSTOP the manager past its audit cadence
+  (several claim windows), then SIGCONT: detected as a manager
+  ``silent`` episode, healed after resume, outcome intact;
+- ``peer_partition`` — SIGSTOP a busd pool member (a link partition:
+  the process lives, its traffic stalls), then SIGCONT: the fleet rides
+  through on the surviving shards + reconnects.
+
+Verdict per fault: ``green`` iff the outcome ledger is intact (every
+captured task completed exactly once), any required detection fired AND
+named the faulted role, no RED divergence is still active at the final
+watermark, and the SLO engine passes the replay signals.  A gate that
+cannot trip is no gate: ``--ci`` runs the determinism pair AND the
+solverd kill, and fails unless the kill is detected + localized.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/chaos_gate.py \
+      --capture results/captures/ci_small.capture.json --ci
+  python scripts/chaos_gate.py --capture C --faults \
+      clean,bus_shard_kill,solverd_sigkill,manager_sigstop,peer_partition \
+      --out results/replay_r13.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from p2p_distributed_tswap_tpu.obs import audit as au  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import capture as _capture  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import slo as _slo  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# fault scripts — polled by fleetsim.run_replay with (ctx, t_rel_s)
+# ---------------------------------------------------------------------------
+
+class Fault:
+    """Base fault: fires once at ``at_s`` into the replay.  Subclasses
+    implement :meth:`fire` (and optionally :meth:`recover` at
+    ``at_s + recover_after_s``)."""
+
+    kind = "clean"
+    needs_solverd = False
+    needs_shards = 1
+    extra_drain_s = 0.0
+
+    def __init__(self, at_s: float = 0.0, recover_after_s: float = 0.0):
+        self.at_s = at_s
+        self.recover_after_s = recover_after_s
+        self.fired_at = None
+        self.recovered_at = None
+
+    def fire(self, ctx) -> None:  # pragma: no cover - overridden
+        pass
+
+    def recover(self, ctx) -> None:
+        pass
+
+    def poll(self, ctx, t_s: float) -> None:
+        if self.fired_at is None and t_s >= self.at_s:
+            self.fired_at = round(t_s, 2)
+            self.fire(ctx)
+        if self.fired_at is not None and self.recovered_at is None \
+                and self.recover_after_s \
+                and t_s >= self.at_s + self.recover_after_s:
+            self.recovered_at = round(t_s, 2)
+            self.recover(ctx)
+
+    def summary(self) -> dict:
+        return {"kind": self.kind, "at_s": self.at_s,
+                "fired_at_s": self.fired_at,
+                "recovered_at_s": self.recovered_at}
+
+
+class CleanFault(Fault):
+    kind = "clean"
+
+    def poll(self, ctx, t_s: float) -> None:
+        pass
+
+
+class BusShardKill(Fault):
+    kind = "bus_shard_kill"
+    needs_shards = 2
+    extra_drain_s = 20.0
+
+    def __init__(self, at_s: float, shard: int = 1):
+        super().__init__(at_s)
+        self.shard = shard
+
+    def fire(self, ctx) -> None:
+        ctx.pool.kill_shard(self.shard)
+        ctx.note(f"killed bus shard {self.shard} at t={self.fired_at}s")
+
+    def summary(self) -> dict:
+        return {**super().summary(), "shard": self.shard}
+
+
+class SolverdSigkill(Fault):
+    kind = "solverd_sigkill"
+    needs_solverd = True
+    # the respawned daemon re-warms JAX programs before planning resumes
+    extra_drain_s = 90.0
+
+    def __init__(self, at_s: float, restart_after_s: float = 3.0):
+        super().__init__(at_s, recover_after_s=restart_after_s)
+
+    def fire(self, ctx) -> None:
+        ctx.solverd.send_signal(signal.SIGKILL)
+        try:
+            ctx.solverd.wait(timeout=10)
+        except Exception:
+            pass
+        ctx.note(f"SIGKILLed solverd at t={self.fired_at}s")
+
+    def recover(self, ctx) -> None:
+        ctx.restart_solverd(wait=False)
+        ctx.note(f"respawned solverd at t={self.recovered_at}s "
+                 "(non-blocking; resync heals it)")
+
+
+class ManagerSigstop(Fault):
+    kind = "manager_sigstop"
+    extra_drain_s = 25.0
+
+    def __init__(self, at_s: float, stop_s: float = 4.0):
+        super().__init__(at_s, recover_after_s=stop_s)
+
+    def fire(self, ctx) -> None:
+        os.kill(ctx.manager.pid, signal.SIGSTOP)
+        ctx.note(f"SIGSTOPped manager at t={self.fired_at}s")
+
+    def recover(self, ctx) -> None:
+        os.kill(ctx.manager.pid, signal.SIGCONT)
+        ctx.note(f"SIGCONTed manager at t={self.recovered_at}s")
+
+
+class PeerPartition(Fault):
+    kind = "peer_partition"
+    needs_shards = 2
+    extra_drain_s = 20.0
+
+    def __init__(self, at_s: float, stop_s: float = 4.0, shard: int = 1):
+        super().__init__(at_s, recover_after_s=stop_s)
+        self.shard = shard
+
+    def fire(self, ctx) -> None:
+        os.kill(ctx.pool.procs[self.shard].pid, signal.SIGSTOP)
+        ctx.note(f"partitioned bus shard {self.shard} (SIGSTOP) at "
+                 f"t={self.fired_at}s")
+
+    def recover(self, ctx) -> None:
+        os.kill(ctx.pool.procs[self.shard].pid, signal.SIGCONT)
+        ctx.note(f"healed partition of shard {self.shard} at "
+                 f"t={self.recovered_at}s")
+
+    def summary(self) -> dict:
+        return {**super().summary(), "shard": self.shard}
+
+
+FAULT_KINDS = ("clean", "bus_shard_kill", "solverd_sigkill",
+               "manager_sigstop", "peer_partition")
+
+
+def build_fault(kind: str, capture: dict) -> Fault:
+    """Instantiate a fault scheduled relative to the capture's own
+    duration (mid-window: the fleet is busiest there)."""
+    dur_s = capture["duration_ms"] / 1000.0
+    mid = max(1.0, 0.4 * dur_s)
+    if kind == "clean":
+        return CleanFault()
+    if kind == "bus_shard_kill":
+        return BusShardKill(at_s=mid)
+    if kind == "solverd_sigkill":
+        return SolverdSigkill(at_s=mid)
+    if kind == "manager_sigstop":
+        return ManagerSigstop(at_s=mid)
+    if kind == "peer_partition":
+        return PeerPartition(at_s=mid)
+    raise SystemExit(f"unknown fault {kind!r} (one of {FAULT_KINDS})")
+
+
+# ---------------------------------------------------------------------------
+# judges
+# ---------------------------------------------------------------------------
+
+# faults whose detection by the auditor is REQUIRED for a green verdict
+# (the faulted role goes silent while its peers keep beaconing); the bus
+# faults are judged on outcome + reconvergence — busd holds no audited
+# fleet state to go silent with
+_DETECTION_REQUIRED = {"solverd_sigkill": "solverd",
+                       "manager_sigstop": "manager"}
+
+CHAOS_SPEC = {
+    "name": "chaos-replay",
+    "slos": [
+        {"name": "completion_ratio", "signal": "replay.completion_ratio",
+         "min": 1.0},
+        {"name": "tasks_missing", "signal": "replay.missing", "max": 0},
+        # duplication is judged at the SYSTEM-OF-RECORD level: the
+        # manager's dedup-guarded completion counter exceeding the
+        # captured task count, or an id the capture never issued
+        # completing.  Pool-side double-deliveries (the positional-done
+        # / goal-exchange race) are reference behavior and ride the
+        # artifact as evidence only.
+        {"name": "ledger_overcount", "signal": "replay.ledger_overcount",
+         "max": 0},
+        {"name": "uncaptured_completions",
+         "signal": "replay.extra_done", "max": 0},
+    ],
+}
+
+
+def _proc_of(res: dict, peer: str) -> str:
+    return ((res["audit"].get("epochs") or {}).get(peer) or {}).get(
+        "proc") or ""
+
+
+def classify(kind: str, res: dict) -> dict:
+    """The chaos verdict for one replayed fault: green iff the outcome
+    ledger is intact, required detection fired and NAMED the faulted
+    role (localization), no RED divergence is still active at the final
+    watermark (reconvergence), and the SLO engine passes."""
+    reasons = []
+    confirmed = res["audit"]["confirmed"]
+    red_confirmed = [d for d in confirmed
+                     if d["class"] in au.RED_CLASSES]
+    active_red = [d for d in res["audit"]["active"]
+                  if d["class"] in au.RED_CLASSES]
+    healed = not active_red
+    outcome_ok = res["ok"]
+    overcount = max(0, res.get("mgr_completed", 0) - res["expected"])
+    if res["missing"]:
+        reasons.append(f"{len(res['missing'])} task(s) lost: "
+                       f"{res['missing'][:8]}")
+    if res["extra_done"]:
+        reasons.append(f"uncaptured task id(s) completed: "
+                       f"{res['extra_done'][:8]}")
+    if overcount:
+        reasons.append(f"manager ledger double-counted {overcount} "
+                       "completion(s)")
+    if not healed:
+        reasons.append("RED divergence still active at the final "
+                       f"watermark: {active_red}")
+    signals = {"replay.completion_ratio": res["completion_ratio"],
+               "replay.ledger_overcount": overcount,
+               "replay.extra_done": len(res["extra_done"]),
+               "replay.missing": len(res["missing"])}
+    slo = _slo.evaluate(CHAOS_SPEC, signals)
+    if not slo["ok"]:
+        reasons.append(f"SLO breach: {slo['failed'] + slo['unknown']}")
+
+    detected = localized = None
+    want = _DETECTION_REQUIRED.get(kind)
+    if want is not None:
+        hits = [d for d in confirmed if d["class"] == "silent"
+                and _proc_of(res, d.get("peer_a") or "").startswith(want)]
+        detected = bool(hits)
+        localized = detected  # a silent record NAMES the quiet peer
+        if not detected:
+            reasons.append(f"auditor never confirmed a silent {want} "
+                           "episode — the fault went undetected")
+    elif kind == "clean":
+        if red_confirmed:
+            reasons.append("clean replay confirmed RED divergence(s): "
+                           f"{red_confirmed}")
+    verdict = "green" if not reasons else "red"
+    return {"fault": kind, "verdict": verdict,
+            "outcome_ok": outcome_ok, "healed": healed,
+            "detected": detected, "localized": localized,
+            "confirmed_divergences": confirmed,
+            "slo": {"ok": slo["ok"], "failed": slo["failed"]},
+            "reasons": reasons}
+
+
+def determinism_verdict(a: dict, b: dict) -> dict:
+    """The replay determinism proof (ISSUE 11 acceptance): two replays
+    of one capture must complete the IDENTICAL task-id set and land
+    EQUAL audit ledger/view digests at the final (drained) watermark.
+    Lane digests (positions) are compared informationally only — the
+    planner's assignment interleaving is live by design."""
+    completed_equal = a["completed_ids"] == b["completed_ids"]
+    digests = {}
+    proof_ok = completed_equal
+    for key in ("ledger", "view", "view_agents", "lanes"):
+        da, db = a["digests"].get(key), b["digests"].get(key)
+        if da is None and db is None:
+            # never beaconed on this solver path: absent, not unequal —
+            # but for the PROOF sections absence still fails (a proof
+            # needs evidence)
+            equal = None
+        else:
+            equal = (da is not None and db is not None
+                     and da["digest"] == db["digest"]
+                     and da["count"] == db["count"])
+        digests[key] = {
+            "a": None if da is None else f"{da['digest']}/{da['count']}",
+            "b": None if db is None else f"{db['digest']}/{db['count']}",
+            "equal": equal}
+        if key in ("ledger", "view"):
+            proof_ok = proof_ok and equal is True
+    return {"completed_equal": completed_equal,
+            "completed": len(a["completed_ids"]),
+            "digests": digests,
+            "both_outcomes_ok": a["ok"] and b["ok"],
+            "ok": proof_ok and a["ok"] and b["ok"]}
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def run_matrix(capture: dict, faults, log_dir, no_trace: bool,
+               drain_s=None) -> dict:
+    from analysis import fleetsim
+
+    rows = []
+    for i, kind in enumerate(faults):
+        fault = build_fault(kind, capture)
+        solver = capture["fleet"].get("solver") or "native"
+        if fault.needs_solverd:
+            solver = "tpu"
+        shards = max(int(capture["fleet"].get("shards") or 1),
+                     fault.needs_shards)
+        print(f"chaos_gate: [{i + 1}/{len(faults)}] fault={kind} "
+              f"solver={solver} shards={shards}", flush=True)
+        t0 = time.monotonic()
+        res = fleetsim.run_replay(
+            capture, log_dir, solver=solver, shards=shards,
+            no_trace=no_trace, drain_s=drain_s,
+            chaos=None if kind == "clean" else fault,
+            label=f"{i}_{kind}")
+        verdict = classify(kind, res)
+        verdict["fault_detail"] = fault.summary()
+        verdict["elapsed_s"] = round(time.monotonic() - t0, 1)
+        verdict["replay"] = {k: res[k] for k in
+                             ("completed", "expected", "missing",
+                              "extra_done", "done_dups",
+                              "mgr_completed", "window_tasks_per_s",
+                              "drift", "wall_s", "digests",
+                              "chaos_notes")}
+        rows.append((verdict, res))
+        print(f"chaos_gate: {kind} -> {verdict['verdict'].upper()}"
+              + (f" ({'; '.join(verdict['reasons'])})"
+                 if verdict["reasons"] else ""), flush=True)
+    return rows
+
+
+def write_artifact(out: Path, doc: dict) -> None:
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=2) + "\n")
+    md = ["# replay + chaos matrix", "",
+          f"capture: `{doc['capture']}` "
+          f"({doc['capture_tasks']} task(s), "
+          f"{doc['capture_world_events']} world event(s), "
+          f"{doc['capture_duration_s']}s)", ""]
+    det = doc.get("determinism")
+    if det:
+        md.append(f"## determinism proof — "
+                  f"{'**PASS**' if det['ok'] else '**FAIL**'}")
+        md.append("")
+        md.append(f"- completed-task sets identical: "
+                  f"{det['completed_equal']} "
+                  f"({det['completed']} tasks)")
+        for k, v in det["digests"].items():
+            state = ("absent (not beaconed)" if v["equal"] is None
+                     else "equal" if v["equal"] else "DIFFER")
+            md.append(f"- {k} digest: `{v['a']}` vs `{v['b']}` — "
+                      + state
+                      + (" (informational)" if k in ("lanes",)
+                         else ""))
+        md.append("")
+    if doc.get("matrix"):
+        md += ["## chaos matrix", "",
+               "| fault | verdict | detected | localized | healed "
+               "| completed | dups | tasks/s drift |",
+               "|---|---|---|---|---|---|---|---|"]
+        for v in doc["matrix"]:
+            r = v["replay"]
+            drift = (r.get("drift") or {}).get("tasks_per_s_pct")
+            md.append(
+                f"| {v['fault']} | {v['verdict'].upper()} "
+                f"| {v.get('detected')} | {v.get('localized')} "
+                f"| {v['healed']} "
+                f"| {r['completed']}/{r['expected']} "
+                f"| {r['done_dups']} "
+                f"| {drift if drift is not None else '-'}% |")
+        md.append("")
+    out.with_name(out.name + ".md").write_text("\n".join(md) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--capture", required=True,
+                    help="capture1 file to replay (fleetsim --capture / "
+                         "blackbox --capture / auditor auto-dump)")
+    ap.add_argument("--faults", default="clean",
+                    help=f"comma list of {', '.join(FAULT_KINDS)}")
+    ap.add_argument("--ci", action="store_true",
+                    help="the CI gate: clean determinism PAIR (two "
+                         "replays must agree on completed sets + "
+                         "ledger/view digests) then an injected "
+                         "solverd SIGKILL that MUST be detected + "
+                         "localized by the audit plane")
+    ap.add_argument("--determinism", action="store_true",
+                    help="run the clean replay twice and add the "
+                         "determinism verdict to the artifact")
+    ap.add_argument("--trace", action="store_true",
+                    help="run replays under JG_TRACE=1 (phase-drift "
+                         "fidelity lands in the artifact; slower)")
+    ap.add_argument("--drain-s", type=float, default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--log-dir", default="/tmp/jg_chaos_logs")
+    args = ap.parse_args(argv)
+
+    try:
+        capture = _capture.load(args.capture)
+    except _capture.CaptureError as e:
+        print(f"chaos_gate: bad capture {args.capture}: {e}",
+              file=sys.stderr)
+        return 2
+
+    faults = [f.strip() for f in args.faults.split(",") if f.strip()]
+    if args.ci:
+        faults = ["clean", "clean", "solverd_sigkill"]
+    elif args.determinism:
+        faults = ["clean"] + faults
+
+    rows = run_matrix(capture, faults, args.log_dir,
+                      no_trace=not args.trace, drain_s=args.drain_s)
+
+    determinism = None
+    clean_results = [res for v, res in rows if v["fault"] == "clean"]
+    if len(clean_results) >= 2:
+        determinism = determinism_verdict(clean_results[0],
+                                          clean_results[1])
+        print("chaos_gate: determinism proof "
+              + ("PASS" if determinism["ok"] else "FAIL")
+              + f" — completed sets equal={determinism['completed_equal']}"
+              + ", " + ", ".join(
+                  f"{k}={'absent' if v['equal'] is None else '==' if v['equal'] else '!='}"
+                  for k, v in determinism["digests"].items()),
+              flush=True)
+
+    doc = {
+        "experiment": "deterministic replay + audit-judged chaos matrix",
+        "capture": str(args.capture),
+        "capture_tasks": len(capture["tasks"]),
+        "capture_world_events": len(capture.get("world") or []),
+        "capture_duration_s": round(capture["duration_ms"] / 1000.0, 1),
+        "baseline": capture.get("baseline"),
+        "determinism": determinism,
+        "matrix": [v for v, _ in rows],
+    }
+    if args.out:
+        write_artifact(Path(args.out), doc)
+
+    ok = all(v["verdict"] == "green" for v, _ in rows)
+    if determinism is not None:
+        ok = ok and determinism["ok"]
+    if args.ci:
+        kill = next(v for v, _ in rows if v["fault"] == "solverd_sigkill")
+        ok = ok and kill["detected"] and kill["localized"]
+    print(json.dumps({"faults": faults,
+                      "verdicts": {v["fault"]: v["verdict"]
+                                   for v, _ in rows},
+                      "determinism_ok": (determinism or {}).get("ok"),
+                      "ok": ok}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

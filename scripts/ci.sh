@@ -39,6 +39,8 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/obs/slo.py \
     p2p_distributed_tswap_tpu/obs/audit.py \
     scripts/audit_smoke.py \
+    scripts/chaos_gate.py \
+    p2p_distributed_tswap_tpu/obs/capture.py \
     analysis/fleetsim.py \
     analysis/tenant_scaling.py \
     analysis/field_bench.py \
@@ -167,6 +169,44 @@ then
         --log-dir /tmp/jg_audit_ci_logs
 else
     echo "audit smoke SKIPPED (no C++ toolchain / binaries)"
+fi
+
+echo "== replay + chaos gate =="
+# ISSUE 11: the committed capture must replay deterministically — two
+# clean replays completing the identical task-id set with equal audit
+# ledger/view digests at the final watermark — and then an injected
+# solverd SIGKILL mid-replay MUST be detected and localized by the
+# audit plane (a confirmed silent record naming solverd) with zero
+# tasks lost or duplicated.  A chaos gate that cannot trip is no gate.
+if [[ -x cpp/build/mapd_bus && -x cpp/build/mapd_manager_centralized ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    JAX_PLATFORMS=cpu python scripts/chaos_gate.py \
+        --capture results/captures/ci_small.capture.json --ci \
+        --log-dir /tmp/jg_chaos_ci_logs
+    # schema versioning is load-bearing: a future/unknown capture
+    # version must be REJECTED (exit 2), never half-replayed
+    rej=0
+    python - >/dev/null 2>&1 <<'PY' || rej=$?
+import json, sys, tempfile, os
+sys.path.insert(0, os.getcwd())
+doc = json.load(open("results/captures/ci_small.capture.json"))
+doc["version"] = "capture999"
+p = tempfile.mktemp(suffix=".json")
+json.dump(doc, open(p, "w"))
+sys.path.insert(0, "scripts")
+import chaos_gate
+sys.exit(chaos_gate.main(["--capture", p]))
+PY
+    if [[ "$rej" != 2 ]]; then
+        echo "chaos gate accepted an unknown capture version" \
+             "(exit $rej)" >&2
+        exit 1
+    fi
+    echo "replay + chaos gate OK (determinism pair held, solverd kill" \
+         "detected + localized, unknown version rejected)"
+else
+    echo "replay + chaos gate SKIPPED (no C++ toolchain / binaries)"
 fi
 
 echo "== multi-tenant smoke =="

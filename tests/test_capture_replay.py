@@ -1,0 +1,427 @@
+"""Deterministic traffic record/replay + chaos matrix (ISSUE 11).
+
+Fast tests pin the capture1 contract — recorder round-trip through
+save/load, STRICT schema versioning (an unknown version is rejected,
+never half-replayed), event-sourced assembly from flight-ring evidence,
+the merged replay schedule — and the chaos gate's verdict logic
+(classification, detection requirements, the determinism proof).
+
+The slow test is the acceptance criterion end to end: capture a live
+2-shard window, replay it twice through scripts/chaos_gate.py, and the
+determinism proof must hold (identical completed-task sets, equal
+ledger/view digests at the final watermark).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.obs import capture as cap
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "scripts"))
+import chaos_gate  # noqa: E402
+
+
+def _doc(**over):
+    d = {
+        "version": cap.CAPTURE_VERSION,
+        "fleet": {"agents": 4, "side": 12, "seed": 9},
+        "tasks": [
+            {"id": 2, "t_ms": 500, "pickup": [1, 1], "delivery": [5, 5]},
+            {"id": 1, "t_ms": 100, "pickup": [2, 3], "delivery": [8, 0]},
+        ],
+        "world": [{"t_ms": 400, "seq": 1, "toggles": [[4, 4, 1]]}],
+    }
+    d.update(over)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# schema: validate / versioning / rejection
+# ---------------------------------------------------------------------------
+
+def test_validate_normalizes_sorts_and_defaults():
+    d = cap.validate(_doc())
+    assert [t["id"] for t in d["tasks"]] == [1, 2]  # sorted by t_ms
+    assert d["fleet"]["shards"] == 1  # defaults filled
+    assert d["fleet"]["solver"] == "native"
+    assert d["duration_ms"] == 500  # derived from the latest event
+    assert d["world"][0]["toggles"] == [[4, 4, 1]]
+
+
+def test_unknown_version_is_rejected_not_half_replayed():
+    for version in ("capture2", "capture0", None, 1, ""):
+        with pytest.raises(cap.CaptureError, match="version"):
+            cap.validate(_doc(version=version))
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda d: d.pop("fleet"), "fleet"),
+    (lambda d: d["fleet"].pop("seed"), "fleet.seed"),
+    (lambda d: d["fleet"].update(agents=0), "not a runnable fleet"),
+    (lambda d: d.update(tasks=[]), "no tasks"),
+    (lambda d: d["tasks"].append(dict(d["tasks"][0])), "duplicate task"),
+    (lambda d: d["tasks"][0].update(pickup=[99, 1]), "outside"),
+    (lambda d: d["tasks"][0].update(delivery="x"), "cell"),
+    (lambda d: d["tasks"][0].pop("t_ms"), "t_ms"),
+    (lambda d: d["world"][0].update(toggles=[[1, 2]]), "toggle"),
+    (lambda d: d["world"][0].update(toggles=[[None, 2, 1]]), "toggle"),
+    (lambda d: d["world"][0].update(toggles=[["a", 2, 1]]), "toggle"),
+    (lambda d: d["world"][0].update(toggles=[]), "no toggles"),
+])
+def test_malformed_documents_are_rejected(mutate, match):
+    d = _doc()
+    mutate(d)
+    with pytest.raises(cap.CaptureError, match=match):
+        cap.validate(d)
+
+
+def test_save_load_round_trip_is_lossless(tmp_path):
+    path = cap.save(tmp_path / "c.json", _doc())
+    loaded = cap.load(path)
+    again = cap.load(cap.save(tmp_path / "c2.json", loaded))
+    assert loaded == again
+    assert [t["id"] for t in loaded["tasks"]] == [1, 2]
+    # and a corrupt file fails loudly
+    path.write_text("{not json")
+    with pytest.raises(cap.CaptureError, match="cannot read"):
+        cap.load(path)
+
+
+def test_schedule_orders_by_offset_tasks_before_world_on_ties():
+    d = cap.validate(_doc(world=[
+        {"t_ms": 500, "seq": 2, "toggles": [[4, 4, 1]]},  # ties task id=2
+        {"t_ms": 50, "seq": 1, "toggles": [[3, 3, 1]]},
+    ]))
+    sched = cap.schedule(d)
+    assert [(t, k) for t, k, _ in sched] == [
+        (50, "world"), (100, "task"), (500, "task"), (500, "world")]
+
+
+# ---------------------------------------------------------------------------
+# recorder: live capture hook
+# ---------------------------------------------------------------------------
+
+def test_recorder_first_sighting_wins_and_finalize_validates():
+    rec = cap.CaptureRecorder({"agents": 3, "side": 10, "seed": 5}, t0=0.0)
+    assert rec.record_task(7, (1, 2), (3, 4), t=0.25)
+    assert not rec.record_task(7, (9, 9), (0, 0), t=0.9)  # re-dispatch
+    assert rec.record_task(8, (5, 5), (6, 6), t=1.5)
+    rec.record_world(3, [[2, 2, 1], (4, 4, 0)], t=1.0)
+    doc = rec.finalize(baseline={"tasks_per_s": 1.5}, source="live")
+    assert doc["version"] == cap.CAPTURE_VERSION
+    assert [(t["id"], t["t_ms"]) for t in doc["tasks"]] == [
+        (7, 250), (8, 1500)]
+    assert doc["tasks"][0]["pickup"] == [1, 2]  # first sighting kept
+    assert doc["world"] == [
+        {"t_ms": 1000, "seq": 3, "toggles": [[2, 2, 1], [4, 4, 0]]}]
+    assert doc["baseline"] == {"tasks_per_s": 1.5}
+    assert cap.task_ids(doc) == [7, 8]
+
+
+# ---------------------------------------------------------------------------
+# event-sourced assembly (the blackbox --capture path)
+# ---------------------------------------------------------------------------
+
+def _evidence():
+    return [
+        {"event": cap.EV_META, "ts_ms": 1000, "agents": 4, "side": 12,
+         "seed": 9},
+        {"event": cap.EV_META, "ts_ms": 1001, "shards": 2,
+         "solver": "tpu"},
+        {"event": cap.EV_TASK, "ts_ms": 1100, "task_id": 1,
+         "pickup": [2, 3], "delivery": [8, 0]},
+        {"event": cap.EV_TASK, "ts_ms": 1100, "task_id": 1,  # dup id
+         "pickup": [9, 9], "delivery": [9, 9]},
+        {"event": cap.EV_TASK, "ts_ms": 1500, "task_id": 2,
+         "pickup": [1, 1], "delivery": [5, 5]},
+        {"event": cap.EV_WORLD, "ts_ms": 1400, "seq": 1,
+         "toggles": [[4, 4, 1]]},
+        {"event": cap.EV_WORLD, "ts_ms": 1405, "seq": 1,  # two witnesses
+         "toggles": [[4, 4, 1]]},
+        {"event": "task.dispatch", "ts_ms": 1050},  # non-evidence noise
+    ]
+
+
+def test_from_events_assembles_dedups_and_re_anchors():
+    doc = cap.from_events(_evidence())
+    assert doc["fleet"] == {"agents": 4, "side": 12, "seed": 9,
+                            "shards": 2, "solver": "tpu", "tick_ms": 250,
+                            "heartbeat_s": 2.0, "manager_seed": None}
+    # offsets re-anchor at the earliest capture.meta (ts 1000)
+    assert [(t["id"], t["t_ms"]) for t in doc["tasks"]] == [
+        (1, 100), (2, 500)]
+    assert doc["tasks"][0]["pickup"] == [2, 3]  # first spec wins
+    assert len(doc["world"]) == 1  # the double-witnessed update dedups
+    assert doc["world"][0]["t_ms"] == 400
+    assert doc["source"] == "flight"
+
+
+def test_from_events_overrides_and_no_task_failure():
+    doc = cap.from_events(_evidence(), fleet_overrides={"agents": 7})
+    assert doc["fleet"]["agents"] == 7
+    with pytest.raises(cap.CaptureError, match="no task.spec evidence"):
+        cap.from_events([e for e in _evidence()
+                         if e["event"] != cap.EV_TASK])
+
+
+def test_from_flight_dir_reads_rings_and_event_logs(tmp_path):
+    lines = [json.dumps(e) for e in _evidence()]
+    (tmp_path / "pool-123.flight.jsonl").write_text(
+        "\n".join(lines[:4]) + "\nnot json\n")
+    (tmp_path / "simfleet-9.events.jsonl").write_text(
+        "\n".join(lines[4:]) + "\n")
+    doc = cap.from_flight_dir(tmp_path)
+    assert cap.task_ids(doc) == [1, 2]
+    assert len(doc["world"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos gate: fault scheduling + verdict classification
+# ---------------------------------------------------------------------------
+
+def _res(**over):
+    """A green replay record for classify()."""
+    r = {
+        "ok": True, "missing": [], "extra_done": [],
+        "expected": 10, "mgr_completed": 10, "completion_ratio": 1.0,
+        "audit": {"confirmed": [], "active": [],
+                  "epochs": {"solverd-1": {"proc": "solverd"},
+                             "mgr-1": {"proc": "manager_centralized"}}},
+    }
+    r.update(over)
+    return r
+
+
+def _silent(peer):
+    return {"class": "silent", "peer_a": peer, "peer_b": None,
+            "detail": "quiet"}
+
+
+def test_build_fault_schedules_mid_window_and_rejects_unknown():
+    capture = cap.validate(_doc())
+    for kind in chaos_gate.FAULT_KINDS:
+        f = chaos_gate.build_fault(kind, capture)
+        assert f.kind == kind
+        if kind != "clean":
+            assert f.at_s >= 1.0
+    assert chaos_gate.build_fault(
+        "solverd_sigkill", capture).needs_solverd
+    assert chaos_gate.build_fault("bus_shard_kill", capture).needs_shards == 2
+    with pytest.raises(SystemExit):
+        chaos_gate.build_fault("nope", capture)
+
+
+def test_classify_clean_green_and_red_on_divergence():
+    assert chaos_gate.classify("clean", _res())["verdict"] == "green"
+    v = chaos_gate.classify("clean", _res(audit={
+        "confirmed": [{"class": "roster", "peer_a": "a", "peer_b": "b",
+                       "detail": "forked"}],
+        "active": [], "epochs": {}}))
+    assert v["verdict"] == "red"
+    assert any("RED divergence" in r for r in v["reasons"])
+
+
+def test_classify_outcome_failures_are_red():
+    v = chaos_gate.classify("clean", _res(ok=False, missing=[3, 4],
+                                          completion_ratio=0.8))
+    assert v["verdict"] == "red" and not v["outcome_ok"]
+    # the system of record double-counting is a real duplication
+    v = chaos_gate.classify("clean", _res(mgr_completed=11))
+    assert v["verdict"] == "red"
+    assert any("double-counted" in r for r in v["reasons"])
+    v = chaos_gate.classify("clean", _res(extra_done=[99]))
+    assert v["verdict"] == "red"
+
+
+def test_classify_detection_required_faults():
+    # undetected SIGKILL: red even though the outcome is intact
+    v = chaos_gate.classify("solverd_sigkill", _res())
+    assert v["verdict"] == "red" and v["detected"] is False
+    # detected + localized (a silent record naming solverd): green
+    v = chaos_gate.classify("solverd_sigkill", _res(audit={
+        "confirmed": [_silent("solverd-1")], "active": [],
+        "epochs": {"solverd-1": {"proc": "solverd"}}}))
+    assert v["verdict"] == "green"
+    assert v["detected"] and v["localized"]
+    # a silent MANAGER does not satisfy solverd detection
+    v = chaos_gate.classify("solverd_sigkill", _res(audit={
+        "confirmed": [_silent("mgr-1")], "active": [],
+        "epochs": {"mgr-1": {"proc": "manager_centralized"}}}))
+    assert v["verdict"] == "red"
+    # manager_sigstop wants a silent manager
+    v = chaos_gate.classify("manager_sigstop", _res(audit={
+        "confirmed": [_silent("mgr-1")], "active": [],
+        "epochs": {"mgr-1": {"proc": "manager_centralized"}}}))
+    assert v["verdict"] == "green"
+
+
+def test_classify_still_active_red_is_not_healed():
+    v = chaos_gate.classify("solverd_sigkill", _res(audit={
+        "confirmed": [_silent("solverd-1")],
+        "active": [{"class": "device_mirror"}],
+        "epochs": {"solverd-1": {"proc": "solverd"}}}))
+    assert v["verdict"] == "red" and not v["healed"]
+
+
+def _replay_result(ids, ledger="aa", view="bb", lanes="cc", ok=True):
+    return {"ok": ok, "completed_ids": list(ids), "digests": {
+        "ledger": {"digest": ledger, "count": len(ids)},
+        "view": {"digest": view, "count": 0},
+        "lanes": {"digest": lanes, "count": 4}}}
+
+
+def test_determinism_verdict_pass_and_failures():
+    a, b = _replay_result([1, 2, 3]), _replay_result([1, 2, 3])
+    v = chaos_gate.determinism_verdict(a, b)
+    assert v["ok"] and v["completed_equal"]
+    # lane digests are informational: a mismatch does NOT fail the proof
+    v = chaos_gate.determinism_verdict(a, _replay_result([1, 2, 3],
+                                                         lanes="zz"))
+    assert v["ok"] and not v["digests"]["lanes"]["equal"]
+    # ledger digest mismatch fails
+    v = chaos_gate.determinism_verdict(a, _replay_result([1, 2, 3],
+                                                         ledger="zz"))
+    assert not v["ok"]
+    # different completed sets fail
+    v = chaos_gate.determinism_verdict(a, _replay_result([1, 2]))
+    assert not v["ok"] and not v["completed_equal"]
+    # a failed outcome fails even when digests agree
+    v = chaos_gate.determinism_verdict(a, _replay_result([1, 2, 3],
+                                                         ok=False))
+    assert not v["ok"]
+    # a section absent on BOTH sides reads absent (None), not unequal —
+    # informational sections tolerate it, proof sections do not
+    a2, b2 = _replay_result([1]), _replay_result([1])
+    for r in (a2, b2):
+        del r["digests"]["lanes"]
+    v = chaos_gate.determinism_verdict(a2, b2)
+    assert v["ok"] and v["digests"]["lanes"]["equal"] is None
+    for r in (a2, b2):
+        del r["digests"]["ledger"]
+    assert not chaos_gate.determinism_verdict(a2, b2)["ok"]
+
+
+def test_chaos_gate_rejects_bad_capture(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(_doc(version="capture9")))
+    assert chaos_gate.main(["--capture", str(p)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# auditor auto-capture: the jsonl record carries the callback's pointer
+# ---------------------------------------------------------------------------
+
+def test_divergence_callback_enriches_record_before_persist(tmp_path):
+    """The standalone auditor's on_divergence attaches the auto-dumped
+    capture1 pointer; the persisted auditor.audit.jsonl line must carry
+    it — so the callback runs BEFORE the write (and a raising callback
+    must never lose the record itself)."""
+    from p2p_distributed_tswap_tpu.obs.audit import AuditJoiner
+
+    record = tmp_path / "auditor.audit.jsonl"
+
+    def attach(rec):
+        rec["capture"] = "/dump/auditor.capture.json"
+
+    j = AuditJoiner(on_divergence=attach, record_path=str(record))
+    j._record({"class": "roster", "peer_a": "a", "peer_b": "b",
+               "detail": "forked", "ts_ms": 1})
+    line = json.loads(record.read_text().splitlines()[0])
+    assert line["capture"] == "/dump/auditor.capture.json"
+    assert j.divergences[0]["capture"] == "/dump/auditor.capture.json"
+
+    def boom(rec):
+        raise RuntimeError("side channel died")
+
+    j2 = AuditJoiner(on_divergence=boom, record_path=str(record))
+    j2._record({"class": "silent", "peer_a": "c", "peer_b": None,
+                "detail": "quiet", "ts_ms": 2})
+    assert len(record.read_text().splitlines()) == 2  # still persisted
+
+
+# ---------------------------------------------------------------------------
+# replay progress surfaces: aggregator section + fleet_top line
+# ---------------------------------------------------------------------------
+
+def test_aggregator_replay_section_and_fleet_top_line():
+    from analysis.fleet_top import render
+    from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (
+        FleetAggregator)
+
+    agg = FleetAggregator()
+    assert agg.rollup(now_ms=1000)["replay"] is None
+    assert agg.ingest({"type": "replay_beacon", "peer_id": "replay-driver",
+                       "proc": "replay", "capture_source": "live",
+                       "t_s": 4.0, "injected": 7, "total": 19,
+                       "world_injected": 1, "done": 5, "done_dups": 0,
+                       "tasks_per_s": 1.25, "orig_tasks_per_s": 1.5,
+                       "final": False}, now_ms=2000)
+    rp = agg.rollup(now_ms=2500)["replay"]
+    assert rp["injected"] == 7 and rp["total"] == 19
+    assert rp["tasks_per_s_delta"] == -0.25
+    assert rp["age_s"] == 0.5
+    text = render(agg.rollup(now_ms=2500))
+    assert "REPLAY [live] inj 7/19 done 5" in text
+    assert "vs orig 1.5" in text
+    # the final beacon adds drift + phase deltas, and dups get loud
+    agg.ingest({"type": "replay_beacon", "peer_id": "replay-driver",
+                "proc": "replay", "capture_source": "live", "t_s": 30.0,
+                "injected": 19, "total": 19, "done": 19, "done_dups": 2,
+                "tasks_per_s": 1.4, "orig_tasks_per_s": 1.5,
+                "drift_pct": -6.7,
+                "phase_p95_delta_ms": {"wire": 12.0}, "final": True},
+               now_ms=9000)
+    rp = agg.rollup(now_ms=9000)["replay"]
+    assert rp["drift_pct"] == -6.7
+    assert rp["phase_p95_delta_ms"] == {"wire": 12.0}
+    text = render(agg.rollup(now_ms=9000))
+    assert "DUPS 2!" in text and "drift -6.7%" in text \
+        and "wire+12ms" in text and "(final)" in text
+    # a minute after the last beacon the section expires: a long-lived
+    # fleet_top must not render a finished replay against live traffic
+    assert agg.rollup(now_ms=9000 + 61_000)["replay"] is None
+    assert "REPLAY" not in render(agg.rollup(now_ms=9000 + 61_000))
+
+
+# ---------------------------------------------------------------------------
+# slow e2e: the acceptance criterion
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_capture_live_window_replay_twice_digests_equal(tmp_path):
+    """Capture a live 2-shard window, replay it twice: the determinism
+    proof must hold — identical completed-task sets and equal audit
+    ledger/view digests at the final watermark."""
+    cap_path = tmp_path / "live.capture.json"
+    r = subprocess.run(
+        [sys.executable, "analysis/fleetsim.py", "--agents", "6",
+         "--side", "14", "--shards", "2", "--window", "8", "--settle",
+         "4", "--seed", "11", "--no-trace",
+         "--capture", str(cap_path),
+         "--log-dir", str(tmp_path / "logs")],
+        cwd=ROOT, capture_output=True, text=True, timeout=420,
+        env=dict(__import__("os").environ, JAX_PLATFORMS="cpu"))
+    assert cap_path.exists(), r.stdout[-2000:] + r.stderr[-2000:]
+    doc = cap.load(cap_path)
+    assert doc["tasks"] and doc["fleet"]["agents"] == 6
+    assert doc["baseline"]["tasks_per_s"] is not None
+
+    r = subprocess.run(
+        [sys.executable, "scripts/chaos_gate.py", "--capture",
+         str(cap_path), "--faults", "clean", "--determinism",
+         "--log-dir", str(tmp_path / "chaos"),
+         "--out", str(tmp_path / "verdict.json")],
+        cwd=ROOT, capture_output=True, text=True, timeout=500,
+        env=dict(__import__("os").environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    verdict = json.loads((tmp_path / "verdict.json").read_text())
+    det = verdict["determinism"]
+    assert det["ok"] and det["completed_equal"]
+    assert det["digests"]["ledger"]["equal"]
+    assert det["digests"]["view"]["equal"]
+    assert all(row["verdict"] == "green" for row in verdict["matrix"])
