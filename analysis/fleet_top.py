@@ -105,6 +105,23 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
 
         lines.append("FIELD " + " | ".join(
             _field_cell(peer, p) for peer, p in field_rows))
+    # mesh-sharded solverd (ISSUE 13): mesh shape + per-shard resident
+    # MB — the live proof the planning plane actually spans the mesh
+    mesh_rows = [(peer, p) for peer, p in rollup["peers"].items()
+                 if p.get("mesh")]
+    if mesh_rows:
+        def _mesh_cell(peer, p):
+            msh = p["mesh"]
+            per = msh.get("resident_bytes") or {}
+            # the aggregator emits shards in numeric order (and dict /
+            # JSON round-trips preserve it) — render as-is
+            mb = "/".join(f"{v / 2**20:.1f}" for v in per.values())
+            return (f"{peer[:16]}: {msh.get('shape') or '?'}"
+                    f" dev={msh['devices']}"
+                    + (f" resident={mb}MB" if per else ""))
+
+        lines.append("MESH " + " | ".join(
+            _mesh_cell(peer, p) for peer, p in mesh_rows))
     # world-epoch tracking (ISSUE 10 satellite): every peer carrying a
     # world_seq gauge, plus the audit beacons' per-tenant epochs — a
     # dynamic-world-OFF peer in a toggling fleet renders "OFF!", the

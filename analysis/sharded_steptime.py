@@ -41,7 +41,7 @@ from p2p_distributed_tswap_tpu.core.tasks import TaskGenerator  # noqa: E402
 from p2p_distributed_tswap_tpu.parallel import (  # noqa: E402
     sharded, sharded2d)
 from p2p_distributed_tswap_tpu.parallel.mesh import (  # noqa: E402
-    TILES_AXIS, agent_mesh, agent_tile_mesh)
+    TILES_AXIS, agent_mesh, agent_tile_mesh, shard_map)
 from p2p_distributed_tswap_tpu.solver import mapd  # noqa: E402
 
 WARMUP = 8
@@ -83,7 +83,7 @@ def _prep_replicated(cfg, starts, tasks):
 def bench_sharded(cfg, starts, tasks, free, steps):
     mesh = agent_mesh(devices=DEVICES)
     specs = sharded.agent_state_specs()
-    sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+    sm = functools.partial(shard_map, mesh=mesh, check_vma=False)
     step = jax.jit(sm(functools.partial(sharded.sharded_mapd_step, cfg),
                       in_specs=(specs, P(), P()), out_specs=specs))
     prime = jax.jit(sm(functools.partial(sharded._sharded_prime, cfg),
@@ -98,7 +98,7 @@ def bench_sharded(cfg, starts, tasks, free, steps):
 def bench_sharded2d(cfg, starts, tasks, free, steps):
     mesh = agent_tile_mesh(2, 4, devices=DEVICES)
     specs = sharded2d.state_specs_2d()
-    sm = functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+    sm = functools.partial(shard_map, mesh=mesh, check_vma=False)
     step = jax.jit(sm(functools.partial(sharded2d.sharded2d_mapd_step, cfg),
                       in_specs=(specs, P(), P(TILES_AXIS, None)),
                       out_specs=specs))

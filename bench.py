@@ -84,6 +84,13 @@ divergence, in digest intervals) and drill cost from a scaled-down
 ``scripts/audit_smoke.py`` run — so the always-on audit cost stays on
 the BENCH trajectory.
 
+Mesh axis (ISSUE 13): unless BENCH_MESH=0, the headline carries a
+``mesh`` record — flat vs 2-way vs 8-way virtual-mesh solverd rungs
+(analysis/mesh_bench.py): tick/sweep ms per rung, per-device resident
+bytes (the memory lever: peak HBM per device shrinks ~mesh-size), and
+the bit_identical verdict — the first rungs of the sharded serving
+trajectory.
+
 Replay axis (ISSUE 11): unless BENCH_REPLAY=0, the headline carries a
 ``replay`` record — replay FIDELITY of the committed CI capture
 (results/captures/ci_small.capture.json re-driven open-loop through
@@ -694,6 +701,48 @@ def run_field_engine_axis() -> dict:
     }
 
 
+def run_mesh_axis() -> dict:
+    """Mesh-solverd rung (ISSUE 13): flat vs 2-way vs 8-way virtual-mesh
+    tick/sweep ms + per-device resident bytes + the bit_identical
+    verdict, via analysis/mesh_bench.py (fresh subprocesses — the
+    virtual device count must be forced before each rung's jax CPU
+    client exists).  Failures are recorded, never fatal."""
+    import tempfile
+    from pathlib import Path
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = Path(tempfile.mkdtemp(prefix="jg-bench-mesh-")) / "mesh.json"
+    cmd = [sys.executable, os.path.join(root, "analysis", "mesh_bench.py"),
+           "--meshes", "1,2,8", "--agents", "16", "--side", "32",
+           "--ticks", "10", "--no-replay", "--out", str(out)]
+    try:
+        # must exceed mesh_bench's own worst case (3 rungs x 1200 s
+        # per-rung subprocess budget) or a slow-but-healthy run is
+        # killed here and misreported as an error
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=3 * 1200 + 120,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        return {"error": "mesh_bench timeout"}
+    if not out.exists():
+        return {"error": (proc.stderr or proc.stdout or "no output")[-300:]}
+    try:
+        doc = json.loads(out.read_text())
+    except json.JSONDecodeError as e:
+        return {"error": f"artifact parse: {e}"}
+    return {
+        "bit_identical": doc.get("bit_identical"),
+        "rungs": [{
+            "mesh": r["mesh"],
+            "devices": r["devices"],
+            "tick_ms_p50": r["tick_ms_p50"],
+            "sweep_chunk8_ms": r["sweep_chunk8_ms"],
+            "resident_bytes_peak_shard": r["resident_bytes_peak_shard"],
+        } for r in doc.get("rungs") or []],
+    }
+
+
 def run_replay_axis() -> dict:
     """Replay-fidelity rung (ISSUE 11): re-drive the committed CI
     capture open-loop and report drift vs the captured original —
@@ -906,6 +955,9 @@ def main():
     if os.environ.get("BENCH_REPLAY", "1") != "0":
         # replay axis (ISSUE 11): fidelity of the committed CI capture
         head["replay"] = run_replay_axis()
+    if os.environ.get("BENCH_MESH", "1") != "0":
+        # mesh axis (ISSUE 13): flat vs 2/8-way virtual-mesh solverd
+        head["mesh"] = run_mesh_axis()
     print(json.dumps(head), flush=True)
 
 

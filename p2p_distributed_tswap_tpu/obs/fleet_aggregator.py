@@ -78,6 +78,18 @@ def counters_by_label(snapshot: dict, name: str, label: str
     return out
 
 
+def gauges_by_label(snapshot: dict, name: str, label: str
+                    ) -> Dict[str, float]:
+    """Latest gauge value per ``label`` series of ``name`` (e.g. the
+    mesh solverd's ``solverd.resident_bytes{shard=k}``)."""
+    out: Dict[str, float] = {}
+    for k, v in (snapshot.get("gauges") or {}).items():
+        n, labels = parse_key(k)
+        if n == name:
+            out[labels.get(label, "")] = v
+    return out
+
+
 def find_hist(snapshot: dict, name: str) -> Optional[dict]:
     """First histogram series of ``name`` (merged across labels if several
     share bucket bounds)."""
@@ -345,6 +357,21 @@ class FleetAggregator:
                 "completed": task_hist["count"],
                 "latency_p50_ms": round(hist_quantile(task_hist, 0.5), 1),
                 "latency_p95_ms": round(hist_quantile(task_hist, 0.95), 1),
+            }
+        # mesh-sharded solverd (ISSUE 13): device count, mesh shape and
+        # per-shard resident bytes — the live view of the memory lever
+        if gauges.get("solverd.mesh_devices"):
+            shapes = gauges_by_label(m, "solverd.mesh_shape", "shape")
+            shard_bytes = gauges_by_label(m, "solverd.resident_bytes",
+                                          "shard")
+            out["mesh"] = {
+                "devices": int(gauges["solverd.mesh_devices"]),
+                "shape": next(iter(sorted(shapes)), None),
+                # numeric shard order (string sort interleaves past 9)
+                "resident_bytes": {k: int(v) for k, v in
+                                   sorted(shard_bytes.items(),
+                                          key=lambda kv: (len(kv[0]),
+                                                          kv[0]))},
             }
         # world-epoch tracking (ISSUE 10 satellite): any peer carrying a
         # world_seq gauge gains a `world` section — the seq AND the

@@ -42,6 +42,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pallas API compat: the params class is ``CompilerParams`` on current
+# jax and ``TPUCompilerParams`` on the 0.4.x line — same fields
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 INF = np.int32(1 << 30)
 LANES = 128
 # Tests set this to run the kernel through the Pallas interpreter on CPU
@@ -260,7 +265,7 @@ def _sweep8_rows(d: jnp.ndarray, blocked: jnp.ndarray,
                                lambda ri, ci, hi: (ri, hmap(hi), ci, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((segs, LANES), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=INTERPRET,
     )(d5, m4)

@@ -44,7 +44,8 @@ from p2p_distributed_tswap_tpu.ops.distance import (
     pack_directions,
     packed_cells,
 )
-from p2p_distributed_tswap_tpu.parallel.mesh import AGENTS_AXIS, agent_mesh
+from p2p_distributed_tswap_tpu.parallel.mesh import (AGENTS_AXIS,
+    agent_mesh, shard_map)
 from p2p_distributed_tswap_tpu.solver import mapd as mapd_mod
 from p2p_distributed_tswap_tpu.solver.mapd import MapdState, init_state
 
@@ -183,7 +184,7 @@ def make_sharded_runner(cfg: SolverConfig, mesh: Mesh | None = None,
     state_specs = agent_state_specs()
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(state_specs, P(), P()), out_specs=state_specs,
         check_vma=False)
     def run_shard(s, tasks, free):

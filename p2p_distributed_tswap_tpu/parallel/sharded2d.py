@@ -54,6 +54,7 @@ from p2p_distributed_tswap_tpu.parallel.mesh import (
     AGENTS_AXIS,
     TILES_AXIS,
     agent_tile_mesh,
+    shard_map,
 )
 from p2p_distributed_tswap_tpu.solver import mapd as mapd_mod
 from p2p_distributed_tswap_tpu.solver.mapd import MapdState, init_state
@@ -212,7 +213,7 @@ def make_sharded2d_runner(cfg: SolverConfig, mesh: Mesh):
     specs = state_specs_2d()
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(specs, P(), P(TILES_AXIS, None)), out_specs=specs,
         check_vma=False)
     def run_shard(s, tasks, free_local):

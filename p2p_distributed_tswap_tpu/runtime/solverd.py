@@ -111,6 +111,8 @@ from p2p_distributed_tswap_tpu.ops.distance import (
     pack_directions,
     packed_cells,
 )
+from p2p_distributed_tswap_tpu.parallel import solver_mesh
+from p2p_distributed_tswap_tpu.parallel import virtual_mesh
 from p2p_distributed_tswap_tpu.runtime import busns
 from p2p_distributed_tswap_tpu.runtime import plan_codec as pcodec
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
@@ -251,9 +253,21 @@ class PlanService:
     MIRROR_BYTES = 256 << 20
 
     def __init__(self, grid: Grid, capacity_min: int = 16,
-                 field_cache: int = 4096):
+                 field_cache: int = 4096,
+                 mesh: Optional["solver_mesh.SolverMesh"] = None):
         self.grid = grid
         self.free = jnp.asarray(grid.free)
+        # Mesh mode (ISSUE 13): the field cache / lanes shard over a
+        # device mesh and the step + sweeps run under shard_map.  mesh
+        # is None on the default single-device path — every mesh branch
+        # below is gated on it, so unset JG_SOLVER_MESH keeps this class
+        # byte-identical to the pre-mesh daemon.
+        self.mesh = mesh
+        if mesh is not None:
+            mesh.validate_grid(grid)
+            # lane capacities must divide over the agent shards; pow2
+            # doubling from a shard-multiple floor preserves the property
+            capacity_min = mesh.round_lanes(capacity_min)
         self.capacity_min = capacity_min
         pc = packed_cells(grid.num_cells)
         self.max_fields = max(capacity_min,
@@ -261,25 +275,36 @@ class PlanService:
         # goal cell -> row index into the dirs buffer
         self.goal_rows: "OrderedDict[int, int]" = OrderedDict()
         self.dirs: jnp.ndarray | None = None  # (rows, ceil(HW/8)) packed uint32
-        self._step = functools.partial(jax.jit, static_argnums=0)(step_parallel)
+        if mesh is None:
+            self._step = functools.partial(jax.jit,
+                                           static_argnums=0)(step_parallel)
+        else:
+            self._step = mesh.make_step()
         # jitted fixed-chunk sweep: eager per-op dispatch of the doubling
         # scan cost ~5 s/tick on a 1-core host (stress test, round 3).
         # ``free`` is an ARGUMENT, not a closure capture: a closure would
         # bake the mask into the traced program as a constant and world
         # toggles (apply_world_update) would silently sweep the old world.
-        self._fields = jax.jit(lambda free, goals: pack_directions(
-            direction_fields(free, goals).reshape(goals.shape[0], -1)))
+        if mesh is None:
+            self._fields = jax.jit(lambda free, goals: pack_directions(
+                direction_fields(free, goals).reshape(goals.shape[0], -1)))
 
-        def _fields_dist_impl(free, goals):
-            # dynamic-world variant: same sweeps, but the raw distance
-            # field and unpacked codes come back too — the host mirrors
-            # incremental repair starts from (ops/field_repair.py)
-            d = distance_fields(free, goals)
-            dirs = directions_from_distance(d, free)
-            return (pack_directions(dirs.reshape(goals.shape[0], -1)),
-                    d, dirs)
+            def _fields_dist_impl(free, goals):
+                # dynamic-world variant: same sweeps, but the raw
+                # distance field and unpacked codes come back too — the
+                # host mirrors incremental repair starts from
+                # (ops/field_repair.py)
+                d = distance_fields(free, goals)
+                dirs = directions_from_distance(d, free)
+                return (pack_directions(dirs.reshape(goals.shape[0], -1)),
+                        d, dirs)
 
-        self._fields_dist = jax.jit(_fields_dist_impl)
+            self._fields_dist = jax.jit(_fields_dist_impl)
+        else:
+            # sharded twins: goal batch over the agents axis, sweeps
+            # optionally H-banded over the tiles axis — bit-identical
+            self._fields = mesh.make_fields(grid)
+            self._fields_dist = mesh.make_fields_dist(grid)
         # Dynamic world (ISSUE 9): obstacle cells toggle mid-run via
         # caps-negotiated world_update messages.  JG_DYNAMIC_WORLD=0 is
         # the kill switch (updates ignored, zero bookkeeping — the
@@ -321,7 +346,9 @@ class PlanService:
         self.h_active = np.zeros(0, bool)
         self.goal_ref: Dict[int, int] = {}  # resident goal -> lane count
         self._scatter = None
-        self._scatter_donate = _donation_ok()
+        # donation composes badly with explicit output shardings (and the
+        # mesh scatter re-lays-out anyway): mesh mode forces it off
+        self._scatter_donate = _donation_ok() and mesh is None
         # Deferred field repair (packed fast path): a fresh goal whose
         # direction field is not cached yet does NOT stall the tick — the
         # agent plans one tick on the reserved all-STAY row (it waits in
@@ -414,7 +441,8 @@ class PlanService:
         for g in goals:
             self.dist_seq[g] = len(self.world_log)
         fields = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        self.dirs = self.dirs.at[jnp.asarray(rows, jnp.int32)].set(fields)
+        self.dirs = self._pin_dirs(
+            self.dirs.at[jnp.asarray(rows, jnp.int32)].set(fields))
 
     def _is_stale(self, g: int) -> bool:
         """A cached row swept before the latest world toggle no longer
@@ -507,8 +535,9 @@ class PlanService:
             reg.count("solverd.field_repairs")
             reg.count("solverd.field_sweeps", cause="repair")
         if rows:
-            self.dirs = self.dirs.at[jnp.asarray(rows, jnp.int32)].set(
-                jnp.asarray(np.stack(packed_rows)))
+            self.dirs = self._pin_dirs(
+                self.dirs.at[jnp.asarray(rows, jnp.int32)].set(
+                    jnp.asarray(np.stack(packed_rows))))
         if fallback:
             # full recompute repairs: recompute into the SAME rows (the
             # fresh-sweep path would allocate new ones), then re-mirror
@@ -638,6 +667,13 @@ class PlanService:
             self.d_slot = jnp.concatenate([self.d_slot, zi])
             self.d_active = jnp.concatenate([self.d_active,
                                              jnp.zeros(pad, bool)])
+        if self.mesh is not None:
+            # growth is rare (O(log N) per fleet life): re-pin the lane
+            # sharding the concatenation may have dropped
+            self.d_pos = self.mesh.pin_lanes(self.d_pos)
+            self.d_goal = self.mesh.pin_lanes(self.d_goal)
+            self.d_slot = self.mesh.pin_lanes(self.d_slot)
+            self.d_active = self.mesh.pin_lanes(self.d_active)
         self.r_cap = cap
 
     def _scatter_fn(self):
@@ -647,6 +683,11 @@ class PlanService:
                         slot.at[idx].set(vs), active.at[idx].set(va))
             kw = {"donate_argnums": (0, 1, 2, 3)} if self._scatter_donate \
                 else {}
+            if self.mesh is not None:
+                # pinned output layout: scatters must never de-shard the
+                # resident lane arrays between ticks
+                ls = self.mesh.lane_sharding
+                kw["out_shardings"] = (ls, ls, ls, ls)
             self._scatter = jax.jit(scatter, **kw)
         return self._scatter
 
@@ -670,15 +711,35 @@ class PlanService:
                 self.goal_rows.move_to_end(g)
         return misses
 
+    def _pin_dirs(self, arr: jnp.ndarray) -> jnp.ndarray:
+        """Keep the dirs cache row-sharded on the mesh (a no-op repin
+        when the layout already matches; identity on the flat path).
+        Every ``self.dirs = ...`` write funnels through this so eager
+        scatters/patches can never silently de-shard the cache."""
+        if self.mesh is None:
+            return arr
+        return self.mesh.pin_rows(arr)
+
+    def _lane_put(self, np_arr) -> jnp.ndarray:
+        """Host -> device upload of a per-lane vector, agent-axis
+        sharded in mesh mode."""
+        if self.mesh is None:
+            return jnp.asarray(np_arr)
+        return self.mesh.pin_lanes(np.asarray(np_arr))
+
     def _grow_dirs(self, rows: int) -> None:
         """Reallocate the dirs buffer at ``rows`` capacity, preserving
         existing rows (recompiles the step program, like a capacity
         jump)."""
+        if self.mesh is not None:
+            # row count must divide over the agent shards (shard_map)
+            rows = self.mesh.round_rows(rows)
         pc = packed_cells(self.grid.num_cells)
         old = self.dirs
         self.dirs = jnp.full((rows, pc), PACKED_STAY, jnp.uint32)
         if old is not None:
             self.dirs = self.dirs.at[:old.shape[0]].set(old)
+        self.dirs = self._pin_dirs(self.dirs)
 
     def _stay_row(self) -> int:
         """The permanent all-STAY row (pseudo-goal key -1, pinned): lanes
@@ -703,8 +764,8 @@ class PlanService:
         # a reused (previously evicted) row still holds its old field —
         # the reserved row must genuinely say STAY everywhere
         pc = packed_cells(self.grid.num_cells)
-        self.dirs = self.dirs.at[row].set(
-            jnp.full((pc,), PACKED_STAY, jnp.uint32))
+        self.dirs = self._pin_dirs(self.dirs.at[row].set(
+            jnp.full((pc,), PACKED_STAY, jnp.uint32)))
         self.goal_rows[-1] = row
         self.goal_ref[-1] = 1  # never evicted, never swept
         return row
@@ -926,8 +987,9 @@ class PlanService:
                     else vals == req
                 patched = (cur[:, j] & keep) | (stay << shift)
                 cur[:, j] = np.where(hit, patched, cur[:, j])
-        self.dirs = self.dirs.at[:, jnp.asarray(cols, jnp.int32)].set(
-            jnp.asarray(cur))
+        self.dirs = self._pin_dirs(
+            self.dirs.at[:, jnp.asarray(cols, jnp.int32)].set(
+                jnp.asarray(cur)))
         # host dirs mirrors get the same overlay (repair re-derives the
         # exact band from the repaired distances later)
         for dirs_m in self.dirs_mirror.values():
@@ -995,6 +1057,27 @@ class PlanService:
         act = np.flatnonzero(da)
         return act, pos[act], goal[act]
 
+    def resident_shard_bytes(self, extra=()) -> Dict[int, int]:
+        """Per-mesh-device resident bytes of the planning state (dirs
+        cache + lane arrays + ``extra`` — e.g. the tenant slab planes).
+        Empty on the flat path."""
+        if self.mesh is None:
+            return {}
+        return self.mesh.shard_bytes(
+            [self.dirs, self.d_pos, self.d_goal, self.d_slot,
+             self.d_active, *extra])
+
+    def update_mesh_gauges(self, extra=()) -> None:
+        """Refresh the per-shard residency gauges (metadata only — no
+        device sync; a no-op on the flat path).  The metrics beacon
+        ships them; fleet_top's MESH line renders them."""
+        per = self.resident_shard_bytes(extra)
+        if not per:
+            return
+        reg = registry.get_registry()
+        for k, b in per.items():
+            reg.gauge("solverd.resident_bytes", b, shard=str(k))
+
     def _scatter_lanes(self, lanes, vp, vg, vs, va) -> None:
         """O(churn) device update: scatter per-lane values into the
         resident arrays, pow2-chunk-padded (see _pad_pow2_chunk)."""
@@ -1049,10 +1132,10 @@ class PlanService:
                  for l, g in zip(lanes, goals)), np.int32, len(goals))
             self.h_active[lanes] = True
             # a snapshot IS the O(N) resync: one full upload
-            self.d_pos = jnp.asarray(self.h_pos)
-            self.d_goal = jnp.asarray(self.h_goal)
-            self.d_slot = jnp.asarray(self.h_slot)
-            self.d_active = jnp.asarray(self.h_active)
+            self.d_pos = self._lane_put(self.h_pos)
+            self.d_goal = self._lane_put(self.h_goal)
+            self.d_slot = self._lane_put(self.h_slot)
+            self.d_active = self._lane_put(self.h_active)
             reg.count("solverd.snapshots_applied")
             self._apply_corruption()
             return int(lanes.size)
@@ -1471,6 +1554,9 @@ class TickRunner:
         if total_ms > self.budget_ms:
             self.registry.count("tick.over_budget")
         self.registry.gauge("tick.agents", plan.n)
+        # mesh residency gauges (ISSUE 13): shard metadata only, no
+        # device sync — a flat service returns before touching anything
+        self.service.update_mesh_gauges()
         if self.heartbeat is not None:
             phase_ms = dict(self.service.last_phase_ms)
             phase_ms["decode"] = 1000.0 * (r["t_dec"] - r["t0"])
@@ -1522,6 +1608,10 @@ class TickRunner:
             "world_seq": svc.world_seq,
             "world_log": len(svc.world_log),
             "dist_mirrors": len(svc.dist_mirror),
+            "mesh": (None if svc.mesh is None else {
+                "shape": svc.mesh.shape_str,
+                "devices": svc.mesh.n_devices,
+                "resident_bytes": svc.resident_shard_bytes()}),
             "last_phase_ms": {k: round(v, 3)
                               for k, v in svc.last_phase_ms.items()},
         }
@@ -1641,11 +1731,19 @@ class TenantSlab:
 
     def _upload(self) -> None:
         """Full host->device resync (growth/admission/eviction — the
-        structural edges; steady-state deltas use the row scatter)."""
-        self.d_pos = jnp.asarray(self.h_pos)
-        self.d_goal = jnp.asarray(self.h_goal)
-        self.d_slot = jnp.asarray(self.h_slot)
-        self.d_active = jnp.asarray(self.h_active)
+        structural edges; steady-state deltas use the row scatter).  In
+        mesh mode the slab planes shard over the lane axis (ISSUE 13)."""
+        mesh = self.service.mesh
+        if mesh is None:
+            self.d_pos = jnp.asarray(self.h_pos)
+            self.d_goal = jnp.asarray(self.h_goal)
+            self.d_slot = jnp.asarray(self.h_slot)
+            self.d_active = jnp.asarray(self.h_active)
+        else:
+            self.d_pos = mesh.pin_slab(self.h_pos)
+            self.d_goal = mesh.pin_slab(self.h_goal)
+            self.d_slot = mesh.pin_slab(self.h_slot)
+            self.d_active = mesh.pin_slab(self.h_active)
 
     def alloc_row(self) -> int:
         row = next((r for r in range(self.T_cap)
@@ -1681,16 +1779,31 @@ class TenantSlab:
             cfg = SolverConfig(height=self.grid.height,
                                width=self.grid.width,
                                num_agents=self.L_cap)
+            if self.service.mesh is not None:
+                # mesh mode (ISSUE 13): the vmapped super-step runs
+                # under shard_map — per-row next-hop psums over the
+                # shared row-sharded field cache, bit-identical
+                self._vstep = self.service.mesh.make_slab_step(cfg)
+            else:
+                def one(pos, goal, slot, active, dirs):
+                    return step_parallel(cfg, pos, goal, slot, dirs,
+                                         active)
 
-            def one(pos, goal, slot, active, dirs):
-                return step_parallel(cfg, pos, goal, slot, dirs, active)
-
-            # the super-batch: one program, tenants down the batch axis,
-            # the shared field cache broadcast (in_axes=None)
-            self._vstep = jax.jit(jax.vmap(one,
-                                           in_axes=(0, 0, 0, 0, None)))
+                # the super-batch: one program, tenants down the batch
+                # axis, the shared field cache broadcast (in_axes=None)
+                self._vstep = jax.jit(jax.vmap(one,
+                                               in_axes=(0, 0, 0, 0, None)))
             self._vstep_l = self.L_cap
         return self._vstep
+
+    def _slab_out_shardings(self) -> dict:
+        """jit kwargs pinning slab outputs to the lane sharding in mesh
+        mode (scatters must never de-shard the resident planes)."""
+        mesh = self.service.mesh
+        if mesh is None:
+            return {}
+        ss = mesh.slab_sharding
+        return {"out_shardings": (ss, ss, ss, ss)}
 
     def _row_scatter_fn(self):
         if self._rowscatter is None:
@@ -1698,7 +1811,7 @@ class TenantSlab:
                 return (pos.at[row, idx].set(vp), goal.at[row, idx].set(vg),
                         slot.at[row, idx].set(vs),
                         active.at[row, idx].set(va))
-            self._rowscatter = jax.jit(sc)
+            self._rowscatter = jax.jit(sc, **self._slab_out_shardings())
         return self._rowscatter
 
     def _row_set_fn(self):
@@ -1706,7 +1819,7 @@ class TenantSlab:
             def st(pos, goal, slot, active, row, vp, vg, vs, va):
                 return (pos.at[row].set(vp), goal.at[row].set(vg),
                         slot.at[row].set(vs), active.at[row].set(va))
-            self._rowset = jax.jit(st)
+            self._rowset = jax.jit(st, **self._slab_out_shardings())
         return self._rowset
 
     def _row_set(self, row: int) -> None:
@@ -2151,6 +2264,10 @@ class MultiTenantRunner:
         if total_ms > self.budget_ms:
             self.registry.count("tick.over_budget")
         self.registry.gauge("tick.agents", p.lanes)
+        # mesh residency gauges (ISSUE 13): dirs cache + the slab planes
+        self.slab.service.update_mesh_gauges(
+            extra=(self.slab.d_pos, self.slab.d_goal, self.slab.d_slot,
+                   self.slab.d_active))
         if self.heartbeat is not None:
             self.heartbeat.beat(
                 self.ticks, p.lanes,
@@ -2187,6 +2304,12 @@ class MultiTenantRunner:
             "deferred_lanes": len(self.slab.lane_wait),
             "dynamic_world": svc.dynamic_world,
             "world_seq": svc.world_seq,
+            "mesh": (None if svc.mesh is None else {
+                "shape": svc.mesh.shape_str,
+                "devices": svc.mesh.n_devices,
+                "resident_bytes": svc.resident_shard_bytes(
+                    extra=(self.slab.d_pos, self.slab.d_goal,
+                           self.slab.d_slot, self.slab.d_active))}),
         }
         snap["network"] = self.registry.network_summary()
         return snap
@@ -2443,6 +2566,13 @@ def main(argv=None) -> int:
     # Force the CPU backend (tests; also the env-var route is unreliable in
     # environments whose sitecustomize pre-imports jax with a plugin set).
     ap.add_argument("--cpu", action="store_true")
+    # Mesh mode (ISSUE 13): shard the planning plane over a device mesh.
+    # "N" = N-way agent-axis sharding (field rows + lanes), "AxT" adds a
+    # grid-tile axis for the sweeps.  Unset/1 = today's single-device
+    # path, byte-identical on the wire.
+    ap.add_argument("--mesh", default=None,
+                    help="device mesh spec N or AxT (JG_SOLVER_MESH); "
+                         "unset/1 = single-device")
     # Multi-tenant mode (ISSUE 8): serve many namespaced fleets from one
     # device-resident super-batch.  --tenants pre-subscribes a static
     # tenant list; --multi-tenant additionally listens on solver.admit
@@ -2468,6 +2598,22 @@ def main(argv=None) -> int:
                     args.tenants.split(",")] if args.tenants is not None
                    else [])
     multi_tenant = bool(tenant_list) or args.multi_tenant
+
+    # Mesh spec (ISSUE 13): --mesh wins over JG_SOLVER_MESH; a malformed
+    # spec is a startup error, never a silent single-device fallback.
+    mesh_env = args.mesh if args.mesh is not None \
+        else os.environ.get("JG_SOLVER_MESH")
+    try:
+        mesh_shape = solver_mesh.mesh_spec_from_env(mesh_env)
+    except ValueError as e:
+        print(f"❌ {e}", file=sys.stderr)
+        return 2
+    if mesh_shape is not None:
+        # must precede the first CPU-client creation (jax.devices below):
+        # on the CPU backend the mesh runs on virtual host devices (a
+        # no-op env nudge for real multi-chip backends)
+        virtual_mesh.force_virtual_cpu_devices(mesh_shape[0]
+                                               * mesh_shape[1])
 
     tracer = trace.configure(enabled=True if args.trace else None,
                              proc="solverd")
@@ -2527,7 +2673,28 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
         jax.devices()
 
-    service = PlanService(grid, capacity_min=args.capacity_min)
+    mesh_obj = None
+    if mesh_shape is not None:
+        try:
+            mesh_obj = solver_mesh.SolverMesh(*mesh_shape)
+            mesh_obj.validate_grid(grid)
+        except (RuntimeError, ValueError) as e:
+            print(f"❌ mesh {mesh_env}: {e}", file=sys.stderr)
+            return 2
+        reg = registry.get_registry()
+        reg.gauge("solverd.mesh_devices", mesh_obj.n_devices)
+        reg.gauge("solverd.mesh_agents", mesh_obj.n_agent_shards)
+        reg.gauge("solverd.mesh_tiles", mesh_obj.n_tiles)
+        # the shape string rides a labeled unit gauge (gauge values are
+        # floats); the fleet aggregator lifts the label into its mesh
+        # section
+        reg.gauge("solverd.mesh_shape", 1, shape=mesh_obj.shape_str)
+
+    service = PlanService(grid, capacity_min=args.capacity_min,
+                          mesh=mesh_obj)
+    if mesh_obj is not None:
+        # residency gauges exist from the first beacon, not the first tick
+        service.update_mesh_gauges()
     if args.warm:
         t0 = time.perf_counter()
         rng = np.random.default_rng(0)
@@ -2602,9 +2769,12 @@ def main(argv=None) -> int:
         bus.publish("solver", {"type": "stats_response", **runner.stats()})
         trace.flush()
 
-    trace.instant("solverd.up", port=args.port, multi_tenant=multi_tenant)
+    trace.instant("solverd.up", port=args.port, multi_tenant=multi_tenant,
+                  mesh=mesh_obj.shape_str if mesh_obj else None)
     print(f"🧮 solverd up on port {args.port} "
           f"(grid {grid.height}x{grid.width}, devices={jax.devices()}"
+          + (f", mesh={mesh_obj.shape_str}"
+             f" [{mesh_obj.n_devices} devices]" if mesh_obj else "")
           + (f", tenants={[t or '<default>' for t in tenant_list]}"
              f" max={args.max_tenants}" if multi_tenant else "") + ")")
     sys.stdout.flush()

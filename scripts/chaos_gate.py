@@ -338,13 +338,14 @@ def determinism_verdict(a: dict, b: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 def run_matrix(capture: dict, faults, log_dir, no_trace: bool,
-               drain_s=None) -> dict:
+               drain_s=None, solver_override=None) -> dict:
     from analysis import fleetsim
 
     rows = []
     for i, kind in enumerate(faults):
         fault = build_fault(kind, capture)
-        solver = capture["fleet"].get("solver") or "native"
+        solver = (solver_override or capture["fleet"].get("solver")
+                  or "native")
         if fault.needs_solverd:
             solver = "tpu"
         shards = max(int(capture["fleet"].get("shards") or 1),
@@ -436,6 +437,10 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="run replays under JG_TRACE=1 (phase-drift "
                          "fidelity lands in the artifact; slower)")
+    ap.add_argument("--solver", choices=["native", "tpu"], default=None,
+                    help="override the capture's solver (e.g. drive a "
+                         "native capture through a mesh solverd: --solver "
+                         "tpu + JG_SOLVER_MESH=2)")
     ap.add_argument("--drain-s", type=float, default=None)
     ap.add_argument("--out", default=None)
     ap.add_argument("--log-dir", default="/tmp/jg_chaos_logs")
@@ -455,7 +460,8 @@ def main(argv=None) -> int:
         faults = ["clean"] + faults
 
     rows = run_matrix(capture, faults, args.log_dir,
-                      no_trace=not args.trace, drain_s=args.drain_s)
+                      no_trace=not args.trace, drain_s=args.drain_s,
+                      solver_override=args.solver)
 
     determinism = None
     clean_results = [res for v, res in rows if v["fault"] == "clean"]
@@ -473,6 +479,8 @@ def main(argv=None) -> int:
     doc = {
         "experiment": "deterministic replay + audit-judged chaos matrix",
         "capture": str(args.capture),
+        "solver_override": args.solver,
+        "solver_mesh": os.environ.get("JG_SOLVER_MESH") or None,
         "capture_tasks": len(capture["tasks"]),
         "capture_world_events": len(capture.get("world") or []),
         "capture_duration_s": round(capture["duration_ms"] / 1000.0, 1),

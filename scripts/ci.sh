@@ -44,6 +44,14 @@ python -m compileall -q -f \
     analysis/fleetsim.py \
     analysis/tenant_scaling.py \
     analysis/field_bench.py \
+    p2p_distributed_tswap_tpu/parallel/mesh.py \
+    p2p_distributed_tswap_tpu/parallel/solver_mesh.py \
+    p2p_distributed_tswap_tpu/parallel/virtual_mesh.py \
+    p2p_distributed_tswap_tpu/parallel/sharded.py \
+    p2p_distributed_tswap_tpu/parallel/sharded2d.py \
+    p2p_distributed_tswap_tpu/ops/tiled_distance.py \
+    analysis/mesh_bench.py \
+    scripts/mesh_smoke.py \
     scripts/bus_smoke.py \
     scripts/trace_smoke.py \
     scripts/field_fuzz.py \
@@ -208,6 +216,15 @@ PY
 else
     echo "replay + chaos gate SKIPPED (no C++ toolchain / binaries)"
 fi
+
+echo "== mesh-solverd smoke =="
+# ISSUE 13: the mesh==flat digest gate runs unconditionally (byte-
+# identical packed responses + audit digests over a 2-way virtual
+# mesh, JG_SOLVER_MESH-unset flat-path pin); the live half (tiny fleet
+# served BY a mesh solverd, every task completes) self-skips without
+# the C++ runtime
+JAX_PLATFORMS=cpu python scripts/mesh_smoke.py \
+    --log-dir /tmp/jg_mesh_ci_logs
 
 echo "== multi-tenant smoke =="
 # ISSUE 8: two namespaced fleets (real C++ managers behind JG_BUS_NS +

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""CI mesh-solverd smoke (ISSUE 13), SLO-gate pattern: both halves run
+every time.
+
+1. **mesh == flat digest gate** (in-process, no C++ needed): the same
+   packed request stream through a flat and a 2-way virtual-mesh
+   TickRunner must produce byte-identical responses and equal
+   mirror/device/fields audit digests every tick — and with
+   JG_SOLVER_MESH unset the resolved service must be the flat path
+   (``service.mesh is None``), pinning the kill-switch contract.
+2. **live fleet through a mesh solverd** (skipped without the C++
+   runtime): busd + the C++ centralized manager --solver tpu + solverd
+   --mesh 2 on virtual CPU devices; every dispatched task must
+   complete, and the solverd log must show the mesh banner.
+
+Exit 0 = both halves green (or the live half explicitly SKIPPED).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from p2p_distributed_tswap_tpu.parallel.virtual_mesh import (  # noqa: E402
+    force_virtual_cpu_devices)
+
+force_virtual_cpu_devices(2)
+
+import numpy as np  # noqa: E402
+
+
+def digest_gate() -> None:
+    from p2p_distributed_tswap_tpu.core.grid import Grid
+    from p2p_distributed_tswap_tpu.obs import audit as au
+    from p2p_distributed_tswap_tpu.parallel.solver_mesh import (
+        SolverMesh, mesh_spec_from_env)
+    from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+    from p2p_distributed_tswap_tpu.runtime.solverd import (PlanService,
+                                                           TickRunner)
+
+    leaked = os.environ.get("JG_SOLVER_MESH")
+    assert not leaked, \
+        f"JG_SOLVER_MESH={leaked!r} leaked into the smoke env"
+    # the kill-switch pin: an unset env resolves to NO mesh
+    assert mesh_spec_from_env(leaked) is None
+    grid = Grid.from_ascii("\n".join(["." * 16] * 16) + "\n")
+    flat_svc = PlanService(grid, capacity_min=4)
+    assert flat_svc.mesh is None  # unset env = the flat path, pinned
+    flat_svc.defer_fields = False
+    mesh_svc = PlanService(grid, capacity_min=4, mesh=SolverMesh(2))
+    mesh_svc.defer_fields = False
+    flat, mesh = TickRunner(flat_svc, grid), TickRunner(mesh_svc, grid)
+    enc_f = pc.PackedFleetEncoder(snapshot_every=4)
+    enc_m = pc.PackedFleetEncoder(snapshot_every=4)
+
+    rng = np.random.default_rng(3)
+    free = np.flatnonzero(np.asarray(grid.free).reshape(-1)).astype(int)
+    cells = rng.choice(free, size=12, replace=False)
+    fleet = {f"p{k}": [int(cells[k]), int(cells[6 + k])] for k in range(6)}
+
+    for seq in range(1, 7):
+        items = [(n, p, g) for n, (p, g) in sorted(fleet.items())]
+
+        def req(enc):
+            return {"type": "plan_request", "seq": seq,
+                    "codec": pc.CODEC_NAME, "caps": [pc.CODEC_NAME],
+                    "data": pc.encode_b64(enc.encode_tick(seq, items))}
+
+        rf, rm = flat.handle(req(enc_f)), mesh.handle(req(enc_m))
+        assert rm["data"] == rf["data"], f"wire diverged at seq {seq}"
+        df = (au.lane_digest(*flat_svc.audit_views("mirror")),
+              au.lane_digest(*flat_svc.audit_views("device")))
+        dm = (au.lane_digest(*mesh_svc.audit_views("mirror")),
+              au.lane_digest(*mesh_svc.audit_views("device")))
+        assert df == dm, f"audit digests diverged at seq {seq}"
+        rp = pc.decode_b64(rf["data"])
+        for lane, c, g in zip(rp.idx, rp.pos, rp.goal):
+            fleet[flat.packed.name_of(int(lane))] = [int(c), int(g)]
+        fleet[f"p{int(rng.integers(6))}"][1] = int(rng.choice(free))
+    per = mesh_svc.resident_shard_bytes()
+    assert len(per) == 2, per
+    print(f"mesh smoke: digest gate OK (6 ticks byte-identical, "
+          f"per-shard bytes {sorted(per.values())})", flush=True)
+
+
+def live_gate(log_dir: str) -> bool:
+    if not (ROOT / "cpp" / "build" / "mapd_bus").exists() \
+            and (shutil.which("cmake") is None
+                 or shutil.which("ninja") is None):
+        print("mesh smoke: live half SKIPPED (no C++ runtime)",
+              flush=True)
+        return True
+    from p2p_distributed_tswap_tpu.runtime.fleet import Fleet
+
+    mapf = Path(log_dir) / "t12.map.txt"
+    mapf.parent.mkdir(parents=True, exist_ok=True)
+    mapf.write_text("\n".join(["." * 12] * 12) + "\n")
+    with Fleet("centralized", num_agents=2, port=7491,
+               map_file=str(mapf), solver="tpu", log_dir=log_dir,
+               solverd_args=["--cpu", "--mesh", "2"]) as fleet:
+        time.sleep(4)
+        fleet.command("tasks 2")
+        deadline = time.monotonic() + 90
+        done = 0
+        while time.monotonic() < deadline:
+            done = sum(f.read_text(errors="ignore").count("DONE")
+                       for f in Path(log_dir).glob("agent_*.log"))
+            if done >= 2:
+                break
+            time.sleep(1)
+        fleet.quit()
+    solverd_log = (Path(log_dir) / "solverd.log").read_text(
+        errors="ignore")
+    assert "mesh=2x1" in solverd_log, "solverd did not build the mesh"
+    assert done >= 2, "live mesh fleet did not complete its tasks"
+    print("mesh smoke: live half OK (2 tasks completed through a "
+          "2-way mesh solverd)", flush=True)
+    return True
+
+
+def main(argv=None) -> int:
+    log_dir = "/tmp/jg_mesh_smoke_logs"
+    if argv and len(argv) >= 2 and argv[0] == "--log-dir":
+        log_dir = argv[1]
+    digest_gate()
+    live_gate(log_dir)
+    print("mesh smoke OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -690,10 +690,19 @@ def _pump_joiner(cli, joiner, seconds):
     return confirmed
 
 
-def test_injected_corruption_detected_and_bisected(built, tmp_path):
+@pytest.mark.parametrize("mesh", [
+    None,
+    pytest.param("2", marks=pytest.mark.slow, id="mesh2"),
+])
+def test_injected_corruption_detected_and_bisected(built, tmp_path, mesh):
     """ISSUE 10 acceptance: flip one device lane via the test hook; the
     auditor must confirm a roster divergence within 3 digest intervals
-    and the bisect drill must localize it to the exact agent + field."""
+    and the bisect drill must localize it to the exact agent + field.
+
+    The mesh variant (ISSUE 13) runs the same drill against a solverd
+    whose state is sharded over a 2-way virtual mesh: corruption
+    injected into shard k must still bisect to the exact lane through
+    the gathered device/mirror views."""
     from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
     from p2p_distributed_tswap_tpu.runtime.fleet import (
         BUILD_DIR, wait_for_log)
@@ -716,7 +725,8 @@ def test_injected_corruption_detected_and_bisected(built, tmp_path):
             [sys.executable, "-m",
              "p2p_distributed_tswap_tpu.runtime.solverd",
              "--port", str(port), "--cpu", "--map", str(mapf),
-             "--warm", "4"],
+             "--warm", "4"]
+            + (["--mesh", mesh] if mesh else []),
             stdout=sd_log, stderr=subprocess.STDOUT, env=env)
         assert wait_for_log(tmp_path / "solverd.log", "solverd up", 120,
                             proc=sd)

@@ -304,6 +304,43 @@ def test_aggregator_field_engine_section():
     assert "FIELD" not in render(roll2)
 
 
+def test_aggregator_mesh_section_and_line():
+    """ISSUE 13: a mesh solverd's gauges (device count, labeled shape,
+    per-shard resident bytes) roll up into a ``mesh`` section and render
+    as a MESH line; non-mesh peers get neither."""
+    from analysis.fleet_top import render
+
+    agg = FleetAggregator()
+    agg.ingest({
+        "type": "metrics_beacon", "peer_id": "solverd", "proc": "solverd",
+        "pid": 1,
+        "metrics": {
+            "uptime_s": 5.0, "counters": {},
+            "gauges": {"solverd.mesh_devices": 2,
+                       "solverd.mesh_agents": 2,
+                       "solverd.mesh_tiles": 1,
+                       'solverd.mesh_shape{shape="2x1"}': 1,
+                       'solverd.resident_bytes{shard="0"}': 10485760,
+                       'solverd.resident_bytes{shard="1"}': 10485760},
+            "hists": {}}}, now_ms=1000)
+    roll = agg.rollup(now_ms=1000)
+    msh = roll["peers"]["solverd"]["mesh"]
+    assert msh == {"devices": 2, "shape": "2x1",
+                   "resident_bytes": {"0": 10485760, "1": 10485760}}
+    text = render(roll)
+    assert "MESH" in text and "2x1" in text and "dev=2" in text \
+        and "resident=10.0/10.0MB" in text
+    # a flat solverd beacon (no mesh gauges) renders no MESH line
+    agg2 = FleetAggregator()
+    agg2.ingest({"type": "metrics_beacon", "peer_id": "solverd",
+                 "proc": "solverd", "pid": 2,
+                 "metrics": {"uptime_s": 1.0, "counters": {},
+                             "gauges": {}, "hists": {}}}, now_ms=1000)
+    roll2 = agg2.rollup(now_ms=1000)
+    assert roll2["peers"]["solverd"].get("mesh") is None
+    assert "MESH" not in render(roll2)
+
+
 def test_aggregator_staleness_and_rates():
     agg = FleetAggregator(stale_after_s=6.0)
     snap1 = {"uptime_s": 10.0,

@@ -1,0 +1,440 @@
+"""Mesh-sharded solverd (ISSUE 13): the serving path over a device mesh
+must be BIT-IDENTICAL to the single-device daemon — same packed
+responses on the wire, same packed direction-field rows, same audit
+digests (mirror == device == flat) at matching seq — on the virtual CPU
+mesh the suite forces (conftest.py: 8 devices).
+
+Also covers: mesh-spec parsing edges, delta-scatter / seq-gap /
+snapshot-resync under sharding, the tenant-slab mesh path, dynamic-world
+toggles + repair on sharded caches, the injected-corruption hook +
+bisect drill against sharded state, per-shard residency accounting, and
+the JG_SOLVER_MESH-unset flat-path pin.  A slow live e2e drives a real
+fleet through a 2-way mesh solverd over busd.
+"""
+
+import os
+import shutil
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.obs import audit as au
+from p2p_distributed_tswap_tpu.obs import registry as reg_mod
+from p2p_distributed_tswap_tpu.parallel.solver_mesh import (
+    SolverMesh,
+    mesh_spec_from_env,
+    parse_mesh_spec,
+)
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+from p2p_distributed_tswap_tpu.runtime.solverd import (
+    MultiTenantRunner,
+    PlanService,
+    TenantSlab,
+    TickRunner,
+    audit_entries,
+    audit_entries_tenant,
+    audit_drill_reply,
+)
+
+
+def _grid(side=16):
+    return Grid.from_ascii("\n".join(["." * side] * side) + "\n")
+
+
+def _req(enc, seq, fleet):
+    pkt = enc.encode_tick(seq, fleet)
+    return {"type": "plan_request", "seq": seq, "codec": pc.CODEC_NAME,
+            "caps": [pc.CODEC_NAME], "data": pc.encode_b64(pkt)}
+
+
+def _runner(grid, mesh=None, defer=False):
+    svc = PlanService(grid, capacity_min=4, mesh=mesh)
+    svc.defer_fields = defer
+    return TickRunner(svc, grid)
+
+
+def _service_digests(svc):
+    m = au.lane_digest(*svc.audit_views("mirror"))
+    d = au.lane_digest(*svc.audit_views("device"))
+    fresh = [g for g in svc.goal_rows if g != -1 and not svc._is_stale(g)]
+    return m, d, au.cells_digest(fresh)
+
+
+# ---------------------------------------------------------------------------
+# mesh-spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_spec_parsing_edges():
+    assert parse_mesh_spec("2") == (2, 1)
+    assert parse_mesh_spec("8") == (8, 1)
+    assert parse_mesh_spec("2x4") == (2, 4)
+    assert parse_mesh_spec(" 2X4 ") == (2, 4)  # trimmed, case-folded
+    assert parse_mesh_spec("1") == (1, 1)
+    for bad in ("", "0", "0x2", "2x0", "-1", "2x", "x4", "2x4x8", "two",
+                "2,4"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+    # env resolution: unset/empty/1/1x1 all mean the flat path
+    assert mesh_spec_from_env(None) is None
+    assert mesh_spec_from_env("") is None
+    assert mesh_spec_from_env("1") is None
+    assert mesh_spec_from_env("1x1") is None
+    assert mesh_spec_from_env("2") == (2, 1)
+    assert mesh_spec_from_env("2x4") == (2, 4)
+    with pytest.raises(ValueError):
+        mesh_spec_from_env("nope")
+
+
+def test_mesh_validates_grid_and_devices():
+    # tiles must divide the grid height
+    with pytest.raises(ValueError):
+        PlanService(_grid(10), capacity_min=4, mesh=SolverMesh(2, 4))
+    # more devices than the virtual mesh has
+    with pytest.raises(RuntimeError):
+        SolverMesh(64)
+
+
+# ---------------------------------------------------------------------------
+# the exactness contract: mesh == flat, byte for byte
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (8, 1), (2, 4)],
+                         ids=["2way", "8way", "2x4"])
+def test_mesh_flat_bit_identity(mesh_shape):
+    """Drive a flat and a mesh TickRunner over the same evolving fleet
+    (joins, leaves, goal churn, snapshot resync every 4 ticks): every
+    packed response must be byte-identical, every audit digest equal at
+    the same seq, and every shared packed field-cache row equal."""
+    grid = Grid.default()
+    rng = np.random.default_rng(7)
+    free = np.flatnonzero(np.asarray(grid.free).reshape(-1)).astype(int)
+    N = 8
+    cells = rng.choice(free, size=2 * N, replace=False)
+    fleet = {f"p{k}": [int(cells[k]), int(cells[N + k])] for k in range(N)}
+
+    flat = _runner(grid)
+    mesh = _runner(grid, mesh=SolverMesh(*mesh_shape))
+    enc_f = pc.PackedFleetEncoder(snapshot_every=4)
+    enc_m = pc.PackedFleetEncoder(snapshot_every=4)
+
+    def items():
+        return [(n, p, g) for n, (p, g) in sorted(fleet.items())]
+
+    for seq in range(1, 8):
+        rf = flat.handle(_req(enc_f, seq, items()))
+        rm = mesh.handle(_req(enc_m, seq, items()))
+        assert rm["data"] == rf["data"], f"wire diverged at seq {seq}"
+        df = _service_digests(flat.service)
+        dm = _service_digests(mesh.service)
+        assert df == dm, f"audit digests diverged at seq {seq}"
+        # mirror == device within the mesh daemon (the sharded device
+        # pull gathers across shards)
+        assert dm[0] == dm[1]
+        # evolve the fleet from the (identical) plan
+        rp = pc.decode_b64(rf["data"])
+        for lane, c, g in zip(rp.idx, rp.pos, rp.goal):
+            fleet[flat.packed.name_of(int(lane))] = [int(c), int(g)]
+        k = f"p{int(rng.integers(N))}"
+        if k in fleet:
+            fleet[k][1] = int(rng.choice(free))
+        if seq == 3:
+            fleet.pop(sorted(fleet)[0])
+        if seq == 5:
+            fleet["q0"] = [int(rng.choice(free)), int(rng.choice(free))]
+
+    # packed rows: every goal cached by both must hold identical words
+    shared = set(flat.service.goal_rows) & set(mesh.service.goal_rows)
+    shared.discard(-1)
+    assert shared
+    for g in shared:
+        a = np.asarray(mesh.service.dirs[mesh.service.goal_rows[g]])
+        b = np.asarray(flat.service.dirs[flat.service.goal_rows[g]])
+        assert np.array_equal(a, b), f"packed row for goal {g} diverged"
+    # the daemon really ran device-resident on the mesh
+    assert mesh.service.r_cap > 0
+    per = mesh.service.resident_shard_bytes()
+    assert len(per) == mesh_shape[0] * mesh_shape[1]
+
+
+def test_mesh_resident_bytes_shrink_with_mesh_size():
+    """The memory lever: per-shard resident bytes of the dominant
+    buffer (the dirs cache) shrink ~mesh-size."""
+    grid = Grid.default()
+    fleet = [(f"p{k}", 101 + k, 3030 + k) for k in range(8)]
+    per = {}
+    for a in (2, 8):
+        run = _runner(grid, mesh=SolverMesh(a))
+        run.handle(_req(pc.PackedFleetEncoder(), 1, fleet))
+        shards = run.service.resident_shard_bytes()
+        assert len(shards) == a
+        assert len(set(shards.values())) == 1  # balanced
+        per[a] = next(iter(shards.values()))
+    # 8-way shards hold ~1/4 of what 2-way shards hold (small epsilon
+    # for the replicated lane remainders)
+    assert per[8] < per[2] / 2
+    # gauges exist after a tick (the beacon ships them)
+    reg = reg_mod.get_registry()
+    assert any(k.startswith("solverd.resident_bytes")
+               for k in reg.snapshot()["gauges"])
+
+
+def test_mesh_seq_gap_snapshot_resync():
+    """Delta-chain bookkeeping is untouched by sharding: a gap flags
+    snapshot_needed, and the snapshot resync restores byte-identity."""
+    grid = _grid()
+    flat = _runner(grid)
+    mesh = _runner(grid, mesh=SolverMesh(2))
+    enc_f = pc.PackedFleetEncoder(snapshot_every=1000)
+    enc_m = pc.PackedFleetEncoder(snapshot_every=1000)
+    fleet = [("a", 0, 37), ("b", 5, 60), ("c", 34, 12)]
+    for seq in (1, 2):
+        rf = flat.handle(_req(enc_f, seq, fleet))
+        rm = mesh.handle(_req(enc_m, seq, fleet))
+        assert rm["data"] == rf["data"]
+    # drop seq 3: encode it (advancing the chain) but never deliver
+    enc_m.encode_tick(3, fleet)
+    fleet2 = fleet[:2] + [("c", 34, 99)]
+    assert not mesh.ingest(_req(enc_m, 4, fleet2))
+    assert mesh.snapshot_needed
+    assert reg_mod.get_registry().counter_value("solverd.seq_gaps") >= 1
+    # the resync snapshot re-aligns both daemons exactly
+    enc_m.force_snapshot = True
+    enc_f.force_snapshot = True
+    # flat side also needs 3..4 applied to stay in lockstep
+    flat.handle(_req(enc_f, 3, fleet))
+    flat.handle(_req(enc_f, 4, fleet2))
+    enc_f.force_snapshot = True
+    rm = mesh.handle(_req(enc_m, 5, fleet2))
+    rf = flat.handle(_req(enc_f, 5, fleet2))
+    assert rm["data"] == rf["data"]
+    assert _service_digests(mesh.service) == _service_digests(flat.service)
+
+
+def test_mesh_deferred_fields_and_queue():
+    """The deferred-field path (CPU default in production): lanes park
+    on the STAY row, the idle-window sweep runs SHARDED, and the
+    released plans match the flat daemon's."""
+    grid = _grid()
+    flat = _runner(grid, defer=True)
+    mesh = _runner(grid, mesh=SolverMesh(2), defer=True)
+    enc_f = pc.PackedFleetEncoder()
+    enc_m = pc.PackedFleetEncoder()
+    fleet = [("a", 2 * 16 + 2, 2 * 16 + 7)]
+    rf = flat.handle(_req(enc_f, 1, fleet))
+    rm = mesh.handle(_req(enc_m, 1, fleet))
+    assert pc.decode_b64(rm["data"]).idx.size == 0  # parked on STAY
+    assert rm["data"] == rf["data"]
+    assert flat.service.process_field_queue() == 1
+    assert mesh.service.process_field_queue() == 1
+    rf = flat.handle(_req(enc_f, 2, fleet))
+    rm = mesh.handle(_req(enc_m, 2, fleet))
+    assert pc.decode_b64(rm["data"]).idx.size == 1  # field landed
+    assert rm["data"] == rf["data"]
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (2, 4)],
+                         ids=["2way", "2x4"])
+def test_mesh_dynamic_world_toggle_and_repair(mesh_shape):
+    """World toggles on sharded caches: the STAY safety patch, the
+    queued repair, and the repaired rows must all match the flat
+    daemon bit-for-bit (the 2x4 variant drives the tiled dist-returning
+    sweep the host repair mirrors start from)."""
+    grid = _grid()
+    flat = _runner(grid)
+    mesh = _runner(grid, mesh=SolverMesh(*mesh_shape))
+    for run in (flat, mesh):
+        run.service.dynamic_world = True
+        run.service.keep_dist = True
+    enc_f = pc.PackedFleetEncoder()
+    enc_m = pc.PackedFleetEncoder()
+    fleet = [("a", 0, 37), ("b", 5, 60)]
+    rf = flat.handle(_req(enc_f, 1, fleet))
+    rm = mesh.handle(_req(enc_m, 1, fleet))
+    assert rm["data"] == rf["data"]
+    toggles = [(18, True), (19, True)]
+    world = {"type": "world_update", "seq": 1, "world_seq": 1,
+             "toggles": [[c, b] for c, b in toggles]}
+    assert flat.handle_world(dict(world)) == 2
+    assert mesh.handle_world(dict(world)) == 2
+    # STAY patch landed identically on the sharded cache
+    for g in flat.service.goal_rows:
+        if g == -1 or g not in mesh.service.goal_rows:
+            continue
+        a = np.asarray(mesh.service.dirs[mesh.service.goal_rows[g]])
+        b = np.asarray(flat.service.dirs[flat.service.goal_rows[g]])
+        assert np.array_equal(a, b)
+    # the queued repair resolves to identical rows + digests
+    flat.service.process_field_queue()
+    mesh.service.process_field_queue()
+    rf = flat.handle(_req(enc_f, 2, fleet))
+    rm = mesh.handle(_req(enc_m, 2, fleet))
+    assert rm["data"] == rf["data"]
+    assert _service_digests(mesh.service) == _service_digests(flat.service)
+
+
+# ---------------------------------------------------------------------------
+# tenant slab over the mesh
+# ---------------------------------------------------------------------------
+
+
+def _mt_runner(grid, mesh=None):
+    pub = []
+    svc = PlanService(grid, capacity_min=4, mesh=mesh)
+    svc.defer_fields = False
+    slab = TenantSlab(svc, grid)
+    runner = MultiTenantRunner(slab, grid,
+                               publish=lambda t, d: pub.append((t, d)),
+                               max_tenants=4, idle_evict_ms=0.0)
+    return runner, pub
+
+
+def test_mesh_tenant_slab_matches_flat():
+    """The [T, L] super-batch under shard_map: per-tenant responses and
+    per-tenant audit digests equal the flat slab's."""
+    grid = _grid()
+    fleet = [("a", 0, 37), ("b", 5, 60), ("c", 200, 12)]
+    out = {}
+    for name, mesh in (("flat", None), ("m2", SolverMesh(2)),
+                       ("m8", SolverMesh(8))):
+        runner, pub = _mt_runner(grid, mesh)
+        encs = {ns: pc.PackedFleetEncoder() for ns in ("t0", "t1")}
+        for seq in range(1, 5):
+            for ns, enc in encs.items():
+                assert runner.ingest(ns, _req(enc, seq, fleet))
+            p = runner.begin()
+            assert p is not None
+            runner.finish(p)
+        rows = [d["data"] for t, d in pub
+                if d.get("type") == "plan_response"]
+        digs = []
+        for t in sorted(runner.tenants.values(), key=lambda t: t.ns):
+            entries, _ = audit_entries_tenant(runner.slab, t)
+            digs.append(tuple((e.section, e.count, e.digest)
+                              for e in entries))
+        out[name] = (rows, digs)
+    assert out["flat"] == out["m2"] == out["m8"]
+
+
+# ---------------------------------------------------------------------------
+# audit plane under sharding (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_corruption_hook_and_bisect(monkeypatch):
+    """Injected corruption in a sharded lane must (a) fork the digests
+    exactly as on the flat daemon and (b) bisect to the exact lane via
+    the drill protocol answered from the sharded device pull."""
+    monkeypatch.setenv("JG_AUDIT_TEST_HOOKS", "1")
+    grid = _grid()
+    run = _runner(grid, mesh=SolverMesh(2))
+    enc = pc.PackedFleetEncoder()
+    fleet = [(f"p{k}", k, 37 + k) for k in range(5)]
+    run.handle(_req(enc, 1, fleet))
+    svc = run.service
+    truth = au.lane_digest(*svc.audit_views("mirror"))
+    assert svc.set_corruption(3, field="goal", delta=2, view="device")
+    m = au.lane_digest(*svc.audit_views("mirror"))
+    d = au.lane_digest(*svc.audit_views("device"))
+    assert m == truth and d != m  # device slab drifted under the mirror
+    # the fault sticks across the next sharded scatter
+    run.handle(_req(enc, 2, fleet))
+    d2 = au.lane_digest(*svc.audit_views("device"))
+    assert d2 != au.lane_digest(*svc.audit_views("mirror"))
+    # bisect: drill mirror vs device through the daemon's own reply
+    # path; the finding must name lane 3's goal
+    def transport(req):
+        reply = audit_drill_reply(svc, run.packed.names,
+                                  {**req, "view": req["view"]},
+                                  peer_id="solverd")
+        return reply
+
+    driller = au.AuditDriller(transport=transport)
+    res = driller.drill_lanes("solverd", "mirror", "solverd", "device",
+                              span=max(svc.r_cap, 8))
+    assert res["findings"], res
+    finding = res["findings"][0]
+    assert finding["lane"] == 3 and finding["field"] == "goal"
+    # audit entries carry both sections at the last applied seq
+    entries, extra = audit_entries(svc, 2)
+    secs = {e.section for e in entries}
+    assert {au.SEC_MIRROR, au.SEC_DEVICE, au.SEC_FIELDS} <= secs
+
+
+# ---------------------------------------------------------------------------
+# flat-path pin: JG_SOLVER_MESH unset changes nothing
+# ---------------------------------------------------------------------------
+
+
+def test_env_unset_keeps_flat_path_byte_identical(monkeypatch):
+    """The kill-switch contract: with JG_SOLVER_MESH unset the daemon
+    builds NO mesh (service.mesh is None — the pre-mesh code path, same
+    programs, same wire bytes as a never-meshed build)."""
+    monkeypatch.delenv("JG_SOLVER_MESH", raising=False)
+    assert mesh_spec_from_env(os.environ.get("JG_SOLVER_MESH")) is None
+    grid = _grid()
+    run = _runner(grid)
+    assert run.service.mesh is None
+    # the step/sweep programs are the plain jitted ones (no shard_map
+    # wrapper objects)
+    enc = pc.PackedFleetEncoder()
+    fleet = [("a", 0, 37), ("b", 5, 60)]
+    r1 = run.handle(_req(enc, 1, fleet))
+    # golden cross-check: a second flat runner produces identical bytes
+    run2 = _runner(grid)
+    enc2 = pc.PackedFleetEncoder()
+    r2 = run2.handle(_req(enc2, 1, fleet))
+    assert r1["data"] == r2["data"]
+    # and no mesh gauges leak into the registry from the flat path
+    run.service.update_mesh_gauges()
+    assert run.service.resident_shard_bytes() == {}
+
+
+# ---------------------------------------------------------------------------
+# slow live e2e: a real fleet through a mesh solverd
+# ---------------------------------------------------------------------------
+
+
+_BUILD = Path(__file__).resolve().parents[1] / "cpp" / "build"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not (_BUILD / "mapd_bus").exists()
+    and (shutil.which("cmake") is None or shutil.which("ninja") is None),
+    reason="requires the C++ runtime (prebuilt or buildable)")
+@pytest.mark.parametrize("mesh_spec", ["2", "8"])
+def test_live_fleet_through_mesh_solverd(tmp_path, mesh_spec):
+    """A small live fleet (busd + C++ centralized manager + agents) must
+    complete every task when the planning daemon spans a virtual mesh
+    (JG_SOLVER_MESH via --mesh)."""
+    from p2p_distributed_tswap_tpu.runtime.fleet import Fleet
+
+    mapf = tmp_path / "t12.map.txt"
+    mapf.write_text("\n".join(["." * 12] * 12) + "\n")
+    log_dir = tmp_path / "logs"
+    port = 7480 + int(mesh_spec)
+    with Fleet("centralized", num_agents=2, port=port,
+               map_file=str(mapf), solver="tpu", log_dir=str(log_dir),
+               solverd_args=["--cpu", "--mesh", mesh_spec]) as fleet:
+        time.sleep(4)
+        fleet.command("tasks 2")
+
+        deadline = time.monotonic() + 90
+        done = 0
+        while time.monotonic() < deadline:
+            done = sum(f.read_text(errors="ignore").count("DONE")
+                       for f in log_dir.glob("agent_*.log"))
+            if done >= 2:
+                break
+            time.sleep(1)
+        fleet.quit()
+        solverd_log = (log_dir / "solverd.log").read_text(errors="ignore")
+        assert f"mesh={mesh_spec}x1" in solverd_log
+        assert done >= 2, "".join(
+            f.read_text(errors="ignore")[-500:]
+            for f in sorted(log_dir.glob("*.log")))
