@@ -122,6 +122,26 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
 
         lines.append("MESH " + " | ".join(
             _mesh_cell(peer, p) for peer, p in mesh_rows))
+    # federated world regions (ISSUE 14): per-region tasks/s + the
+    # handoff ledger — the live proof every region pair pulls its
+    # weight and nothing is stuck mid-transfer
+    fed = rollup.get("federation")
+    if fed:
+        cells = []
+        for rname, r in (fed.get("per_region") or {}).items():
+            tps = r.get("tasks_per_s")
+            cell = (f"{rname}{'!' if r.get('stale') else ''}:"
+                    f" {_fmt(tps, '.2f')}/s"
+                    f" hs={r['handoffs_sent']}/{r['handoffs_acked']}")
+            if r.get("pending_handoffs"):
+                cell += f" pend={r['pending_handoffs']}!"
+            if r.get("handoffs_dup_dropped"):
+                cell += f" dup={r['handoffs_dup_dropped']}"
+            if r.get("mirrors"):
+                cell += f" mir={r['mirrors']}"
+            cells.append(cell)
+        lines.append(f"REGIONS {fed['regions']} "
+                     f"({fed['managers']} mgr) " + " | ".join(cells))
     # world-epoch tracking (ISSUE 10 satellite): every peer carrying a
     # world_seq gauge, plus the audit beacons' per-tenant epochs — a
     # dynamic-world-OFF peer in a toggling fleet renders "OFF!", the

@@ -64,6 +64,7 @@ from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (  # noqa: E402
     FleetAggregator, counter_total)
 from p2p_distributed_tswap_tpu.obs.registry import hist_quantile  # noqa: E402
 from p2p_distributed_tswap_tpu.runtime import buspool  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import region as regionlib  # noqa: E402,E501
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402,E501
 from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
     BUILD_DIR, ensure_built, wait_for_log)
@@ -211,13 +212,52 @@ def _timeline_summary(trace_dir: Path) -> dict:
     return summary
 
 
+def _federation_counters(watch, mgr_proc: str) -> dict:
+    """Window deltas of the handoff-protocol counters, summed across
+    every region manager — the one evidence dict both the load rungs
+    and the chaos judge read (keep them from diverging)."""
+    return {
+        "handoffs_sent": int(watch.delta(
+            mgr_proc, "manager.handoffs_sent")),
+        "handoffs_acked": int(watch.delta(
+            mgr_proc, "manager.handoffs_acked")),
+        "handoffs_received": int(watch.delta(
+            mgr_proc, "manager.handoffs_received")),
+        "handoffs_dup_dropped": int(watch.delta(
+            mgr_proc, "manager.handoffs_dup_dropped")),
+        "handoff_retransmits": int(watch.delta(
+            mgr_proc, "manager.handoff_retransmits")),
+        "handoff_outbox_overflow": int(watch.delta(
+            mgr_proc, "manager.handoff_outbox_overflow")),
+        "conflict_releases": int(watch.delta(
+            mgr_proc, "manager.fed_conflict_releases")),
+    }
+
+
+def _fed_spec(args):
+    """``(cols, rows, total)`` from the rung's --regions spec (None/1 =
+    the single-pair fleet)."""
+    cols, rows = regionlib.fed_parse_spec(getattr(args, "regions", None))
+    return cols, rows, cols * rows
+
+
 def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
-    """One measured load rung: fresh fleet, settle, window, verdicts."""
+    """One measured load rung: fresh fleet, settle, window, verdicts.
+
+    With ``--regions CxR`` (ISSUE 14) the fleet is FEDERATED: one
+    (manager [, solverd]) pair per region on the shared bus pool, each
+    manager owning its rectangle and sampling its own pickups, one
+    world-spanning sim pool driven through all of them; the window
+    signals sum across managers and the rung grows a ``federation``
+    section (per-region tasks/s + handoff counters)."""
     import shutil
 
     ensure_built()
+    fed_cols, fed_rows, fed_total = _fed_spec(args)
     home_port = buspool.free_port()
-    log_dir = Path(args.log_dir) / f"a{agents}_t{tick_ms}_s{args.shards}"
+    log_dir = Path(args.log_dir) / (f"a{agents}_t{tick_ms}_s{args.shards}"
+                                    + (f"_r{fed_cols}x{fed_rows}"
+                                       if fed_total > 1 else ""))
     # a fresh rung directory every time: event logs append per-pid and
     # task_timeline merges every *.events.jsonl it finds, so a stale
     # previous run at the same config (the CI gate's fixed --log-dir)
@@ -262,26 +302,37 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
         _trace.configure(proc="simfleet")
         _events.configure("simfleet")
         if args.solver == "tpu":
-            sd_cmd = [sys.executable, "-m",
-                      "p2p_distributed_tswap_tpu.runtime.solverd",
-                      "--port", str(home_port), "--map", args.map_file,
-                      "--warm", str(agents), "--cpu"]
-            sd_proc = spawn("solverd", sd_cmd)
-            if not wait_for_log(log_dir / "solverd.log", "solverd up",
-                                900, proc=sd_proc):
-                raise RuntimeError("solverd never became ready")
-        mgr = spawn(
-            "manager",
-            [str(BUILD_DIR / "mapd_manager_centralized"),
-             "--port", str(home_port), "--map", args.map_file,
-             "--solver", "cpu" if args.solver == "native" else "tpu",
-             "--planning-interval-ms", str(tick_ms),
-             "--max-tracked-agents", str(agents + 16),
-             # seed audit (ISSUE 11): the manager's task sampling is the
-             # last stochastic path fleetsim touches — thread the one
-             # harness seed through it so a rung is re-runnable
-             "--seed", str(args.seed)],
-            stdin=subprocess.PIPE)
+            for rid in range(fed_total):
+                tag = f"_r{rid}" if fed_total > 1 else ""
+                sd_cmd = [sys.executable, "-m",
+                          "p2p_distributed_tswap_tpu.runtime.solverd",
+                          "--port", str(home_port), "--map",
+                          args.map_file, "--warm", str(agents), "--cpu",
+                          *regionlib.fed_cli_args(rid, fed_cols,
+                                                  fed_rows, "solverd")]
+                sd_proc = spawn(f"solverd{tag}", sd_cmd)
+                if not wait_for_log(log_dir / f"solverd{tag}.log",
+                                    "solverd up", 900, proc=sd_proc):
+                    raise RuntimeError(f"solverd{tag} never became ready")
+        mgrs = []
+        for rid in range(fed_total):
+            tag = f"_r{rid}" if fed_total > 1 else ""
+            mgr_cmd = [
+                str(BUILD_DIR / "mapd_manager_centralized"),
+                "--port", str(home_port), "--map", args.map_file,
+                "--solver", "cpu" if args.solver == "native" else "tpu",
+                "--planning-interval-ms", str(tick_ms),
+                "--max-tracked-agents", str(agents + 16),
+                # seed audit (ISSUE 11): the manager's task sampling is
+                # the last stochastic path fleetsim touches — thread the
+                # one harness seed through it so a rung is re-runnable
+                # (per-region offset keeps the samplers independent)
+                "--seed", str(args.seed + rid),
+                *regionlib.fed_cli_args(rid, fed_cols, fed_rows,
+                                        "manager")]
+            mgrs.append(spawn(f"manager{tag}", mgr_cmd,
+                              stdin=subprocess.PIPE))
+        mgr = mgrs[0]
         time.sleep(0.5)
         sim = SimAgentPool(agents, args.side, port=home_port,
                            seed=args.seed, heartbeat_s=args.heartbeat_s)
@@ -307,8 +358,17 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
         sim.pump(1.5)
 
         def inject(k):
-            mgr.stdin.write(f"tasks {k}\n".encode())
-            mgr.stdin.flush()
+            # federated fleets split the injection across region
+            # managers (each samples pickups in its own rectangle)
+            share = -(-k // fed_total)
+            left = k
+            for m in mgrs:
+                n = min(share, left)
+                if n <= 0:
+                    break
+                m.stdin.write(f"tasks {n}\n".encode())
+                m.stdin.flush()
+                left -= n
 
         open_loop = args.mode == "open"
         inject_every = 1.0
@@ -411,6 +471,22 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             signals["world.requests"] = toggler.sent
             signals["world.updates_seen"] = sim.world_updates
             signals["world.toggles_accepted"] = sim.world_accepted
+        federation = None
+        if fed_total > 1:
+            # federation evidence (ISSUE 14): window handoff counters
+            # summed across region managers + the aggregator's
+            # per-region view — the signals a spec can gate on
+            federation = {
+                "regions": f"{fed_cols}x{fed_rows}",
+                "region_count": fed_total,
+                **_federation_counters(watch, mgr_proc),
+                "per_region": (rollup.get("federation") or {}).get(
+                    "per_region"),
+            }
+            signals["fed.handoffs_sent"] = federation["handoffs_sent"]
+            signals["fed.handoffs_acked"] = federation["handoffs_acked"]
+            signals["fed.handoffs_dup_dropped"] = \
+                federation["handoffs_dup_dropped"]
         timeline = None
         if not args.no_trace and trace_dir.exists():
             timeline = _timeline_summary(trace_dir)
@@ -420,6 +496,7 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             "agents": agents,
             "tick_ms": tick_ms,
             "shards": args.shards,
+            "regions": f"{fed_cols}x{fed_rows}" if fed_total > 1 else None,
             "mode": args.mode,
             "solver": args.solver,
             "map": f"{args.side}x{args.side} empty",
@@ -433,6 +510,8 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             "signals": signals,
             "slo": result,
         }
+        if federation is not None:
+            rung["federation"] = federation
         if toggler.sent:
             rung["world"] = {
                 "toggle_cells": args.world_toggle_cells,
@@ -514,9 +593,18 @@ class ReplayCtx:
     accumulates a human-readable fault log that rides the replay
     artifact."""
 
-    def __init__(self, pool, mgr, sim, solverd, start_solverd):
+    def __init__(self, pool, mgr, sim, solverd, start_solverd,
+                 managers=None, solverds=None):
         self.pool = pool
         self.manager = mgr
+        # federated replays (ISSUE 14): every region manager/solverd,
+        # index = region id — the handoff-kill fault targets
+        # managers[1]; a fault combining regions with a solverd
+        # kill/restart must target (and respawn) the RIGHT region's
+        # daemon or one plan wire goes dark while another doubles up
+        self.managers = list(managers) if managers else [mgr]
+        self.solverds = (list(solverds) if solverds
+                         else ([solverd] if solverd is not None else []))
         self.sim = sim
         self.solverd = solverd
         self._start_solverd = start_solverd
@@ -527,14 +615,21 @@ class ReplayCtx:
         self.notes.append(text)
         print(f"chaos: {text}", flush=True)
 
-    def restart_solverd(self, wait: bool = False):
-        """Respawn solverd (default non-blocking: a chaos recovery must
-        not stall the replay loop for the whole JAX warmup — the fleet's
-        own resync machinery picks the daemon up when it's ready)."""
+    def restart_solverd(self, wait: bool = False, rid: int = 0):
+        """Respawn region ``rid``'s solverd on ITS plan-wire topic
+        (default non-blocking: a chaos recovery must not stall the
+        replay loop for the whole JAX warmup — the fleet's own resync
+        machinery picks the daemon up when it's ready)."""
         self._solverd_generation += 1
-        self.solverd = self._start_solverd(
-            f"_r{self._solverd_generation}", wait=wait)
-        return self.solverd
+        tag = ((f"_r{rid}" if len(self.solverds) > 1 else "")
+               + f"_g{self._solverd_generation}")
+        p = self._start_solverd(tag, wait=wait, rid=rid)
+        while len(self.solverds) <= rid:
+            self.solverds.append(None)
+        self.solverds[rid] = p
+        if rid == 0:
+            self.solverd = p
+        return p
 
 
 def _final_digests(joiner) -> dict:
@@ -567,7 +662,7 @@ def _final_digests(joiner) -> dict:
 
 def run_replay(capture: dict, log_dir, solver=None, shards=None,
                no_trace: bool = False, chaos=None, drain_s=None,
-               label: str = "replay") -> dict:
+               label: str = "replay", regions=None) -> dict:
     """Re-drive a captured window open-loop as a DETERMINISTIC load
     (ISSUE 11): a fresh fleet (seeded from the capture), the captured
     tasks injected via the manager's ``taskat`` command at their
@@ -576,6 +671,11 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
     until every captured task completed (or timeout).  ``chaos``, when
     given, is polled with ``(ctx, t_rel_s)`` throughout and may kill /
     stop / restart fleet members (scripts/chaos_gate.py).
+
+    ``regions`` (ISSUE 14): a "CxR" spec replays the capture through a
+    FEDERATED fleet — per-region (manager [, solverd]) pairs, each
+    captured task injected into the manager owning its pickup cell —
+    so the chaos matrix can fault a region manager mid-handoff.
 
     Returns the replay record: outcome ledger (completed ids, missing,
     duplicates), final-watermark audit digests, the auditor's confirmed
@@ -592,6 +692,8 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
     mseed = fleet.get("manager_seed")
     mseed = seed if mseed is None else int(mseed)
     heartbeat_s = float(fleet.get("heartbeat_s") or 2.0)
+    fed_cols, fed_rows = regionlib.fed_parse_spec(regions)
+    fed_total = fed_cols * fed_rows
 
     ensure_built()
     map_file = f"/tmp/fleetsim_replay_{side}.map.txt"
@@ -638,30 +740,44 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
         _trace.configure(proc="simfleet")
         _events.configure("simfleet")
 
-        def start_solverd(tag: str = "", wait: bool = True):
+        def start_solverd(tag: str = "", wait: bool = True, rid: int = 0):
             name = f"solverd{tag}"
-            p = spawn(name, [sys.executable, "-m",
-                             "p2p_distributed_tswap_tpu.runtime.solverd",
-                             "--port", str(home_port), "--map", map_file,
-                             "--warm", str(agents), "--cpu"])
+            cmd = [sys.executable, "-m",
+                   "p2p_distributed_tswap_tpu.runtime.solverd",
+                   "--port", str(home_port), "--map", map_file,
+                   "--warm", str(agents), "--cpu",
+                   *regionlib.fed_cli_args(rid, fed_cols, fed_rows,
+                                           "solverd")]
+            p = spawn(name, cmd)
             if wait and not wait_for_log(log_dir / f"{name}.log",
                                          "solverd up", 900, proc=p):
                 raise RuntimeError(f"{name} never became ready")
             return p
 
-        sd = start_solverd() if solver == "tpu" else None
-        mgr = spawn(
-            "manager",
-            [str(BUILD_DIR / "mapd_manager_centralized"),
-             "--port", str(home_port), "--map", map_file,
-             "--solver", "cpu" if solver == "native" else "tpu",
-             "--planning-interval-ms", str(tick_ms),
-             "--max-tracked-agents", str(agents + 16),
-             "--seed", str(mseed),
-             # open-loop: completions must NOT mint fresh rng tasks —
-             # the load is exactly the captured taskat stream
-             "--open-loop"],
-            stdin=subprocess.PIPE)
+        sds = []
+        if solver == "tpu":
+            for rid in range(fed_total):
+                sds.append(start_solverd(
+                    f"_r{rid}" if fed_total > 1 else "", rid=rid))
+        sd = sds[0] if sds else None
+        mgrs = []
+        for rid in range(fed_total):
+            tag = f"_r{rid}" if fed_total > 1 else ""
+            cmd = [str(BUILD_DIR / "mapd_manager_centralized"),
+                   "--port", str(home_port), "--map", map_file,
+                   "--solver", "cpu" if solver == "native" else "tpu",
+                   "--planning-interval-ms", str(tick_ms),
+                   "--max-tracked-agents", str(agents + 16),
+                   "--seed", str(mseed + rid),
+                   # open-loop: completions must NOT mint fresh rng
+                   # tasks — the load is exactly the captured taskat
+                   # stream
+                   "--open-loop",
+                   *regionlib.fed_cli_args(rid, fed_cols, fed_rows,
+                                           "manager")]
+            mgrs.append(spawn(f"manager{tag}", cmd,
+                              stdin=subprocess.PIPE))
+        mgr = mgrs[0]
         time.sleep(0.5)
         sim = SimAgentPool(agents, side, port=home_port, seed=seed,
                            heartbeat_s=heartbeat_s)
@@ -670,7 +786,8 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
         sim.pump(1.5)
         watch.pump(0.5)
 
-        ctx = ReplayCtx(pool, mgr, sim, sd, start_solverd)
+        ctx = ReplayCtx(pool, mgr, sim, sd, start_solverd, managers=mgrs,
+                        solverds=sds)
         events = _capture.schedule(capture)
         expected = set(_capture.task_ids(capture))
         baseline = capture.get("baseline") or {}
@@ -725,11 +842,23 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
             if kind == "task":
                 px, py = payload["pickup"]
                 dx, dy = payload["delivery"]
-                mgr.stdin.write(
-                    f"taskat {px} {py} {dx} {dy} "
-                    f"{payload['id']}\n".encode())
-                mgr.stdin.flush()
-                injected += 1
+                # federated replays route each task to the manager that
+                # OWNS its pickup cell (the ownership canon); a manager
+                # a chaos fault already killed just loses its stream —
+                # the judge accounts for that, the driver must not die
+                tgt = mgr
+                if fed_total > 1:
+                    tgt = mgrs[regionlib.fed_region_of(
+                        int(px), int(py), fed_cols, fed_rows, side, side)]
+                try:
+                    tgt.stdin.write(
+                        f"taskat {px} {py} {dx} {dy} "
+                        f"{payload['id']}\n".encode())
+                    tgt.stdin.flush()
+                    injected += 1
+                except (BrokenPipeError, OSError):
+                    ctx.note(f"task {payload['id']} lost: its region "
+                             "manager is down")
             else:
                 sim.bus.publish("mapd", {"type": "world_update_request",
                                          "toggles": payload["toggles"]})
@@ -764,6 +893,14 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
                                         "manager.tasks_completed"))
         mgr_dispatched = int(watch.delta(mgr_proc,
                                          "manager.tasks_dispatched"))
+        federation = None
+        if fed_total > 1:
+            # the chaos judge's handoff evidence: summed across every
+            # region manager's beacons over the whole replay window
+            federation = {
+                "regions": f"{fed_cols}x{fed_rows}",
+                **_federation_counters(watch, mgr_proc),
+            }
         wall = time.monotonic() - t0
         window_done = len(completed)
         tps_wall = round(window_done / max(wall, 1e-9), 3)
@@ -802,6 +939,7 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
             "fleet": dict(fleet),
             "solver": solver,
             "shards": shards,
+            "federation": federation,
             "injected": injected,
             "world_injected": world_injected,
             "expected": len(expected),
@@ -1029,10 +1167,27 @@ def write_artifact(out: Path, doc: dict) -> None:
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(doc, indent=2) + "\n")
     md = [f"# fleetsim — {doc['experiment']}", ""]
+    fl = doc.get("federation_ladder")
+    if fl:
+        md += ["## federation scaling ladder", "",
+               f"aggregate tasks/s monotone with region count: "
+               f"**{fl['monotone_tasks_per_s']}**", "",
+               "| regions | pairs | tasks/s | completion | handoffs "
+               "sent/acked | dup dropped |", "|---|---|---|---|---|---|"]
+        for r in fl["rungs"]:
+            md.append(
+                f"| {r['regions']} | {r['region_count']} "
+                f"| {r['tasks_per_s']} | {r['completion_ratio']} "
+                f"| {r.get('handoffs_sent', '-')}"
+                f"/{r.get('handoffs_acked', '-')} "
+                f"| {r.get('handoffs_dup_dropped', '-')} |")
+        md.append("")
     for rung in doc["rungs"]:
         md.append(f"### rung: {rung['agents']} agents @ "
                   f"{rung['tick_ms']} ms tick, {rung['shards']} bus "
-                  f"shard(s) ({rung['mode']} loop, {rung['solver']})")
+                  f"shard(s) ({rung['mode']} loop, {rung['solver']}"
+                  + (f", regions {rung['regions']}"
+                     if rung.get("regions") else "") + ")")
         md.append("")
         md.append(f"- window: {rung['window_s']} s — "
                   f"{rung['window_tasks_completed']} completed / "
@@ -1071,6 +1226,16 @@ def main(argv=None) -> int:
                     help="busd pool shards (the federated plane)")
     ap.add_argument("--tick-ms", type=int, default=250,
                     help="manager planning interval")
+    ap.add_argument("--regions", default=None,
+                    help="federated world regions (ISSUE 14): a CxR "
+                         "spec (e.g. 2x1) brings up one (manager"
+                         "[, solverd]) pair per region on the shared "
+                         "bus pool; unset/1 = single-pair fleet")
+    ap.add_argument("--region-ladder", default=None,
+                    help="comma list of region specs (e.g. 1,2x1,2x2): "
+                         "run the SAME workload through each federation "
+                         "size and record aggregate tasks/s per rung — "
+                         "the scaling artifact mode")
     ap.add_argument("--mode", choices=["closed", "open"], default="closed",
                     help="closed: one task per agent, manager refills on "
                          "done (peak sustainable); open: inject --rate "
@@ -1125,6 +1290,10 @@ def main(argv=None) -> int:
     ap.add_argument("--replay-drain-s", type=float, default=None,
                     help="post-injection completion budget (default: "
                          "max(30, capture duration))")
+    ap.add_argument("--replay-regions", default=None,
+                    help="replay the capture through a federated CxR "
+                         "fleet (tasks routed to their pickup region's "
+                         "manager)")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip JG_TRACE (phase-attribution SLOs read "
                          "unknown)")
@@ -1154,7 +1323,8 @@ def main(argv=None) -> int:
                          solver=args.replay_solver,
                          shards=args.replay_shards,
                          no_trace=args.no_trace,
-                         drain_s=args.replay_drain_s)
+                         drain_s=args.replay_drain_s,
+                         regions=args.replay_regions)
         print(json.dumps({k: res[k] for k in
                           ("expected", "completed", "missing",
                            "extra_done", "done_dups", "mgr_completed",
@@ -1178,6 +1348,51 @@ def main(argv=None) -> int:
     Path(args.map_file).write_text(
         "\n".join(["." * args.side] * args.side) + "\n")
     spec = _slo.load_spec(args.spec)
+
+    if args.region_ladder:
+        # federation scaling ladder (ISSUE 14): the SAME workload driven
+        # through 1, 2, ... region pairs — the artifact behind
+        # results/federation_r15.json (aggregate tasks/s must rise
+        # monotonically on a workload that saturates one manager)
+        rungs = []
+        ladder = []
+        for rspec in [r.strip() for r in args.region_ladder.split(",")
+                      if r.strip()]:
+            args.regions = None if rspec in ("", "1", "1x1") else rspec
+            cols, rows, total = _fed_spec(args)
+            print(f"fleetsim: federation rung {cols}x{rows} "
+                  f"({total} region pair(s))", flush=True)
+            rung = run_rung(args, args.agents, args.tick_ms, spec)
+            rungs.append(rung)
+            fed = rung.get("federation") or {}
+            ladder.append({
+                "regions": f"{cols}x{rows}",
+                "region_count": total,
+                "tasks_per_s": rung["signals"].get("fleet.tasks_per_s"),
+                "completion_ratio": rung["signals"].get(
+                    "fleet.completion_ratio"),
+                "handoffs_sent": fed.get("handoffs_sent"),
+                "handoffs_acked": fed.get("handoffs_acked"),
+                "handoffs_dup_dropped": fed.get("handoffs_dup_dropped"),
+            })
+            print(json.dumps(ladder[-1]), flush=True)
+        tps = [r["tasks_per_s"] for r in ladder]
+        monotone = (all(v is not None for v in tps)
+                    and all(b >= a for a, b in zip(tps, tps[1:])))
+        doc = {
+            "experiment": "federated world regions: aggregate tasks/s "
+                          "vs region count on one saturating workload",
+            "spec": spec,
+            "rungs": rungs,
+            "saturation": None,
+            "federation_ladder": {"rungs": ladder,
+                                  "monotone_tasks_per_s": monotone},
+        }
+        print(json.dumps({"ladder": ladder, "monotone": monotone}),
+              flush=True)
+        if args.out:
+            write_artifact(Path(args.out), doc)
+        return 0 if monotone else 1
 
     rungs = []
     saturation = None

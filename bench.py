@@ -91,6 +91,12 @@ bytes (the memory lever: peak HBM per device shrinks ~mesh-size), and
 the bit_identical verdict — the first rungs of the sharded serving
 trajectory.
 
+Federation axis (ISSUE 14): unless BENCH_FEDERATION=0, the headline
+carries a ``federation`` record — the live 2x1-region smoke
+(scripts/federation_smoke.py): world-spanning tasks through two region
+(manager) pairs, exact-once completion, handoff-protocol sent/acked
+evidence, per-region ledgers drained.
+
 Replay axis (ISSUE 11): unless BENCH_REPLAY=0, the headline carries a
 ``replay`` record — replay FIDELITY of the committed CI capture
 (results/captures/ci_small.capture.json re-driven open-loop through
@@ -662,6 +668,51 @@ def run_fleetsim_axis() -> dict:
     }
 
 
+def run_federation_axis() -> dict:
+    """Federation rung for the BENCH trajectory (ISSUE 14): the live
+    2x1-region smoke — world-spanning tasks through two (manager,
+    solverd-less) region pairs, exact-once completion + handoff-protocol
+    evidence.  Failures are recorded, never fatal."""
+    import shutil
+
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    if not (BUILD_DIR / "mapd_bus").exists() \
+            and (shutil.which("cmake") is None
+                 or shutil.which("ninja") is None):
+        return {"skipped": "C++ runtime unavailable"}
+    cmd = [sys.executable,
+           os.path.join(root, "scripts", "federation_smoke.py"),
+           "--log-dir", "/tmp/jg_bench_federation_logs"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=420,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        return {"error": "federation smoke timeout"}
+    rec = None
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("federation smoke: "):
+            try:
+                rec = json.loads(line.split(": ", 1)[1])
+            except json.JSONDecodeError:
+                pass
+    if rec is None:
+        return {"error": (proc.stderr or proc.stdout or "no output")[-300:]}
+    return {
+        "regions": "2x1",
+        "injected": rec.get("injected"),
+        "cross_region_tasks": rec.get("cross_region_tasks"),
+        "completed": rec.get("completed"),
+        "handoffs_sent": rec.get("handoffs_sent"),
+        "handoffs_acked": rec.get("handoffs_acked"),
+        "views_drained": rec.get("views_drained"),
+        "exact_once_ok": rec.get("ok"),
+    }
+
+
 def run_field_engine_axis() -> dict:
     """Field-engine rung for the BENCH trajectory (ISSUE 9): ms/field of
     a full resweep vs the bounded-region incremental repair at CI scale
@@ -958,6 +1009,10 @@ def main():
     if os.environ.get("BENCH_MESH", "1") != "0":
         # mesh axis (ISSUE 13): flat vs 2/8-way virtual-mesh solverd
         head["mesh"] = run_mesh_axis()
+    if os.environ.get("BENCH_FEDERATION", "1") != "0":
+        # federation axis (ISSUE 14): 2x1 region pairs, exact-once
+        # world-spanning completion + handoff evidence
+        head["federation"] = run_federation_axis()
     print(json.dumps(head), flush=True)
 
 

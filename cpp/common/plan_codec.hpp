@@ -40,6 +40,14 @@ constexpr uint8_t kResponse = 3;
 // zero padding; seq = the manager's monotone world_seq.  Byte-identical
 // mirror of plan_codec.py encode_world/decode_world.
 constexpr uint8_t kWorld = 4;
+// handoff1 (ISSUE 14): a cross-region agent-lane + task-ledger transfer
+// on the unchanged packed1 framing — byte-identical mirror of
+// plan_codec.py encode_handoff/decode_handoff (see its layout comment:
+// idx=[pos,goal,phase], pos=[pickup,delivery,has_task],
+// goal=[id_lo,id_hi,0] with id = hi * 32768 + lo, names=[peer];
+// seq = per-(src,dst) handoff chain seq, base_seq = source region id).
+constexpr uint8_t kHandoff = 5;
+constexpr int64_t kHandoffIdBase = 32768;
 constexpr const char* kCodecName = "packed1";
 constexpr const char* kWorldCap = "world1";
 constexpr int kSnapshotEvery = 64;
@@ -291,6 +299,59 @@ inline Packet encode_world(int64_t world_seq,
   for (int32_t b : blocked) p.pos.push_back(b ? 1 : 0);
   p.goal.assign(cells.size(), 0);
   return p;
+}
+
+// One cross-region agent transfer (ISSUE 14; runtime/region.py is the
+// ownership canon deciding WHEN it fires).
+struct HandoffRec {
+  int64_t seq = 0;
+  int32_t src_region = 0;
+  std::string peer;
+  int32_t pos = 0;
+  int32_t goal = 0;
+  int32_t phase = 0;  // 0 idle, 1 to-pickup, 2 to-delivery
+  bool has_task = false;
+  int64_t task_id = 0;
+  int32_t pickup = 0;
+  int32_t delivery = 0;
+};
+
+inline Packet encode_handoff(const HandoffRec& r) {
+  Packet p;
+  p.kind = kHandoff;
+  p.seq = r.seq;
+  p.base_seq = r.src_region;
+  p.idx = {r.pos, r.goal, r.phase};
+  p.pos = {r.has_task ? r.pickup : 0, r.has_task ? r.delivery : 0,
+           r.has_task ? 1 : 0};
+  p.goal = {static_cast<int32_t>(r.has_task ? r.task_id % kHandoffIdBase
+                                            : 0),
+            static_cast<int32_t>(r.has_task ? r.task_id / kHandoffIdBase
+                                            : 0),
+            0};
+  p.named_idx = {0};
+  p.names = {r.peer};
+  return p;
+}
+
+inline std::optional<HandoffRec> decode_handoff(const Packet& p) {
+  if (p.kind != kHandoff || p.idx.size() != 3 || p.pos.size() != 3 ||
+      p.goal.size() != 3 || p.names.size() != 1)
+    return std::nullopt;
+  HandoffRec r;
+  r.seq = p.seq;
+  r.src_region = static_cast<int32_t>(p.base_seq);
+  r.peer = p.names[0];
+  r.pos = p.idx[0];
+  r.goal = p.idx[1];
+  r.phase = p.idx[2];
+  r.has_task = p.pos[2] != 0;
+  if (r.has_task) {
+    r.task_id = static_cast<int64_t>(p.goal[1]) * kHandoffIdBase + p.goal[0];
+    r.pickup = p.pos[0];
+    r.delivery = p.pos[1];
+  }
+  return r;
 }
 
 inline std::string encode_b64(const Packet& p) { return b64_encode(encode(p)); }

@@ -14,6 +14,7 @@
 #pragma once
 
 #include <algorithm>
+#include <cctype>
 #include <set>
 #include <string>
 
@@ -54,6 +55,117 @@ class RegionMap {
 
  private:
   int cells_;
+};
+
+// ---------------------------------------------------------------------------
+// Federated world regions (ISSUE 14) — native mirror of the ownership canon
+// in runtime/region.py (fed_* helpers), kept RULE-IDENTICAL and golden-
+// tested via codec_golden --fedmap.  The grid splits into cols x rows
+// ceil-width rectangular slabs, region id = ry * cols + rx; hysteresis and
+// the border-mirror strip are margin tests against the owning rectangle.
+// ---------------------------------------------------------------------------
+
+constexpr const char* kFedTopicPrefix = "mapd.fed.";
+constexpr int kDefaultFedHysteresis = 2;
+constexpr int kDefaultFedBorder = 2;
+
+struct FedRect {
+  int x0 = 0, y0 = 0, x1 = 0, y1 = 0;  // half-open
+};
+
+class FedMap {
+ public:
+  // spec "CxR" or bare "N" (= Nx1); ""/"0"/"1"/"1x1" = 1x1 = off.
+  // Malformed specs yield cols_ = 0 (caller must treat as fatal — a
+  // half-parsed world partition must never route silently).
+  static FedMap parse(const std::string& spec) {
+    FedMap m;
+    std::string s;
+    for (char c : spec) s += static_cast<char>(::tolower(c));
+    if (s.empty() || s == "0" || s == "1" || s == "1x1") {
+      m.cols_ = m.rows_ = 1;
+      return m;
+    }
+    int cols = 0, rows = 1;
+    size_t x = s.find('x');
+    try {
+      size_t used = 0;
+      if (x == std::string::npos) {
+        cols = std::stoi(s, &used);
+        if (used != s.size()) cols = 0;
+      } else {
+        cols = std::stoi(s.substr(0, x), &used);
+        if (used != x) cols = 0;
+        rows = std::stoi(s.substr(x + 1), &used);
+        if (used != s.size() - x - 1) rows = 0;
+      }
+    } catch (...) {
+      cols = 0;
+    }
+    if (cols < 1 || rows < 1) {
+      m.cols_ = 0;  // invalid marker
+      m.rows_ = 0;
+      return m;
+    }
+    m.cols_ = cols;
+    m.rows_ = rows;
+    return m;
+  }
+
+  FedMap() = default;
+  FedMap(int cols, int rows) : cols_(cols), rows_(rows) {}
+
+  bool valid() const { return cols_ >= 1 && rows_ >= 1; }
+  int cols() const { return cols_; }
+  int rows() const { return rows_; }
+  int total() const { return cols_ * rows_; }
+
+  static int slab(int extent, int n) { return (extent + n - 1) / n; }
+
+  int region_of(int width, int height, int x, int y) const {
+    const int cw = slab(width, cols_), rh = slab(height, rows_);
+    const int rx = std::min(x / cw, cols_ - 1);
+    const int ry = std::min(y / rh, rows_ - 1);
+    return ry * cols_ + rx;
+  }
+
+  FedRect rect_of(int width, int height, int rid) const {
+    const int cw = slab(width, cols_), rh = slab(height, rows_);
+    const int rx = rid % cols_, ry = rid / cols_;
+    FedRect r;
+    r.x0 = rx * cw;
+    r.y0 = ry * rh;
+    r.x1 = std::min((rx + 1) * cw, width);
+    r.y1 = std::min((ry + 1) * rh, height);
+    return r;
+  }
+
+  // handoff trigger: strictly more than `margin` cells outside the rect
+  // on either axis (margin >= 1 = border-ping-pong hysteresis)
+  static bool escaped(int x, int y, const FedRect& r, int margin) {
+    return x < r.x0 - margin || x > r.x1 - 1 + margin ||
+           y < r.y0 - margin || y > r.y1 - 1 + margin;
+  }
+
+  // the border-mirror strip: OUTSIDE the rect but within `border` cells
+  // of it on both axes
+  static bool in_border(int x, int y, const FedRect& r, int border) {
+    if (x >= r.x0 && x < r.x1 && y >= r.y0 && y < r.y1) return false;
+    return x >= r.x0 - border && x <= r.x1 - 1 + border &&
+           y >= r.y0 - border && y <= r.y1 - 1 + border;
+  }
+
+  static std::string fed_topic(int rid) {
+    return std::string(kFedTopicPrefix) + std::to_string(rid);
+  }
+
+  std::string solver_topic(int rid) const {
+    return total() <= 1 ? std::string("solver")
+                        : "solver.r" + std::to_string(rid);
+  }
+
+ private:
+  int cols_ = 1, rows_ = 1;
 };
 
 }  // namespace mapd

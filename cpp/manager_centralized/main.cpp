@@ -146,6 +146,32 @@ int main(int argc, char** argv) {
       knobs.get_int("--audit", "JG_AUDIT", 1) != 0;
   const int64_t audit_interval_ms =
       knobs.get_int("--audit-interval-ms", "JG_AUDIT_INTERVAL_MS", 2000);
+  // federated world regions (ISSUE 14): --regions CxR partitions the
+  // world into rectangular regions, each owned by its own
+  // (manager, solverd) pair; THIS manager owns --region-id.  Ownership,
+  // hysteresis and the border-mirror strip follow the canon in
+  // common/region.hpp ≡ runtime/region.py (golden-tested).  Unset /
+  // "1" is the kill switch: no subscription, no frames, no filters —
+  // the single-manager wire stays byte-identical.
+  const std::string regions_spec =
+      knobs.get_str("--regions", "JG_REGIONS", "1");
+  const int region_id = static_cast<int>(
+      knobs.get_int("--region-id", "JG_REGION_ID", 0));
+  const int fed_hyst = static_cast<int>(knobs.get_int(
+      "--fed-hysteresis", "JG_FED_HYSTERESIS", kDefaultFedHysteresis));
+  const int fed_border = static_cast<int>(knobs.get_int(
+      "--fed-border", "JG_FED_BORDER", kDefaultFedBorder));
+  const int64_t handoff_retry_ms = knobs.get_int(
+      "--handoff-retry-ms", "JG_HANDOFF_RETRY_MS", 1000);
+  // a federated fleet runs one plan wire per region ("solver.r<id>");
+  // the default keeps the legacy single-plane topic
+  const std::string solver_topic =
+      knobs.get_str("--solver-topic", "JG_SOLVER_TOPIC", "solver");
+  // audit-pairing namespace: the auditor joins manager↔solverd digests
+  // by ns, so each region pair gets a label (e.g. "r0") WITHOUT bus
+  // namespacing; defaults to the tenant ns for namespaced fleets
+  const std::string audit_ns = knobs.get_str(
+      "--audit-ns", "JG_AUDIT_NS", (ns_env && *ns_env) ? ns_env : "");
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -174,6 +200,32 @@ int main(int argc, char** argv) {
   DistanceCache dc(grid);
   std::mt19937_64 rng(seed);
 
+  // federation canon (ISSUE 14): parse + validate before any wire I/O —
+  // a half-parsed world partition must never route silently
+  FedMap fed = FedMap::parse(regions_spec);
+  if (!fed.valid()) {
+    fprintf(stderr, "bad --regions spec %s (want N or CxR)\n",
+            regions_spec.c_str());
+    return 2;
+  }
+  const bool fed_on = fed.total() > 1;
+  if (fed_on && (region_id < 0 || region_id >= fed.total())) {
+    fprintf(stderr, "--region-id %d out of range for %s\n", region_id,
+            regions_spec.c_str());
+    return 2;
+  }
+  const FedRect my_rect =
+      fed_on ? fed.rect_of(grid.width, grid.height, region_id) : FedRect{};
+  if (fed_on && (my_rect.x0 >= my_rect.x1 || my_rect.y0 >= my_rect.y1)) {
+    // ceil-width slabs can leave trailing regions EMPTY on narrow
+    // grids (e.g. 4x1 on a 9-wide map): a manager owning no cells
+    // would strand every task injected into it — fail loudly
+    fprintf(stderr,
+            "--regions %s leaves region %d empty on a %dx%d grid\n",
+            regions_spec.c_str(), region_id, grid.width, grid.height);
+    return 2;
+  }
+
   BusClient bus;
   std::string my_id = random_peer_id();
   if (!bus.connect(bus_host, port, my_id)) {
@@ -181,8 +233,43 @@ int main(int argc, char** argv) {
     return 1;
   }
   bus.subscribe("mapd");
-  if (region_gossip) bus.subscribe(kPosTopicWildcard);
-  if (solver == "tpu") bus.subscribe("solver");
+  if (region_gossip) {
+    if (fed_on) {
+      // interest-scoped gossip (ISSUE 14): a region manager needs only
+      // the beacon topics covering ITS rectangle expanded by the
+      // handoff/mirror margin — subscribing the fleet-wide wildcard
+      // would make every manager process every beacon and the message
+      // plane would not scale with region count at all.  Coverage: an
+      // agent we track can stand at most `hyst` cells outside the rect
+      // before the escape handoff fires, and mirrors live within
+      // `border` cells — the +1 guard band covers the crossing beat.
+      // The expanded-rect topics also spread across the SHARDED bus
+      // pool by the region indices (runtime/shardmap.py), so each
+      // manager's gossip load lands on its regions' shards.
+      const int gcells = static_cast<int>(knobs.get_int(
+          "--region-cells", "JG_REGION_CELLS", kDefaultRegionCells));
+      const int exp = std::max(fed_border, fed_hyst) + 1;
+      const int x0 = std::max(0, my_rect.x0 - exp);
+      const int y0 = std::max(0, my_rect.y0 - exp);
+      const int x1 = std::min(grid.width - 1, my_rect.x1 - 1 + exp);
+      const int y1 = std::min(grid.height - 1, my_rect.y1 - 1 + exp);
+      int n_topics = 0;
+      for (int gy = y0 / gcells; gy <= y1 / gcells; ++gy)
+        for (int gx = x0 / gcells; gx <= x1 / gcells; ++gx) {
+          bus.subscribe(std::string(kPosTopicPrefix) +
+                        std::to_string(gx) + "." + std::to_string(gy));
+          ++n_topics;
+        }
+      log_info("🗺️  region %d gossip scope: %d topic(s) over "
+               "[%d,%d)x[%d,%d)+%d\n", region_id, n_topics, my_rect.x0,
+               my_rect.x1, my_rect.y0, my_rect.y1, exp);
+    } else {
+      bus.subscribe(kPosTopicWildcard);
+    }
+  }
+  if (solver == "tpu") bus.subscribe(solver_topic);
+  // cross-region handoffs arrive on this region's own fed topic
+  if (fed_on) bus.subscribe(FedMap::fed_topic(region_id));
   // audit plane rides the un-namespaced operator topic (raw): a tenant
   // manager's digests must reach the cross-tenant auditor
   if (audit_on) bus.subscribe(audit::kAuditTopic, /*raw=*/true);
@@ -200,6 +287,12 @@ int main(int argc, char** argv) {
   // operator plane) becomes visible instead of folklore
   metrics_gauge("manager.world_seq", 0.0);
   metrics_gauge("manager.dynamic_world", dynamic_world ? 1.0 : 0.0);
+  if (fed_on) {
+    // federation gauges are the aggregator's REGIONS-section evidence
+    metrics_gauge("manager.region", static_cast<double>(region_id));
+    metrics_gauge("manager.regions", static_cast<double>(fed.total()));
+    metrics_gauge("manager.fed_pending_handoffs", 0.0);
+  }
   log_info("🧠 centralized manager %s up (grid %dx%d, solver=%s%s)\n",
            my_id.c_str(), grid.width, grid.height, solver.c_str(),
            clean ? ", clean" : "");
@@ -208,6 +301,98 @@ int main(int argc, char** argv) {
 
   std::map<std::string, AgentInfo> agents;
   std::set<std::string> known_left;
+  // ---- federation state (ISSUE 14) ----
+  // border-strip foreign agents, fed into the move-emission guard (the
+  // boundary-planning-correctness contract; see emit_moves).
+  // cell_since tracks how long the body has HELD its current cell: a
+  // freshly arrived mirror is presumed transiting and blocks the cell;
+  // one parked past the block window becomes pass-through — a foreign
+  // idle agent may sit on a border cell indefinitely, and a permanent
+  // block there starves every crossing route (found live: the 2x1
+  // ladder's crossing throughput collapsed ~4x under an unconditional
+  // guard).
+  struct Mirror {
+    Cell cell = 0;
+    int64_t last_seen = 0;
+    int64_t cell_since = 0;
+  };
+  std::map<std::string, Mirror> mirrors;
+  const int64_t mirror_block_ms = knobs.get_int(
+      "--fed-mirror-block-ms", "JG_FED_MIRROR_BLOCK_MS", 3000);
+  // mirror EXPIRY must outlive the block window (and slow heartbeats):
+  // evicting a parked foreign body between its beacons would drop the
+  // very mirror_cells entry the boundary guard reads
+  const int64_t mirror_expire_ms = std::max<int64_t>(
+      knobs.get_int("--fed-mirror-expire-ms", "JG_FED_MIRROR_EXPIRE_MS",
+                    6000),
+      2 * mirror_block_ms);
+  auto mirror_touch = [&](const std::string& peer, Cell c) {
+    const int64_t now2 = mono_ms();
+    auto [mit, fresh] = mirrors.try_emplace(peer);
+    if (fresh || mit->second.cell != c) mit->second.cell_since = now2;
+    mit->second.cell = c;
+    mit->second.last_seen = now2;
+  };
+  // outbound handoffs: seq-chained per destination region, retransmitted
+  // until acked; a peer with an unacked record is in transfer limbo and
+  // is never re-adopted from its beacons (handing_off)
+  struct OutHandoff {
+    Json frame;
+    std::string peer;
+    int dst = 0;
+    int64_t first_send_ms = 0;  // creation order (eviction key —
+                                // retransmits refresh last_send_ms
+                                // even for a dead neighbor's backlog)
+    int64_t last_send_ms = 0;
+  };
+  std::map<std::pair<int, int64_t>, OutHandoff> handoff_unacked;
+  std::map<int, int64_t> handoff_next_seq;
+  // sender incarnation: a RESTARTED manager reuses seq numbers from 1,
+  // and a receiver whose dedup set remembered the old incarnation
+  // would ack-without-applying — silently losing the lane and its
+  // task.  Every handoff frame carries this epoch; the receiver keys
+  // its dedup set by (src, epoch) and resets it when the epoch moves.
+  const int64_t fed_epoch = unix_ms();
+  // receiver dedup: per source region, the sender epoch + applied seq
+  // set (bounded) — a replayed/retransmitted handoff can never
+  // double-admit an agent (or double-dispatch its task)
+  std::map<int, std::pair<int64_t, std::set<int64_t>>> handoff_applied;
+  std::set<std::string> handing_off;
+  // peers recently adopted via handoff (peer -> flag expiry): shipped
+  // as "handoff_peers" on plan_requests so solverd attributes the
+  // fresh lanes (solverd.lanes_admitted{cause=handoff}).  STICKY for a
+  // few seconds rather than cleared on first send — the flagged
+  // request can be lost to a seq gap, and the recovery snapshot that
+  // re-declares the lane must still carry the attribution (solverd
+  // only counts NEWLY named lanes, so repeat flags never double-count)
+  std::map<std::string, int64_t> handoff_admitted;
+  int64_t last_handoff_retry = 0;
+  // CLAIM-AWARE adoption (the double-tracking guard): a beacon inside
+  // our rect but still within a NEIGHBOR's hysteresis reach may belong
+  // to that neighbor (its escape check has not fired yet) — adopting
+  // it immediately puts two managers on one body, which wedges both
+  // ledgers (found live by the 2x2 ladder: border-hovering agents
+  // collected conflicting tasks from two planners).  Such candidates
+  // wait: either the neighbor's handoff arrives and claims them, or
+  // the grace period expires and they were genuinely unclaimed (a
+  // fresh agent spawned in the band) and we adopt.
+  const int64_t claim_grace_ms = knobs.get_int(
+      "--fed-claim-grace-ms", "JG_FED_CLAIM_GRACE_MS", 4000);
+  std::map<std::string, int64_t> claim_candidates;  // peer -> first seen
+  std::vector<FedRect> fed_rects;
+  if (fed_on)
+    for (int rid = 0; rid < fed.total(); ++rid)
+      fed_rects.push_back(fed.rect_of(grid.width, grid.height, rid));
+  // claimable = outside EVERY other region's hysteresis reach: any
+  // neighbor that still owned the agent there would already have
+  // escaped-and-handed-off (the thresholds are the same geometry)
+  auto fed_claimable = [&](int x, int y) {
+    for (int rid = 0; rid < fed.total(); ++rid) {
+      if (rid == region_id) continue;
+      if (!FedMap::escaped(x, y, fed_rects[rid], fed_hyst)) return false;
+    }
+    return true;
+  };
   // cells targeted by move_instructions of the last two planning ticks:
   // a world toggle must not close a cell an agent is currently walking
   // into (its position_update lands a beat after the instruction) —
@@ -222,7 +407,21 @@ int main(int argc, char** argv) {
   std::set<long long> completed_ids;
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
-  uint64_t next_task_id = 1;
+  // task-id allocation: federated managers mint from DISJOINT residue
+  // classes (ids ≡ region_id mod region count) — colliding ids across
+  // regions would poison every dedup/ownership filter keyed by task id
+  // (two regions each "owning" a task 56; found live by the 2x2 ladder)
+  const uint64_t task_id_stride = fed_on ? fed.total() : 1;
+  uint64_t next_task_id = fed_on ? 1 + region_id : 1;
+  // advance past an externally minted id (taskat / handed-in ledger
+  // entries) while PRESERVING this region's residue class
+  auto bump_task_id_past = [&](uint64_t id) {
+    if (next_task_id > id) return;
+    // O(1): a replay can inject ids in the billions, and a unit-step
+    // loop here would stall the single bus-processing thread
+    next_task_id += ((id - next_task_id) / task_id_stride + 1)
+                    * task_id_stride;
+  };
   int64_t plan_seq = 0;
   // per-task wire-hop ledger (common/events.hpp: send advances, receive
   // max-merges, bounded by oldest-id eviction)
@@ -230,6 +429,27 @@ int main(int argc, char** argv) {
 
   auto free_cells = grid.free_cells();
   auto gen_point = [&]() { return free_cells[rng() % free_cells.size()]; };
+  // federated task sampling: pickups come from OUR region's free cells
+  // (each region manager generates its own load), deliveries stay
+  // global — cross-region tasks arise naturally and exercise the
+  // handoff path exactly like a world-spanning workload would
+  std::vector<Cell> rect_free;
+  auto rebuild_rect_free = [&]() {
+    rect_free.clear();
+    if (!fed_on) return;
+    for (Cell c : free_cells) {
+      const int x = grid.x_of(c), y = grid.y_of(c);
+      if (x >= my_rect.x0 && x < my_rect.x1 && y >= my_rect.y0 &&
+          y < my_rect.y1)
+        rect_free.push_back(c);
+    }
+  };
+  rebuild_rect_free();
+  auto gen_pickup = [&]() {
+    return (fed_on && !rect_free.empty())
+               ? rect_free[rng() % rect_free.size()]
+               : gen_point();
+  };
 
   auto point_json = [&](Cell c) {
     Json p;
@@ -247,13 +467,14 @@ int main(int argc, char** argv) {
   };
 
   auto make_task = [&]() {
-    Cell pickup = gen_point(), delivery = gen_point();
+    Cell pickup = gen_pickup(), delivery = gen_point();
     while (delivery == pickup) delivery = gen_point();
     Json t;
     t.set("pickup", point_json(pickup))
         .set("delivery", point_json(delivery))
         .set("peer_id", Json())
-        .set("task_id", next_task_id++);
+        .set("task_id", static_cast<int64_t>(next_task_id));
+    next_task_id += task_id_stride;
     return t;
   };
 
@@ -324,6 +545,92 @@ int main(int argc, char** argv) {
     pending_tasks.push_front(std::move(t));
   };
 
+  // ---- cross-region handoff, outbound (ISSUE 14) ----
+  // The agent lane AND its in-flight task-ledger entry move to the
+  // neighbor manager in one packed handoff1 record: seq-chained per
+  // (src, dst) pair, retransmitted until handoff_ack, dedup-guarded on
+  // the receiver.  The agent leaves OUR tracking immediately (its lane
+  // vanishes from the next plan delta; solverd drops it), and beacons
+  // from it are ignored while the record is unacked (handing_off) so a
+  // quick return can never make two managers plan one body.
+  auto send_handoff = [&](const std::string& peer, const AgentInfo& a,
+                          int dst) {
+    codec::HandoffRec r;
+    const int64_t hseq = ++handoff_next_seq[dst];
+    r.seq = hseq;
+    r.src_region = region_id;
+    r.peer = peer;
+    r.pos = static_cast<int32_t>(a.pos);
+    r.goal = static_cast<int32_t>(a.goal);
+    r.phase = a.phase == Phase::ToDelivery ? 2
+              : (a.phase == Phase::ToPickup ? 1 : 0);
+    if (a.task) {
+      r.has_task = true;
+      r.task_id = (*a.task)["task_id"].as_int();
+      if (auto p = parse_point((*a.task)["pickup"]))
+        r.pickup = static_cast<int32_t>(*p);
+      if (auto p = parse_point((*a.task)["delivery"]))
+        r.delivery = static_cast<int32_t>(*p);
+      // the ledger entry LEAVES this region with the lane: surrender
+      // any local at-least-once claim on its future done, or a task
+      // that was displacement-requeued here earlier would be counted
+      // by BOTH regions when it completes (found by the smoke's
+      // mgr_completed <= injected bound)
+      requeued_ids.erase(r.task_id);
+    }
+    Json f;
+    f.set("type", "handoff1")
+        .set("src", static_cast<int64_t>(region_id))
+        .set("dst", static_cast<int64_t>(dst))
+        .set("seq", hseq)
+        .set("epoch", fed_epoch)
+        .set("peer_id", peer)
+        .set("data", codec::encode_b64(codec::encode_handoff(r)));
+    bus.publish(FedMap::fed_topic(dst), f);
+    const int64_t send_ms = mono_ms();
+    handoff_unacked[{dst, hseq}] =
+        OutHandoff{f, peer, dst, send_ms, send_ms};
+    handing_off.insert(peer);
+    metrics_count("manager.handoffs_sent");
+    metrics_gauge("manager.fed_pending_handoffs",
+                  static_cast<double>(handoff_unacked.size()));
+    log_info("🛫 handoff %lld: %s -> region %d%s\n",
+             static_cast<long long>(hseq), peer.c_str(), dst,
+             r.has_task ? " (with task)" : "");
+    // bounded outbox: with a dead neighbor the chain never acks — past
+    // the cap the oldest record's task re-queues LOCALLY (at-least-once;
+    // the done path dedups by task id like every other requeue)
+    while (handoff_unacked.size() > 1024) {
+      // evict the OLDEST record by creation time (a dead neighbor's
+      // backlog), never map-order begin() — that would cancel a LIVE
+      // destination's fresh in-flight handoff first
+      auto oldest = handoff_unacked.begin();
+      for (auto it2 = handoff_unacked.begin();
+           it2 != handoff_unacked.end(); ++it2)
+        if (it2->second.first_send_ms < oldest->second.first_send_ms)
+          oldest = it2;
+      auto pkt = codec::decode_b64(oldest->second.frame["data"].as_str());
+      if (pkt) {
+        if (auto rec = codec::decode_handoff(*pkt); rec && rec->has_task) {
+          Json t;
+          t.set("pickup", point_json(static_cast<Cell>(rec->pickup)))
+              .set("delivery", point_json(static_cast<Cell>(rec->delivery)))
+              .set("peer_id", Json())
+              .set("task_id", rec->task_id);
+          requeued_ids.insert(rec->task_id);
+          pending_tasks.push_front(std::move(t));
+        }
+      }
+      handing_off.erase(oldest->second.peer);
+      metrics_count("manager.handoff_outbox_overflow");
+      handoff_unacked.erase(oldest);
+      // the pending gauge is the operator's dead-neighbor evidence —
+      // it must track evictions, not just sends/acks
+      metrics_gauge("manager.fed_pending_handoffs",
+                    static_cast<double>(handoff_unacked.size()));
+    }
+  };
+
   // drain the pending queue onto idle tracked agents (ref :367-436)
   auto try_assign_pending = [&]() {
     while (!pending_tasks.empty()) {
@@ -343,10 +650,30 @@ int main(int argc, char** argv) {
   auto emit_moves = [&](const std::vector<std::string>& ids,
                         const std::vector<Cell>& next) {
     Span sp("manager.emit_moves");
+    // border-mirror guard (ISSUE 14): cells currently occupied by
+    // FOREIGN agents (the neighbor's border-strip beacons we mirror).
+    // The planner does not know those bodies — including them as
+    // immovable lanes deadlocks TSWAP's rotation resolution (found
+    // live: the 2x2 ladder froze at the four-border crossing) — so
+    // boundary correctness is enforced HERE instead, exactly like
+    // moves_blocked_world: never instruct an agent into an occupied
+    // border cell; the lane waits a tick and routes on once the
+    // foreign agent moves.
+    std::set<Cell> mirror_cells;
+    if (fed_on) {
+      const int64_t now2 = mono_ms();
+      for (const auto& [mp, mc] : mirrors)
+        if (now2 - mc.cell_since < mirror_block_ms)
+          mirror_cells.insert(mc.cell);
+    }
     for (size_t k = 0; k < ids.size(); ++k) {
       auto it = agents.find(ids[k]);
       if (it == agents.end()) continue;
       if (next[k] == it->second.pos) continue;  // no-op moves not sent
+      if (fed_on && mirror_cells.count(next[k])) {
+        metrics_count("manager.moves_blocked_mirror");
+        continue;
+      }
       if (!grid.is_free(next[k])) {
         // dynamic worlds (ISSUE 9): a plan computed against the
         // pre-toggle mask may still point into a freshly closed cell
@@ -523,7 +850,7 @@ int main(int argc, char** argv) {
       old_goals.push_back(a.goal);
       ta.push_back(TswapAgent{static_cast<int>(ta.size()), a.pos, a.goal});
     }
-    if (ta.empty()) return;
+    if (ids.empty()) return;
     auto t0 = std::chrono::steady_clock::now();
     {
       Span sp("manager.tswap_step",
@@ -631,8 +958,25 @@ int main(int argc, char** argv) {
         req.set("hints", hints);
         plan_hints.clear();
       }
+      if (fed_on && !handoff_admitted.empty()) {
+        // recently handed-off lanes: solverd attributes their
+        // admission (lanes_admitted{cause=handoff}); expired flags
+        // prune here
+        const int64_t now2 = mono_ms();
+        Json hp;
+        for (auto hit2 = handoff_admitted.begin();
+             hit2 != handoff_admitted.end();) {
+          if (now2 > hit2->second) {
+            hit2 = handoff_admitted.erase(hit2);
+          } else {
+            hp.push_back(Json(hit2->first));
+            ++hit2;
+          }
+        }
+        if (!hp.is_null()) req.set("handoff_peers", hp);
+      }
       plan_sent_ms = mono_ms();
-      bus.publish("solver", req);
+      bus.publish(solver_topic, req);
       return;
     }
     Json req;
@@ -653,7 +997,7 @@ int main(int argc, char** argv) {
                                 (plan_seq & 0x7FFFFFFF), 1));
     sent_goals = std::move(snap);
     plan_sent_ms = mono_ms();
-    bus.publish("solver", req);
+    bus.publish(solver_topic, req);
   };
 
   // ---- dynamic worlds (ISSUE 9) ----
@@ -738,6 +1082,7 @@ int main(int argc, char** argv) {
       ++world_seq;
       dc.clear();  // native fields rebuild against the new mask on demand
       free_cells = grid.free_cells();
+      rebuild_rect_free();  // region task sampling tracks the new mask
       metrics_count("manager.world_updates");
       metrics_count("manager.world_toggles",
                     static_cast<double>(cells.size()));
@@ -772,7 +1117,7 @@ int main(int argc, char** argv) {
           }
           su.set("toggles", st);
         }
-        bus.publish("solver", su);
+        bus.publish(solver_topic, su);
       }
       log_info("🌍 world update %lld: %zu toggle(s) applied, %zu free "
                "cell(s) remain\n",
@@ -857,8 +1202,7 @@ int main(int argc, char** argv) {
     b.set("type", "audit_beacon")
         .set("peer_id", my_id)
         .set("proc", "manager_centralized")
-        .set("ns", (ns_env && *ns_env) ? std::string(ns_env)
-                                       : std::string())
+        .set("ns", audit_ns)
         .set("ts_ms", unix_ms())
         .set("interval_s", audit_interval_ms / 1000.0)
         .set("caps", caps)
@@ -1053,10 +1397,15 @@ int main(int argc, char** argv) {
                  px, py, dx, dy);
         metrics_count("manager.taskat_rejected");
       } else {
-        if (id >= 0 && static_cast<uint64_t>(id) >= next_task_id)
-          next_task_id = static_cast<uint64_t>(id) + 1;
-        const uint64_t tid =
-            id >= 0 ? static_cast<uint64_t>(id) : next_task_id++;
+        if (id >= 0)
+          bump_task_id_past(static_cast<uint64_t>(id));
+        uint64_t tid;
+        if (id >= 0) {
+          tid = static_cast<uint64_t>(id);
+        } else {
+          tid = next_task_id;
+          next_task_id += task_id_stride;
+        }
         Json t;
         t.set("pickup", point_json(grid.cell(static_cast<int>(px),
                                              static_cast<int>(py))))
@@ -1179,6 +1528,42 @@ int main(int argc, char** argv) {
             if (!p) return;
             auto it = agents.find(peer);
             if (it == agents.end()) {
+              if (fed_on) {
+                // ownership (ISSUE 14): adopt only agents standing in
+                // OUR region; a foreign agent in the border strip
+                // becomes a stationary mirror lane instead (boundary
+                // planning correctness), anything further is not ours.
+                // A peer in transfer limbo (unacked outbound handoff)
+                // is never re-adopted — the neighbor owns it the moment
+                // the record applies.
+                if (handing_off.count(peer)) return;
+                const int x = grid.x_of(*p), y = grid.y_of(*p);
+                if (fed.region_of(grid.width, grid.height, x, y)
+                    != region_id) {
+                  claim_candidates.erase(peer);
+                  if (FedMap::in_border(x, y, my_rect, fed_border))
+                    mirror_touch(peer, *p);
+                  else
+                    mirrors.erase(peer);
+                  return;
+                }
+                if (!fed_claimable(x, y)) {
+                  // inside our rect but within a neighbor's hysteresis
+                  // reach: possibly still the neighbor's.  Wait for its
+                  // handoff — or the unclaimed grace — before adopting
+                  // (mirror it meanwhile so planning routes around it).
+                  const int64_t now2 = mono_ms();
+                  auto [cit, fresh] =
+                      claim_candidates.emplace(peer, now2);
+                  if (fresh || now2 - cit->second < claim_grace_ms) {
+                    mirror_touch(peer, *p);
+                    return;
+                  }
+                  metrics_count("manager.fed_grace_adoptions");
+                }
+                claim_candidates.erase(peer);
+                mirrors.erase(peer);
+              }
               AgentInfo a;
               a.pos = a.goal = *p;
               a.last_seen_ms = mono_ms();
@@ -1190,6 +1575,22 @@ int main(int argc, char** argv) {
               AgentInfo& a = it->second;
               a.pos = *p;
               a.last_seen_ms = mono_ms();
+              if (fed_on) {
+                // hysteresis escape: hand the lane (and its task) to
+                // the region the agent now stands in — only once it is
+                // MORE than the margin outside ours, so border
+                // oscillation never thrashes ownership
+                const int x = grid.x_of(*p), y = grid.y_of(*p);
+                if (FedMap::escaped(x, y, my_rect, fed_hyst)) {
+                  const int dst =
+                      fed.region_of(grid.width, grid.height, x, y);
+                  if (dst != region_id) {
+                    send_handoff(peer, a, dst);
+                    agents.erase(it);
+                    return;
+                  }
+                }
+              }
               if (!a.task) a.goal = *p;
               // idle-but-marked-busy reconciliation: the heartbeat carries
               // a busy_task field while the agent holds a task; still-idle
@@ -1255,7 +1656,7 @@ int main(int argc, char** argv) {
                 }
                 su.set("toggles", st);
               }
-              bus.publish("solver", su);
+              bus.publish(solver_topic, su);
               metrics_count("manager.world_replays");
               log_info("🌍 replayed %zu accumulated world toggle(s) at "
                        "epoch %lld with the snapshot\n",
@@ -1286,12 +1687,228 @@ int main(int argc, char** argv) {
             // black-box query: dump the ring and answer with the path
             bus.publish("mapd",
                         flight_dump_answer("manager_centralized", my_id));
+          } else if (fed_on && type.empty() && d.has("task_id")
+                     && d.has("pickup") && d.has("delivery")
+                     && !d["peer_id"].as_str().empty()) {
+            // ownership-conflict arbitration (ISSUE 14): every manager
+            // hears every task dispatch on "mapd".  One naming an
+            // agent WE track, carrying a task our ledger cannot
+            // explain, means another region claimed the agent (grace
+            // adoption of a band-dweller, beacon races).  The agent's
+            // POSITION arbitrates — both sides apply the same rule to
+            // the same beacons, so exactly one yields: if it stands
+            // outside our rectangle we RELEASE it (our in-flight task
+            // re-queues locally; at-least-once, the done path dedups),
+            // if inside we keep it and the dispatcher releases when it
+            // hears OUR next dispatch/re-send.  Without this a
+            // band-dwelling agent collects conflicting tasks from two
+            // planners and both ledgers wedge (found live by the 2x2
+            // ladder).
+            const std::string peer = d["peer_id"].as_str();
+            auto it = agents.find(peer);
+            if (it == agents.end()) return;
+            const long long tid = d["task_id"].as_int();
+            bool known =
+                it->second.task
+                && (*it->second.task)["task_id"].as_int() == tid;
+            if (!known)
+              for (const auto& q : pending_tasks)
+                if (q["task_id"].as_int() == tid) {
+                  known = true;
+                  break;
+                }
+            if (known || requeued_ids.count(tid)
+                || completed_ids.count(tid))
+              return;
+            const int x = grid.x_of(it->second.pos);
+            const int y = grid.y_of(it->second.pos);
+            if (x >= my_rect.x0 && x < my_rect.x1 && y >= my_rect.y0 &&
+                y < my_rect.y1)
+              return;  // standing in OUR rect: the other side yields
+            requeue_task(peer, it->second,
+                         "ownership conflict, releasing");
+            metrics_count("manager.fed_conflict_releases");
+            agents.erase(it);
+            try_assign_pending();
+          } else if (type == "handoff1") {
+            // ---- cross-region handoff, inbound (ISSUE 14) ----
+            if (!fed_on || static_cast<int>(d["dst"].as_int()) != region_id)
+              return;
+            const int src = static_cast<int>(d["src"].as_int());
+            const int64_t hseq = d["seq"].as_int();
+            const int64_t hepoch = d["epoch"].as_int();
+            Json ack;
+            ack.set("type", "handoff_ack")
+                .set("src", static_cast<int64_t>(src))
+                .set("dst", static_cast<int64_t>(region_id))
+                .set("seq", hseq)
+                .set("epoch", hepoch)  // sender matches its own epoch
+                .set("peer_id", d["peer_id"]);
+            auto& src_state = handoff_applied[src];
+            if (hepoch > src_state.first) {
+              // the sender restarted (NEWER incarnation): its seq
+              // chain starts over — the old dedup set must not
+              // swallow it
+              src_state.first = hepoch;
+              src_state.second.clear();
+            } else if (hepoch < src_state.first) {
+              // a delayed frame from a DEAD incarnation: dropping it
+              // (no ack — nobody retransmits it) is the only safe
+              // move; resetting the dedup set for it would let the
+              // live epoch's already-applied records re-apply
+              metrics_count("manager.handoffs_stale_epoch");
+              return;
+            }
+            auto& seen = src_state.second;
+            if (seen.count(hseq)) {
+              // replayed/retransmitted record: ack again (its ack was
+              // lost), NEVER re-apply — a duplicate handoff must not
+              // double-admit the lane or double-dispatch its task
+              metrics_count("manager.handoffs_dup_dropped");
+              bus.publish(FedMap::fed_topic(src), ack);
+              return;
+            }
+            auto pkt = codec::decode_b64(d["data"].as_str());
+            std::optional<codec::HandoffRec> rec;
+            if (pkt) rec = codec::decode_handoff(*pkt);
+            const Cell cells = static_cast<Cell>(grid.free.size());
+            if (!rec || rec->pos < 0 || rec->pos >= cells ||
+                (rec->has_task &&
+                 (rec->pickup < 0 || rec->pickup >= cells ||
+                  rec->delivery < 0 || rec->delivery >= cells))) {
+              // malformed record: counted, NOT acked — the sender keeps
+              // retransmitting and the counter names the problem
+              metrics_count("manager.bad_handoffs");
+              return;
+            }
+            seen.insert(hseq);
+            while (seen.size() > 8192) seen.erase(seen.begin());
+            const std::string hpeer = rec->peer;
+            AgentInfo a;
+            a.pos = static_cast<Cell>(rec->pos);
+            a.goal = (rec->goal >= 0 && rec->goal < cells)
+                         ? static_cast<Cell>(rec->goal) : a.pos;
+            a.last_seen_ms = mono_ms();
+            a.dispatched_ms = mono_ms();
+            if (rec->has_task) {
+              Json t;
+              t.set("pickup", point_json(static_cast<Cell>(rec->pickup)))
+                  .set("delivery",
+                       point_json(static_cast<Cell>(rec->delivery)))
+                  .set("peer_id", hpeer)
+                  .set("task_id", rec->task_id);
+              a.task = t;
+              a.phase = rec->phase == 2 ? Phase::ToDelivery
+                                        : Phase::ToPickup;
+              // the ledger entry moves WITH the lane: metrics and the
+              // audit ledger digest now account for it here
+              TaskMetric m;
+              m.task_id = static_cast<uint64_t>(rec->task_id);
+              m.peer_id = hpeer;
+              m.sent_time = unix_ms();
+              task_metrics.add_metric(m);
+              if (rec->task_id >= 0)
+                bump_task_id_past(static_cast<uint64_t>(rec->task_id));
+            }
+            known_left.erase(hpeer);  // --clean must re-track a handoff
+            mirrors.erase(hpeer);
+            claim_candidates.erase(hpeer);
+            // ownership-race merge: we may already track this agent
+            // (adopted from its beacons before the neighbor's record
+            // arrived).  The LEDGER ENTRY is what must never be lost
+            // or doubled:
+            //  - incoming carries a DIFFERENT task: our local
+            //    assignment RE-QUEUES (never silently clobbered — that
+            //    loses it from every ledger; found by the smoke's
+            //    exact-once accounting) and the neighbor's state wins;
+            //  - incoming carries the SAME task, or NO task while we
+            //    hold one: our record is fresher (pickup flips and
+            //    goal exchanges happened HERE) — keep it, just ack.
+            auto prev = agents.find(hpeer);
+            if (prev != agents.end() && prev->second.task) {
+              const long long ptid =
+                  (*prev->second.task)["task_id"].as_int();
+              if (rec->has_task && ptid != rec->task_id) {
+                requeue_task(hpeer, prev->second, "handoff displaced");
+              } else {
+                prev->second.last_seen_ms = mono_ms();
+                metrics_count("manager.handoffs_received");
+                bus.publish(FedMap::fed_topic(src), ack);
+                return;
+              }
+            }
+            agents[hpeer] = a;
+            handoff_admitted[hpeer] = mono_ms() + 10000;
+            metrics_count("manager.handoffs_received");
+            bus.publish(FedMap::fed_topic(src), ack);
+            log_info("🛬 handoff %lld from region %d: adopted %s%s\n",
+                     static_cast<long long>(hseq), src, hpeer.c_str(),
+                     rec->has_task ? " (with task)" : "");
+            try_assign_pending();
+          } else if (type == "handoff_ack") {
+            if (!fed_on || static_cast<int>(d["src"].as_int()) != region_id)
+              return;
+            if (d["epoch"].as_int() != fed_epoch)
+              return;  // an ack for a PREVIOUS incarnation's record
+                       // must not cancel THIS incarnation's in-flight
+                       // handoff (same seq, different lane/task)
+            auto key = std::make_pair(
+                static_cast<int>(d["dst"].as_int()), d["seq"].as_int());
+            auto hit = handoff_unacked.find(key);
+            if (hit != handoff_unacked.end()) {
+              handing_off.erase(hit->second.peer);
+              handoff_unacked.erase(hit);
+              metrics_count("manager.handoffs_acked");
+              metrics_gauge("manager.fed_pending_handoffs",
+                            static_cast<double>(handoff_unacked.size()));
+            }
           } else if (d["status"].as_str() == "done") {
             // same multiplexed-client accommodation as the heartbeat
             // path: an explicit payload peer_id outranks the frame from
             const std::string peer =
                 d.has("peer_id") ? d["peer_id"].as_str() : m.from;
             const long long tid = d["task_id"].as_int();
+            if (fed_on) {
+              // ownership (ISSUE 14): every region manager hears
+              // "mapd", so only the region whose LEDGER knows the task
+              // may count a done — anything else either acks without
+              // counting (we track the reporter: quiet its retransmit;
+              // the region of record dedups and counts) or ignores the
+              // frame outright.  An agent mid-handoff keeps
+              // retransmitting until the new owner applies the record
+              // and answers — the retransmit heals the limbo window.
+              // owner-first short-circuit: the common case is the
+              // reporter's own region hearing its own done — one map
+              // lookup.  The linear fallbacks below run only on
+              // foreign frames and are bounded by max_agents (500) and
+              // the pending deque; fine at done rates, and an
+              // inflight-id index is the scaling follow-up if a
+              // many-region profile ever shows them.
+              auto rit = agents.find(peer);
+              bool task_known =
+                  (rit != agents.end() && rit->second.task
+                   && (*rit->second.task)["task_id"].as_int() == tid)
+                  || completed_ids.count(tid) || requeued_ids.count(tid);
+              if (!task_known)
+                for (const auto& q : pending_tasks)
+                  if (q["task_id"].as_int() == tid) {
+                    task_known = true;
+                    break;
+                  }
+              if (!task_known)
+                for (const auto& [ap, aa] : agents)
+                  if (aa.task && (*aa.task)["task_id"].as_int() == tid) {
+                    task_known = true;
+                    break;
+                  }
+              // unknown task: IGNORE outright — even when we track the
+              // reporter.  Acking here would clear the agent's
+              // unacked_done and silence the retransmit that is the
+              // region of record's only heal if ITS copy of the frame
+              // was dropped (per-subscriber slow-consumer eviction);
+              // the owner hears a later retransmit and acks it itself.
+              if (!task_known) return;
+            }
             auto done_tc = tc_parse(d);
             if (done_tc) {
               hops.seen(tid, *done_tc);
@@ -1366,6 +1983,7 @@ int main(int argc, char** argv) {
           if (ev["op"].as_str() == "peer_left") {
             const std::string& peer = ev["peer_id"].as_str();
             known_left.insert(peer);
+            mirrors.erase(peer);  // a dead foreign agent stops mirroring
             auto it = agents.find(peer);
             if (it != agents.end()) {
               // The task restarts from pickup on the next idle agent.
@@ -1387,6 +2005,19 @@ int main(int argc, char** argv) {
       // roll the move-target protection window (last two ticks)
       prev_move_targets = std::move(recent_move_targets);
       recent_move_targets.clear();
+      if (fed_on) {
+        // expire border mirrors whose beacons stopped (the agent left
+        // the strip, died, or crossed in and got adopted)
+        for (auto mit = mirrors.begin(); mit != mirrors.end();) {
+          if (now - mit->second.last_seen > mirror_expire_ms ||
+              agents.count(mit->first) || handing_off.count(mit->first))
+            mit = mirrors.erase(mit);
+          else
+            ++mit;
+        }
+        metrics_gauge("manager.fed_mirrors",
+                      static_cast<double>(mirrors.size()));
+      }
       pickup_transitions();
       if (!agents.empty()) {
         if (solver == "tpu") {
@@ -1425,6 +2056,18 @@ int main(int argc, char** argv) {
     if (audit_on && now - last_audit >= audit_interval_ms) {
       last_audit = now;
       publish_audit_beacon();
+    }
+    if (fed_on && now - last_handoff_retry >= handoff_retry_ms) {
+      // retransmit-until-ack: a lost handoff (or lost ack) heals here;
+      // the receiver's dedup guard makes the replay harmless
+      last_handoff_retry = now;
+      for (auto& [key, out] : handoff_unacked) {
+        if (now - out.last_send_ms >= handoff_retry_ms) {
+          bus.publish(FedMap::fed_topic(out.dst), out.frame);
+          out.last_send_ms = now;
+          metrics_count("manager.handoff_retransmits");
+        }
+      }
     }
     if (now - last_cleanup > cleanup_ms) {
       last_cleanup = now;

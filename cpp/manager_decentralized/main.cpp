@@ -65,6 +65,21 @@ int main(int argc, char** argv) {
   const uint64_t seed = static_cast<uint64_t>(knobs.get_int(
       "--seed", "MAPD_SEED",
       static_cast<int64_t>(std::random_device{}())));
+  // federation-lite (ISSUE 14): a decentralized manager in a federated
+  // world samples its task PICKUPS inside its own region (deliveries
+  // stay global), so several of them can co-serve one world without a
+  // shared sampler.  The full handoff protocol lives on the centralized
+  // serving path (manager_centralized) — decentralized agents carry
+  // their own task state peer-to-peer and need no lane transfer.
+  const std::string regions_spec =
+      knobs.get_str("--regions", "JG_REGIONS", "1");
+  const int region_id = static_cast<int>(
+      knobs.get_int("--region-id", "JG_REGION_ID", 0));
+  // audit-pairing namespace (ISSUE 14): per-region label without bus
+  // namespacing; defaults to the tenant ns
+  const char* dns_env = getenv("JG_BUS_NS");
+  const std::string audit_ns = knobs.get_str(
+      "--audit-ns", "JG_AUDIT_NS", (dns_env && *dns_env) ? dns_env : "");
   // RuntimeConfig knobs, reference-parity defaults (core/config.py).
   const int64_t cleanup_ms =
       knobs.get_int("--cleanup-interval-ms", "MAPD_CLEANUP_INTERVAL_MS",
@@ -186,13 +201,48 @@ int main(int argc, char** argv) {
   std::map<long long, std::pair<std::string, int64_t>> holder_claim;
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
-  uint64_t next_task_id = 1;
+  // federation-lite pickup sampling + region-strided ids (ISSUE 14):
+  // co-serving managers must mint task ids from disjoint residue
+  // classes — colliding ids poison every task-id-keyed dedup (see
+  // manager_centralized)
+  FedMap fed = FedMap::parse(regions_spec);
+  if (!fed.valid()) {
+    fprintf(stderr, "bad --regions spec %s (want N or CxR)\n",
+            regions_spec.c_str());
+    return 2;
+  }
+  if (fed.total() > 1 && (region_id < 0 || region_id >= fed.total())) {
+    // an out-of-range id would silently collide task-id residue
+    // classes with a real region's manager — fail at startup like the
+    // centralized manager does
+    fprintf(stderr, "--region-id %d out of range for %s\n", region_id,
+            regions_spec.c_str());
+    return 2;
+  }
+  const uint64_t task_id_stride = fed.total() > 1 ? fed.total() : 1;
+  uint64_t next_task_id = fed.total() > 1 ? 1 + region_id : 1;
   // per-task wire-hop ledger (common/events.hpp: send advances, receive
   // max-merges, bounded by oldest-id eviction)
   TaskHopLedger hops(trace_epoch);
 
   auto free_cells = grid.free_cells();
   auto gen_point = [&]() { return free_cells[rng() % free_cells.size()]; };
+  // federation-lite pickup sampling (see the --regions knob above)
+  std::vector<Cell> rect_free;
+  if (fed.total() > 1) {
+    const FedRect r = fed.rect_of(grid.width, grid.height, region_id);
+    for (Cell c : free_cells) {
+      const int x = grid.x_of(c), y = grid.y_of(c);
+      if (x >= r.x0 && x < r.x1 && y >= r.y0 && y < r.y1)
+        rect_free.push_back(c);
+    }
+    metrics_gauge("manager.region", static_cast<double>(region_id));
+    metrics_gauge("manager.regions", static_cast<double>(fed.total()));
+  }
+  auto gen_pickup = [&]() {
+    return rect_free.empty() ? gen_point()
+                             : rect_free[rng() % rect_free.size()];
+  };
 
   auto dispatch_task = [&](const std::string& peer, Json t) {
     uint64_t id = static_cast<uint64_t>(t["task_id"].as_int());
@@ -221,7 +271,7 @@ int main(int argc, char** argv) {
   };
 
   auto send_task_to = [&](const std::string& peer) {
-    Cell pickup = gen_point(), delivery = gen_point();
+    Cell pickup = gen_pickup(), delivery = gen_point();
     while (delivery == pickup) delivery = gen_point();
     Json t;  // bare Task JSON, the one shared serde struct (ref C10)
     Json pk, dl;
@@ -230,7 +280,8 @@ int main(int argc, char** argv) {
     dl.push_back(Json(grid.x_of(delivery)));
     dl.push_back(Json(grid.y_of(delivery)));
     t.set("pickup", pk).set("delivery", dl).set("peer_id", peer)
-        .set("task_id", next_task_id++);
+        .set("task_id", static_cast<int64_t>(next_task_id));
+    next_task_id += task_id_stride;
     if (tctx) {
       // hop 0 = creation: the trace root (dispatch is hop 1, a breath
       // later — decentralized tasks are born assigned)
@@ -512,13 +563,11 @@ int main(int argc, char** argv) {
     Json buckets;
     buckets.set("pending", static_cast<int64_t>(requeue.size()))
         .set("in_flight", static_cast<int64_t>(inflight.size()));
-    const char* ns_env = getenv("JG_BUS_NS");
     Json b;
     b.set("type", "audit_beacon")
         .set("peer_id", my_id)
         .set("proc", "manager_decentralized")
-        .set("ns", (ns_env && *ns_env) ? std::string(ns_env)
-                                       : std::string())
+        .set("ns", audit_ns)
         .set("ts_ms", unix_ms())
         .set("interval_s", audit_interval_ms / 1000.0)
         .set("caps", caps)

@@ -38,6 +38,19 @@
 //   --audit-decode stdin: one base64 audit1 blob per line
 //                  stdout: {"entries":[[...],...]} per line ("null"
 //                  for undecodable input)
+//   --fedmap       stdin: one JSON per line (ISSUE 14)
+//                  {"spec":"CxR","w":W,"h":H,"x":x,"y":y,
+//                   "margin":m,"border":b,"shards":n}
+//                  stdout: {"region":k,"rect":[x0,y0,x1,y1],
+//                           "escaped":bool,"border":bool,"shard":s,
+//                           "topic":"mapd.fed.k","solver":"solver.rk"}
+//                  — the federated region-ownership canon the Python
+//                  side (runtime/region.py fed_*) asserts rule-identical
+//   --handoff-encode stdin: one JSON per line (ISSUE 14)
+//                  {"seq":N,"src":R,"peer":"id","pos":P,"goal":G,
+//                   "phase":0|1|2,"task":T?,"pickup":PK?,"delivery":D?}
+//                  stdout: one base64 handoff1 packet per line
+//                  (--decode round-trips it like any packed1 kind)
 
 #include <algorithm>
 #include <cstdio>
@@ -45,8 +58,10 @@
 #include <string>
 
 #include "../common/audit.hpp"
+#include "../common/grid.hpp"
 #include "../common/json.hpp"
 #include "../common/plan_codec.hpp"
+#include "../common/region.hpp"
 #include "../common/shardmap.hpp"
 
 using namespace mapd;
@@ -83,11 +98,13 @@ int main(int argc, char** argv) {
   if (mode != "--encode" && mode != "--decode" && mode != "--pos1-encode" &&
       mode != "--pos1-decode" && mode != "--shardmap" &&
       mode != "--world-encode" && mode != "--audit-digest" &&
-      mode != "--audit-encode" && mode != "--audit-decode") {
+      mode != "--audit-encode" && mode != "--audit-decode" &&
+      mode != "--fedmap" && mode != "--handoff-encode") {
     fprintf(stderr,
             "usage: codec_golden --encode|--decode|--pos1-encode|"
             "--pos1-decode|--shardmap|--world-encode|--audit-digest|"
-            "--audit-encode|--audit-decode < lines\n");
+            "--audit-encode|--audit-decode|--fedmap|--handoff-encode"
+            " < lines\n");
     return 2;
   }
   codec::PackedFleetEncoder enc;
@@ -274,6 +291,81 @@ int main(int argc, char** argv) {
       Json out;
       out.set("entries", arr);
       printf("%s\n", out.dump().c_str());
+      continue;
+    }
+    if (mode == "--fedmap") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad fedmap script line\n");
+        return 1;
+      }
+      const Json& j = *parsed;
+      FedMap fm = FedMap::parse(j["spec"].as_str());
+      if (!fm.valid()) {
+        printf("null\n");
+        continue;
+      }
+      const int w = static_cast<int>(j["w"].as_int());
+      const int h = static_cast<int>(j["h"].as_int());
+      const int x = static_cast<int>(j["x"].as_int());
+      const int y = static_cast<int>(j["y"].as_int());
+      const int margin = j.has("margin")
+                             ? static_cast<int>(j["margin"].as_int())
+                             : kDefaultFedHysteresis;
+      const int border = j.has("border")
+                             ? static_cast<int>(j["border"].as_int())
+                             : kDefaultFedBorder;
+      const int shards = j.has("shards")
+                             ? static_cast<int>(j["shards"].as_int())
+                             : 1;
+      const int rid = fm.region_of(w, h, x, y);
+      // the escape/border tests are judged against region 0's rect so
+      // the Python side can sweep cells over a FIXED rectangle
+      FedRect r0 = fm.rect_of(w, h, 0);
+      Json rect;
+      FedRect rr = fm.rect_of(w, h, rid);
+      rect.push_back(Json(static_cast<int64_t>(rr.x0)));
+      rect.push_back(Json(static_cast<int64_t>(rr.y0)));
+      rect.push_back(Json(static_cast<int64_t>(rr.x1)));
+      rect.push_back(Json(static_cast<int64_t>(rr.y1)));
+      Json out;
+      out.set("region", static_cast<int64_t>(rid))
+          .set("rect", rect)
+          .set("escaped", FedMap::escaped(x, y, r0, margin))
+          .set("border", FedMap::in_border(x, y, r0, border))
+          .set("shard", static_cast<int64_t>(rid % std::max(1, shards)))
+          .set("topic", FedMap::fed_topic(rid))
+          .set("solver", fm.solver_topic(rid));
+      printf("%s\n", out.dump().c_str());
+      continue;
+    }
+    if (mode == "--handoff-encode") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad handoff script line\n");
+        return 1;
+      }
+      const Json& j = *parsed;
+      codec::HandoffRec r;
+      r.seq = j["seq"].as_int();
+      r.src_region = static_cast<int32_t>(j["src"].as_int());
+      r.peer = j["peer"].as_str();
+      r.pos = static_cast<int32_t>(j["pos"].as_int());
+      r.goal = static_cast<int32_t>(j["goal"].as_int());
+      r.phase = static_cast<int32_t>(j["phase"].as_int());
+      if (j.has("task")) {
+        r.has_task = true;
+        r.task_id = j["task"].as_int();
+        r.pickup = static_cast<int32_t>(j["pickup"].as_int());
+        r.delivery = static_cast<int32_t>(j["delivery"].as_int());
+      }
+      codec::Packet pkt = codec::encode_handoff(r);
+      codec::TraceCtx tc;
+      if (parse_trace(j, &tc)) {
+        pkt.has_trace = true;
+        pkt.trace = tc;
+      }
+      printf("%s\n", codec::encode_b64(pkt).c_str());
       continue;
     }
     if (mode == "--decode") {

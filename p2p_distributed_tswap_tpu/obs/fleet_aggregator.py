@@ -373,6 +373,32 @@ class FleetAggregator:
                                           key=lambda kv: (len(kv[0]),
                                                           kv[0]))},
             }
+        # federated world regions (ISSUE 14): a region manager's beacon
+        # carries its region id + handoff counters — the REGIONS line's
+        # per-region evidence (ownership, pending/acked handoffs)
+        if gauges.get("manager.regions"):
+            out["federation"] = {
+                "region": int(gauges.get("manager.region") or 0),
+                "regions": int(gauges["manager.regions"]),
+                "handoffs_sent": int(
+                    counter_total(m, "manager.handoffs_sent")),
+                "handoffs_acked": int(
+                    counter_total(m, "manager.handoffs_acked")),
+                "handoffs_received": int(
+                    counter_total(m, "manager.handoffs_received")),
+                "handoffs_dup_dropped": int(
+                    counter_total(m, "manager.handoffs_dup_dropped")),
+                "retransmits": int(
+                    counter_total(m, "manager.handoff_retransmits")),
+                "pending": int(
+                    gauges.get("manager.fed_pending_handoffs") or 0),
+                "mirrors": int(gauges.get("manager.fed_mirrors") or 0),
+            }
+        # solverd's lane-admission attribution (cause=fresh|handoff)
+        admitted = counters_by_label(m, "solverd.lanes_admitted", "cause")
+        if admitted:
+            out["lanes_admitted"] = {k: int(v)
+                                     for k, v in sorted(admitted.items())}
         # world-epoch tracking (ISSUE 10 satellite): any peer carrying a
         # world_seq gauge gains a `world` section — the seq AND the
         # dynamic-world flag, so a toggling fleet with an epoch-unaware
@@ -433,6 +459,53 @@ class FleetAggregator:
         mgr = [p["mgr_tasks"] for p in peers.values() if p["mgr_tasks"]]
         dispatched = sum(t["dispatched"] for t in mgr)
         completed = sum(t["completed"] for t in mgr)
+        # federated regions (ISSUE 14): one row per region manager —
+        # per-region tasks/s + the handoff ledger the REGIONS line shows
+        fed_peers = [(peer, p) for peer, p in peers.items()
+                     if p.get("federation")]
+        # a restarted region manager leaves its dead incarnation's
+        # beacons in the window (marked stale) while the fresh peer
+        # beacons the SAME region id: prefer the live row — a stale one
+        # must never shadow it (and must not inflate the manager count)
+        live_regions = {p["federation"]["region"]
+                        for _, p in fed_peers if not p["stale"]}
+        fed_peers = [(peer, p) for peer, p in fed_peers
+                     if not (p["stale"]
+                             and p["federation"]["region"] in live_regions)]
+        federation = None
+        if fed_peers:
+            per_region = {}
+            for peer, p in fed_peers:
+                f = p["federation"]
+                t = p.get("mgr_tasks") or {}
+                per_region[f"r{f['region']}"] = {
+                    "peer": peer,
+                    "stale": p["stale"],
+                    "tasks_per_s": t.get("tasks_per_s"),
+                    "dispatched": t.get("dispatched"),
+                    "completed": t.get("completed"),
+                    "pending_handoffs": f["pending"],
+                    "handoffs_sent": f["handoffs_sent"],
+                    "handoffs_acked": f["handoffs_acked"],
+                    "handoffs_dup_dropped": f["handoffs_dup_dropped"],
+                    "mirrors": f["mirrors"],
+                }
+            federation = {
+                "regions": max(p["federation"]["regions"]
+                               for _, p in fed_peers),
+                "managers": len(fed_peers),
+                "per_region": dict(sorted(
+                    per_region.items(), key=lambda kv: int(kv[0][1:]))),
+                "handoffs_sent": sum(p["federation"]["handoffs_sent"]
+                                     for _, p in fed_peers),
+                "handoffs_acked": sum(p["federation"]["handoffs_acked"]
+                                      for _, p in fed_peers),
+                "handoffs_dup_dropped": sum(
+                    p["federation"]["handoffs_dup_dropped"]
+                    for _, p in fed_peers),
+                "pending": sum(p["federation"]["pending"]
+                               for _, p in fed_peers),
+            }
         return {
             "ts_ms": now_ms,
             "budget_ms": self.budget_ms,
@@ -441,6 +514,7 @@ class FleetAggregator:
             # must read unknown, never a silent green
             "audit": self.audit.status() if self.audit.beacons else None,
             "replay": self._replay_rollup(now_ms),
+            "federation": federation,
             "peers": peers,
             "fleet": {
                 "peers": len(peers),

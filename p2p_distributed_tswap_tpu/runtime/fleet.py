@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
 from p2p_distributed_tswap_tpu.obs import trace
 from p2p_distributed_tswap_tpu.runtime import buspool
+from p2p_distributed_tswap_tpu.runtime import region as regionlib
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 BUILD_DIR = REPO_ROOT / "cpp" / "build"
@@ -97,8 +98,16 @@ class Fleet:
                  config: Optional[RuntimeConfig] = None,
                  solverd_args: Optional[List[str]] = None,
                  bus_shards: Optional[int] = None,
-                 bus_cpu_affinity: Optional[str] = None):
+                 bus_cpu_affinity: Optional[str] = None,
+                 regions: Optional[str] = None):
         assert mode in ("centralized", "decentralized")
+        # federated world regions (ISSUE 14): a "CxR" spec brings up one
+        # (manager [, solverd]) pair PER REGION on the shared bus pool —
+        # region i's manager owns the i-th rectangle (--region-id), its
+        # plan wire is solver.r<i>, audit pairing ns r<i>.  None/"1"
+        # keeps today's single-pair fleet byte-identical.
+        fed_cols, fed_rows = regionlib.fed_parse_spec(regions)
+        fed_total = fed_cols * fed_rows
         build = ensure_built()
         self.procs: List[subprocess.Popen] = []
         self._names: List[str] = []
@@ -162,26 +171,41 @@ class Fleet:
         penv.update(self.bus_pool.env())
         time.sleep(0.3)
         if mode == "centralized" and solver == "tpu":
-            # --solver=tpu planning happens in the JAX solver daemon
-            sd_proc = spawn("solverd",
-                            [sys.executable, "-m",
-                             "p2p_distributed_tswap_tpu.runtime.solverd",
-                             "--port", str(port), *map_args,
-                             *(solverd_args or [])])
-            # wait for the readiness banner (printed AFTER any --warm
-            # pre-compile) so the manager never opens with a failover
-            # window; a startup death just means the manager plans
-            # natively; without logs fall back to a fixed headroom sleep
-            if self.log_dir:
-                wait_for_log(self.log_dir / "solverd.log", "solverd up",
-                             240, proc=sd_proc)
-            else:
-                time.sleep(8)  # accelerator init headroom
-        mgr_cmd = [str(build / f"mapd_manager_{mode}"), "--port", str(port),
-                   *map_args]
-        if mode == "centralized":
-            mgr_cmd += ["--solver", solver]
-        self.manager = spawn("manager", mgr_cmd, stdin=subprocess.PIPE)
+            # --solver=tpu planning happens in the JAX solver daemon —
+            # one per region in a federated fleet, each on its own
+            # plan-wire topic
+            for rid in range(fed_total):
+                tag = f"_r{rid}" if fed_total > 1 else ""
+                fed_args = regionlib.fed_cli_args(rid, fed_cols, fed_rows,
+                                                  "solverd")
+                sd_proc = spawn(f"solverd{tag}",
+                                [sys.executable, "-m",
+                                 "p2p_distributed_tswap_tpu.runtime"
+                                 ".solverd",
+                                 "--port", str(port), *map_args,
+                                 *fed_args, *(solverd_args or [])])
+                # wait for the readiness banner (printed AFTER any
+                # --warm pre-compile) so the manager never opens with a
+                # failover window; a startup death just means the
+                # manager plans natively; without logs fall back to a
+                # fixed headroom sleep
+                if self.log_dir:
+                    wait_for_log(self.log_dir / f"solverd{tag}.log",
+                                 "solverd up", 240, proc=sd_proc)
+                else:
+                    time.sleep(8)  # accelerator init headroom
+        self.managers: List[subprocess.Popen] = []
+        for rid in range(fed_total):
+            tag = f"_r{rid}" if fed_total > 1 else ""
+            mgr_cmd = [str(build / f"mapd_manager_{mode}"),
+                       "--port", str(port), *map_args]
+            if mode == "centralized":
+                mgr_cmd += ["--solver", solver]
+            mgr_cmd += regionlib.fed_cli_args(rid, fed_cols, fed_rows,
+                                              "manager")
+            self.managers.append(spawn(f"manager{tag}", mgr_cmd,
+                                       stdin=subprocess.PIPE))
+        self.manager = self.managers[0]
         time.sleep(0.3)
         for i in range(1, num_agents + 1):
             spawn(f"agent_{i}",
@@ -195,6 +219,13 @@ class Fleet:
         assert self.manager.stdin is not None
         self.manager.stdin.write((line + "\n").encode())
         self.manager.stdin.flush()
+
+    def command_region(self, rid: int, line: str) -> None:
+        """Send an operator CLI line to region ``rid``'s manager."""
+        mgr = self.managers[rid]
+        assert mgr.stdin is not None
+        mgr.stdin.write((line + "\n").encode())
+        mgr.stdin.flush()
 
     def quit(self, timeout: float = 10.0) -> None:
         try:

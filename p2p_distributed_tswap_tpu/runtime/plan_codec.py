@@ -85,6 +85,21 @@ KIND_RESPONSE = 3
 # stays byte-identical with the switch off).
 KIND_WORLD = 4
 WORLD_CAP = "world1"
+# handoff1 (ISSUE 14): a cross-region agent-lane + task-ledger transfer,
+# riding the packed1 framing unchanged — one agent's full manager-side
+# state (pos, goal, task phase, task endpoints, task id) as three
+# 3-element arrays so every packed1 decoder parses it with ZERO layout
+# changes; the peer id travels in the names blob (named_idx=[0]).
+# seq = the per-(src,dst) handoff chain sequence (ack'd, retransmitted
+# until ack, dedup-guarded on the receiver); base_seq = the SOURCE
+# region id.  Layout:
+#     idx  = [pos, goal, phase]            phase: 0 none, 1 pickup, 2 dlv
+#     pos  = [pickup, delivery, has_task]  -less task: [0, 0, 0]
+#     goal = [task_id_lo, task_id_hi, 0]   id = hi * 32768 + lo (keeps
+#                                          narrow u16 arrays for ids
+#                                          into the hundreds of millions)
+KIND_HANDOFF = 5
+HANDOFF_ID_BASE = 32768
 CODEC_NAME = "packed1"
 SNAPSHOT_EVERY = 64  # periodic resync cadence (packets)
 
@@ -392,6 +407,54 @@ def decode_world(pkt: Packet) -> List[Tuple[int, bool]]:
     if pkt.kind != KIND_WORLD:
         raise CodecError(f"not a world packet (kind {pkt.kind})")
     return [(int(c), bool(b)) for c, b in zip(pkt.idx, pkt.pos)]
+
+
+@dataclass
+class HandoffRec:
+    """One cross-region agent transfer (ISSUE 14): the owning manager's
+    full per-agent state, moved to the neighbor manager as a seq-chained
+    ``handoff1`` record.  ``phase``: 0 = idle, 1 = to-pickup, 2 =
+    to-delivery; a task-less record carries ``task_id=None``."""
+    seq: int
+    src_region: int
+    peer: str
+    pos: int
+    goal: int
+    phase: int = 0
+    task_id: Optional[int] = None
+    pickup: int = 0
+    delivery: int = 0
+
+
+def encode_handoff(rec: HandoffRec,
+                   trace: Optional[TraceCtx] = None) -> Packet:
+    has_task = rec.task_id is not None
+    tid = int(rec.task_id) if has_task else 0
+    if tid < 0:
+        raise CodecError(f"negative task id {tid} in handoff")
+    return Packet(
+        kind=KIND_HANDOFF, seq=rec.seq, base_seq=rec.src_region,
+        idx=_i32([rec.pos, rec.goal, rec.phase]),
+        pos=_i32([rec.pickup if has_task else 0,
+                  rec.delivery if has_task else 0,
+                  1 if has_task else 0]),
+        goal=_i32([tid % HANDOFF_ID_BASE, tid // HANDOFF_ID_BASE, 0]),
+        named_idx=_i32([0]), names=[rec.peer], trace=trace)
+
+
+def decode_handoff(pkt: Packet) -> HandoffRec:
+    if pkt.kind != KIND_HANDOFF:
+        raise CodecError(f"not a handoff packet (kind {pkt.kind})")
+    if pkt.idx.size != 3 or pkt.pos.size != 3 or pkt.goal.size != 3 \
+            or len(pkt.names) != 1:
+        raise CodecError("malformed handoff packet arrays")
+    has_task = bool(pkt.pos[2])
+    return HandoffRec(
+        seq=pkt.seq, src_region=int(pkt.base_seq), peer=pkt.names[0],
+        pos=int(pkt.idx[0]), goal=int(pkt.idx[1]), phase=int(pkt.idx[2]),
+        task_id=(int(pkt.goal[1]) * HANDOFF_ID_BASE + int(pkt.goal[0])
+                 if has_task else None),
+        pickup=int(pkt.pos[0]), delivery=int(pkt.pos[1]))
 
 
 def encode_response(seq: int, idx: Sequence[int], next_pos: Sequence[int],

@@ -1404,6 +1404,8 @@ class TickRunner:
                                         int(pkt.idx.size))
                     self.registry.gauge("solverd.last_delta_agents",
                                         int(pkt.idx.size))
+                prev_names = ({n for n in self.packed.names
+                               if n is not None} if pkt.names else None)
                 try:
                     upd = self.packed.apply(pkt)
                 except pcodec.SeqGapError as e:
@@ -1412,6 +1414,20 @@ class TickRunner:
                     trace.instant("solverd.seq_gap", have=e.have_seq,
                                   base=e.base_seq)
                     return False
+                if prev_names is not None:
+                    # lane-admission attribution (ISSUE 14): a newly
+                    # named lane is an admitted agent — cause=handoff
+                    # when the manager flagged it as a cross-region
+                    # transfer, cause=fresh otherwise (snapshots re-
+                    # declare the whole roster; prev_names keeps the
+                    # count to genuine admissions)
+                    handoff_names = set(data.get("handoff_peers") or [])
+                    for n in pkt.names:
+                        if n not in prev_names:
+                            self.registry.count(
+                                "solverd.lanes_admitted",
+                                cause=("handoff" if n in handoff_names
+                                       else "fresh"))
                 self.service.resident_apply(upd)
                 # manager hints (e.g. delivery cells at task assignment):
                 # sweep their fields in the idle window, long before the
@@ -2593,11 +2609,31 @@ def main(argv=None) -> int:
     ap.add_argument("--tenant-idle-ms", type=float, default=2000.0,
                     help="a tenant is eviction-eligible only after this "
                          "long without a plan_request")
+    # Federated world regions (ISSUE 14): each region pair runs its own
+    # plan wire ("solver.r<id>", runtime/region.fed_solver_topic) so N
+    # planning planes share one bus pool without cross-talk; --audit-ns
+    # labels this daemon's audit beacons (e.g. "r0") so the auditor
+    # joins it against ITS region's manager, not a neighbor's.
+    ap.add_argument("--solver-topic",
+                    default=os.environ.get("JG_SOLVER_TOPIC") or "solver",
+                    help="plan-wire bus topic (JG_SOLVER_TOPIC; a "
+                         "federated region pair uses solver.r<id>)")
+    ap.add_argument("--audit-ns",
+                    default=os.environ.get("JG_AUDIT_NS") or "",
+                    help="audit-beacon pairing namespace (JG_AUDIT_NS; "
+                         "federation uses the region label)")
     args = ap.parse_args(argv)
     tenant_list = ([busns.validate(t.strip()) for t in
                     args.tenants.split(",")] if args.tenants is not None
                    else [])
     multi_tenant = bool(tenant_list) or args.multi_tenant
+    solver_topic = args.solver_topic
+    if multi_tenant and solver_topic != "solver":
+        # tenant plan wires are namespaced topics; a custom flat topic
+        # would silently split the plane — fail loudly instead
+        print("❌ --solver-topic is incompatible with multi-tenant mode",
+              file=sys.stderr)
+        return 2
 
     # Mesh spec (ISSUE 13): --mesh wins over JG_SOLVER_MESH; a malformed
     # spec is a startup error, never a silent single-device fallback.
@@ -2658,7 +2694,7 @@ def main(argv=None) -> int:
         if "" not in tenant_list:
             bus.subscribe("solver")  # the un-namespaced default fleet
     else:
-        bus.subscribe("solver")
+        bus.subscribe(solver_topic)
     if obs_audit.enabled():
         # audit plane (ISSUE 10): digest beacons + drill answering ride
         # the raw operator topic.  JG_AUDIT=0 skips the subscription AND
@@ -2749,7 +2785,8 @@ def main(argv=None) -> int:
             lambda: audit_entries(
                 service,
                 runner.packed.last_seq
-                if runner.packed.last_seq is not None else 0))
+                if runner.packed.last_seq is not None else 0),
+            ns=args.audit_ns)
 
     # SIGUSR1 = operator stats dump: signal handlers only flip a flag (the
     # handler can interrupt the plan path mid-tick, where a full dump
@@ -2766,7 +2803,7 @@ def main(argv=None) -> int:
     def answer_stats() -> None:
         # on-demand machine-readable snapshot over the bus (the
         # operator-CLI / harness analog of SIGUSR1)
-        bus.publish("solver", {"type": "stats_response", **runner.stats()})
+        bus.publish(solver_topic, {"type": "stats_response", **runner.stats()})
         trace.flush()
 
     trace.instant("solverd.up", port=args.port, multi_tenant=multi_tenant,
@@ -2816,7 +2853,7 @@ def main(argv=None) -> int:
                 resp = runner.finish(pending, pipelined=True)
                 pending = None
                 if resp is not None:
-                    bus.publish("solver", resp)
+                    bus.publish(solver_topic, resp)
             elif service.field_queue:
                 # idle window between ticks: sweep queued/prefetched goal
                 # fields OFF the tick path (deferred field repair)
@@ -2831,7 +2868,7 @@ def main(argv=None) -> int:
         if data.get("type") == "flight_dump":
             # black-box query: dump the ring and answer with the path
             path = flightrec.dump(reason="bus_request")
-            bus.publish("solver", {
+            bus.publish(solver_topic, {
                 "type": "flight_dump_response", "proc": "solverd",
                 "peer_id": "solverd", "path": path,
                 "events": len(flightrec.get_recorder())})
@@ -2887,7 +2924,7 @@ def main(argv=None) -> int:
         ok = runner.ingest(reqs[-1])
         if runner.snapshot_needed:
             runner.snapshot_needed = False
-            bus.publish("solver", {
+            bus.publish(solver_topic, {
                 "type": "plan_snapshot_request",
                 "have_seq": (runner.packed.last_seq
                              if runner.packed.last_seq is not None else -1)})
@@ -2906,7 +2943,7 @@ def main(argv=None) -> int:
             # this fetch+encode+publish of response k are the overlap
             resp = runner.finish(pending, pipelined=True)
             if resp is not None:
-                bus.publish("solver", resp)
+                bus.publish(solver_topic, resp)
         pending = nxt_pending
 
 

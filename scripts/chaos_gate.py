@@ -162,6 +162,31 @@ class ManagerSigstop(Fault):
         ctx.note(f"SIGCONTed manager at t={self.recovered_at}s")
 
 
+class ManagerHandoffKill(Fault):
+    """ISSUE 14: SIGKILL region 1's manager mid-window on a FEDERATED
+    (2x1) replay — by then agents are crossing the border, so the kill
+    lands mid-handoff-traffic.  The contract: the auditor must DETECT
+    the silent manager, the surviving neighbor must NOT double-dispatch
+    (no uncaptured completion, no ledger overcount — the handoff dedup
+    guard under fire), and the handoff protocol must actually have been
+    exercised (handoffs_sent >= 1).  Tasks whose region of record died
+    MAY strand — reviving a manager's ledger is control-plane HA
+    (ROADMAP item 1), not federation."""
+
+    kind = "manager_handoff_kill"
+    needs_regions = "2x1"
+    extra_drain_s = 25.0
+
+    def fire(self, ctx) -> None:
+        victim = ctx.managers[1]
+        victim.send_signal(signal.SIGKILL)
+        try:
+            victim.wait(timeout=10)
+        except Exception:
+            pass
+        ctx.note(f"SIGKILLed region-1 manager at t={self.fired_at}s")
+
+
 class PeerPartition(Fault):
     kind = "peer_partition"
     needs_shards = 2
@@ -186,7 +211,8 @@ class PeerPartition(Fault):
 
 
 FAULT_KINDS = ("clean", "bus_shard_kill", "solverd_sigkill",
-               "manager_sigstop", "peer_partition")
+               "manager_sigstop", "peer_partition",
+               "manager_handoff_kill")
 
 
 def build_fault(kind: str, capture: dict) -> Fault:
@@ -204,6 +230,8 @@ def build_fault(kind: str, capture: dict) -> Fault:
         return ManagerSigstop(at_s=mid)
     if kind == "peer_partition":
         return PeerPartition(at_s=mid)
+    if kind == "manager_handoff_kill":
+        return ManagerHandoffKill(at_s=mid)
     raise SystemExit(f"unknown fault {kind!r} (one of {FAULT_KINDS})")
 
 
@@ -248,6 +276,8 @@ def classify(kind: str, res: dict) -> dict:
     ledger is intact, required detection fired and NAMED the faulted
     role (localization), no RED divergence is still active at the final
     watermark (reconvergence), and the SLO engine passes."""
+    if kind == "manager_handoff_kill":
+        return classify_handoff_kill(res)
     reasons = []
     confirmed = res["audit"]["confirmed"]
     red_confirmed = [d for d in confirmed
@@ -297,6 +327,63 @@ def classify(kind: str, res: dict) -> dict:
             "detected": detected, "localized": localized,
             "confirmed_divergences": confirmed,
             "slo": {"ok": slo["ok"], "failed": slo["failed"]},
+            "reasons": reasons}
+
+
+def classify_handoff_kill(res: dict) -> dict:
+    """The federated-kill verdict (ISSUE 14): a dead region manager may
+    strand ITS OPEN tasks (reviving a ledger is ROADMAP item 1's HA, not
+    federation) — so the red lines here are DUPLICATION and blindness,
+    not completeness:
+
+    - the auditor must confirm a silent MANAGER episode (detection +
+      localization; the dead peer never heals, so that record staying
+      active at the final watermark is the expected end state);
+    - the surviving neighbor must not double-dispatch: no uncaptured id
+      completes, the dedup-guarded ledger never overcounts;
+    - the handoff protocol must actually have been exercised
+      (handoffs_sent >= 1 — a kill before any border crossing tests
+      nothing) and the surviving region must still complete tasks."""
+    reasons = []
+    confirmed = res["audit"]["confirmed"]
+    overcount = max(0, res.get("mgr_completed", 0) - res["expected"])
+    fed = res.get("federation") or {}
+    if res["extra_done"]:
+        reasons.append(f"uncaptured task id(s) completed: "
+                       f"{res['extra_done'][:8]}")
+    if overcount:
+        reasons.append(f"manager ledger double-counted {overcount} "
+                       "completion(s)")
+    if not fed.get("handoffs_sent"):
+        reasons.append("no handoff ever fired — the kill tested nothing")
+    if res["completed"] < 1:
+        reasons.append("the surviving region completed no task at all")
+    silent_mgr = [d for d in confirmed if d["class"] == "silent"
+                  and _proc_of(res, d.get("peer_a") or "").startswith(
+                      "manager")]
+    detected = bool(silent_mgr)
+    if not detected:
+        reasons.append("auditor never confirmed a silent manager "
+                       "episode — the dead region went undetected")
+    # reconvergence judged on every OTHER divergence: the killed
+    # manager's own silence is the detection, not a failure to heal
+    other_red = [d for d in res["audit"]["active"]
+                 if d["class"] in au.RED_CLASSES
+                 and not (d["class"] == "silent"
+                          and _proc_of(res, d.get("peer_a") or ""
+                                       ).startswith("manager"))]
+    if other_red:
+        reasons.append("RED divergence beyond the killed manager still "
+                       f"active at the final watermark: {other_red}")
+    return {"fault": "manager_handoff_kill",
+            "verdict": "green" if not reasons else "red",
+            "outcome_ok": not res["extra_done"] and not overcount,
+            "healed": not other_red,
+            "detected": detected, "localized": detected,
+            "handoffs_sent": fed.get("handoffs_sent"),
+            "handoffs_dup_dropped": fed.get("handoffs_dup_dropped"),
+            "confirmed_divergences": confirmed,
+            "slo": {"ok": not reasons, "failed": []},
             "reasons": reasons}
 
 
@@ -350,14 +437,16 @@ def run_matrix(capture: dict, faults, log_dir, no_trace: bool,
             solver = "tpu"
         shards = max(int(capture["fleet"].get("shards") or 1),
                      fault.needs_shards)
+        regions = getattr(fault, "needs_regions", None)
         print(f"chaos_gate: [{i + 1}/{len(faults)}] fault={kind} "
-              f"solver={solver} shards={shards}", flush=True)
+              f"solver={solver} shards={shards}"
+              + (f" regions={regions}" if regions else ""), flush=True)
         t0 = time.monotonic()
         res = fleetsim.run_replay(
             capture, log_dir, solver=solver, shards=shards,
             no_trace=no_trace, drain_s=drain_s,
             chaos=None if kind == "clean" else fault,
-            label=f"{i}_{kind}")
+            label=f"{i}_{kind}", regions=regions)
         verdict = classify(kind, res)
         verdict["fault_detail"] = fault.summary()
         verdict["elapsed_s"] = round(time.monotonic() - t0, 1)
@@ -366,7 +455,7 @@ def run_matrix(capture: dict, faults, log_dir, no_trace: bool,
                               "extra_done", "done_dups",
                               "mgr_completed", "window_tasks_per_s",
                               "drift", "wall_s", "digests",
-                              "chaos_notes")}
+                              "federation", "chaos_notes")}
         rows.append((verdict, res))
         print(f"chaos_gate: {kind} -> {verdict['verdict'].upper()}"
               + (f" ({'; '.join(verdict['reasons'])})"
@@ -455,7 +544,11 @@ def main(argv=None) -> int:
 
     faults = [f.strip() for f in args.faults.split(",") if f.strip()]
     if args.ci:
-        faults = ["clean", "clean", "solverd_sigkill"]
+        # the CI matrix (ISSUE 11 + ISSUE 14): determinism pair, the
+        # solverd kill that MUST be detected, and the federated
+        # manager kill that must neither go blind nor double-dispatch
+        faults = ["clean", "clean", "solverd_sigkill",
+                  "manager_handoff_kill"]
     elif args.determinism:
         faults = ["clean"] + faults
 
@@ -497,6 +590,9 @@ def main(argv=None) -> int:
     if args.ci:
         kill = next(v for v, _ in rows if v["fault"] == "solverd_sigkill")
         ok = ok and kill["detected"] and kill["localized"]
+        hk = next(v for v, _ in rows
+                  if v["fault"] == "manager_handoff_kill")
+        ok = ok and hk["detected"] and bool(hk.get("handoffs_sent"))
     print(json.dumps({"faults": faults,
                       "verdicts": {v["fault"]: v["verdict"]
                                    for v, _ in rows},

@@ -55,6 +55,9 @@ python -m compileall -q -f \
     scripts/bus_smoke.py \
     scripts/trace_smoke.py \
     scripts/field_fuzz.py \
+    scripts/federation_smoke.py \
+    p2p_distributed_tswap_tpu/runtime/fleet.py \
+    p2p_distributed_tswap_tpu/runtime/plan_codec.py \
     bench.py
 echo "syntax OK"
 
@@ -215,6 +218,21 @@ PY
          "detected + localized, unknown version rejected)"
 else
     echo "replay + chaos gate SKIPPED (no C++ toolchain / binaries)"
+fi
+
+echo "== federation smoke =="
+# ISSUE 14: a live 2x1 federated world — two (manager, solverd-less)
+# region pairs on one bus, explicit world-spanning tasks (half cross
+# the border), every task must complete EXACTLY once, the handoff
+# protocol must fire and ack, and each region's ledger digests must
+# reconcile drained at the final watermark
+if [[ -x cpp/build/mapd_bus && -x cpp/build/mapd_manager_centralized ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    JAX_PLATFORMS=cpu python scripts/federation_smoke.py \
+        --log-dir /tmp/jg_federation_ci_logs
+else
+    echo "federation smoke SKIPPED (no C++ toolchain / binaries)"
 fi
 
 echo "== mesh-solverd smoke =="

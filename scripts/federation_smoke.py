@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""Federation smoke (ISSUE 14, scripts/ci.sh): the live 2-region proof.
+
+Brings up a 2x1 federated world — two (manager [, solverd]) pairs on one
+busd pool, one wire-faithful sim fleet spanning both rectangles — and
+drives an EXPLICIT world-spanning task set through it (open-loop
+``taskat``, so the ledger is exact):
+
+- half the tasks live entirely inside one region, half CROSS the border
+  (pickup in region 0, delivery in region 1 and vice versa), so at
+  least one agent is handed off mid-route;
+- every injected task must complete EXACTLY ONCE: the pool's done-id
+  ledger must equal the injected set (zero lost), no uncaptured id may
+  complete and the managers' dedup-guarded completion counters must not
+  exceed the injected count (zero duplicated);
+- the handoff protocol must actually run: handoffs sent >= 1 AND acked
+  >= 1 across the pair (a smoke that never crosses the border proves
+  nothing);
+- per-region ledger digests must reconcile at the drained watermark:
+  each region pair's audit join must be free of RED divergence and both
+  managers' in-flight views must be EMPTY (count 0) — everything that
+  entered a ledger left it through a completion.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/federation_smoke.py
+  python scripts/federation_smoke.py --solver tpu   # per-region solverd
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from p2p_distributed_tswap_tpu.obs import audit as _audit  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import registry as _reg  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import buspool  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import region as regionlib  # noqa: E402,E501
+from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
+    BUILD_DIR, ensure_built, wait_for_log)
+from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool  # noqa: E402,E501
+
+from analysis.fleetsim import MetricsWindow  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--solver", choices=["native", "tpu"],
+                    default="native",
+                    help="native = the fast CI smoke; tpu adds one "
+                         "solverd per region (the full pair "
+                         "architecture)")
+    ap.add_argument("--agents", type=int, default=8)
+    ap.add_argument("--side", type=int, default=20)
+    ap.add_argument("--tasks", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--drain-s", type=float, default=90.0)
+    ap.add_argument("--log-dir", default="/tmp/jg_federation_smoke")
+    args = ap.parse_args(argv)
+
+    ensure_built()
+    cols, rows = 2, 1
+    total = cols * rows
+    side = args.side
+    map_file = f"/tmp/federation_smoke_{side}.map.txt"
+    Path(map_file).write_text("\n".join(["." * side] * side) + "\n")
+    log_dir = Path(args.log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    port = buspool.free_port()
+    saved_env = dict(os.environ)
+    procs, logs = [], []
+
+    def spawn(name, cmd, stdin=None):
+        log = open(log_dir / f"{name}.log", "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, stdin=stdin, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             env=dict(os.environ))
+        procs.append(p)
+        return p
+
+    pool = watch = sim = None
+    _reg.get_registry().clear()
+    try:
+        pool = buspool.BusPool(BUILD_DIR / "mapd_bus", num_shards=1,
+                               home_port=port, spawn=spawn)
+        time.sleep(0.3)
+        os.environ.update(pool.env())
+        # fast audit cadence: per-region digests must land inside the
+        # smoke budget
+        os.environ.setdefault("JG_AUDIT_INTERVAL_MS", "500")
+        os.environ.setdefault("JG_AUDIT_INTERVAL_S", "0.5")
+        if args.solver == "tpu":
+            for rid in range(total):
+                p = spawn(f"solverd_r{rid}",
+                          [sys.executable, "-m",
+                           "p2p_distributed_tswap_tpu.runtime.solverd",
+                           "--port", str(port), "--map", map_file,
+                           "--warm", str(args.agents), "--cpu",
+                           *regionlib.fed_cli_args(rid, cols, rows,
+                                                   "solverd")])
+                if not wait_for_log(log_dir / f"solverd_r{rid}.log",
+                                    "solverd up", 900, proc=p):
+                    raise RuntimeError(f"solverd_r{rid} never ready")
+        mgrs = []
+        for rid in range(total):
+            mgrs.append(spawn(
+                f"manager_r{rid}",
+                [str(BUILD_DIR / "mapd_manager_centralized"),
+                 "--port", str(port), "--map", map_file,
+                 "--solver", "cpu" if args.solver == "native" else "tpu",
+                 "--planning-interval-ms", "150",
+                 *regionlib.fed_cli_args(rid, cols, rows, "manager"),
+                 "--seed", str(args.seed + rid),
+                 "--open-loop"],
+                stdin=subprocess.PIPE))
+        time.sleep(0.6)
+        sim = SimAgentPool(args.agents, side, port=port, seed=args.seed,
+                           heartbeat_s=1.0)
+        watch = MetricsWindow(port, audit=True)
+        sim.heartbeat_all()
+        sim.pump(2.0)
+        watch.pump(0.5)
+
+        # world-spanning task set: explicit endpoints, explicit ids —
+        # half CROSS-REGION (both directions: the handoff path must
+        # carry real ledger entries over the border both ways), half
+        # IN-REGION (a purely local task must also complete exactly
+        # once while federation machinery runs around it)
+        tasks = []
+        for k in range(args.tasks):
+            tid = 1000 + k
+            if k % 4 == 0:    # cross: r0 pickup -> r1 delivery
+                px, py = 2 + (k % 3), 2 + k % (side - 4)
+                dx, dy = side - 3, 2 + (k * 3) % (side - 4)
+            elif k % 4 == 2:  # cross the other way
+                px, py = side - 3 - (k % 3), 1 + k % (side - 4)
+                dx, dy = 1 + (k % 3), side - 3 - k % (side - 4)
+            elif k % 4 == 1:  # in-region, region 0
+                px, py = 1 + (k % 3), 2 + k % (side - 4)
+                dx, dy = 4, side - 3 - k % (side - 4)
+            else:             # in-region, region 1
+                px, py = side - 2 - (k % 3), 2 + k % (side - 4)
+                dx, dy = side - 5, side - 3 - k % (side - 4)
+            rid = regionlib.fed_region_of(px, py, cols, rows, side, side)
+            tasks.append((tid, rid, px, py, dx, dy))
+        expected = {t[0] for t in tasks}
+        cross = sum(1 for t in tasks
+                    if regionlib.fed_region_of(t[2], t[3], cols, rows,
+                                               side, side)
+                    != regionlib.fed_region_of(t[4], t[5], cols, rows,
+                                               side, side))
+        for tid, rid, px, py, dx, dy in tasks:
+            mgrs[rid].stdin.write(
+                f"taskat {px} {py} {dx} {dy} {tid}\n".encode())
+            mgrs[rid].stdin.flush()
+            sim.pump(0.3)
+            watch.pump(0.05)
+
+        deadline = time.monotonic() + args.drain_s
+        last_eval = 0.0
+        while time.monotonic() < deadline \
+                and not expected <= sim.done_ids:
+            sim.pump(0.3)
+            watch.pump(0.05)
+            if time.monotonic() - last_eval >= 0.5:
+                last_eval = time.monotonic()
+                watch.agg.audit.evaluate()
+        # final watermark: let every role beacon its drained digests
+        end = time.monotonic() + 2.5
+        while time.monotonic() < end:
+            sim.pump(0.2)
+            watch.pump(0.1)
+            watch.agg.audit.evaluate()
+        watch.pump(1.0)
+
+        rollup = watch.agg.rollup()
+        fed = rollup.get("federation") or {}
+        mgr_proc = "manager_centralized"
+        mgr_completed = int(watch.delta(mgr_proc,
+                                        "manager.tasks_completed"))
+        handoffs_sent = int(watch.delta(mgr_proc,
+                                        "manager.handoffs_sent"))
+        handoffs_acked = int(watch.delta(mgr_proc,
+                                         "manager.handoffs_acked"))
+        missing = sorted(expected - sim.done_ids)
+        extra = sorted(sim.done_ids - expected)
+        # per-region ledger reconciliation at the drained watermark:
+        # every region manager's newest VIEW digest must count 0
+        # in-flight tasks, and the audit joiner must hold no RED
+        views = {}
+        for name, st in watch.agg.audit._peers.items():
+            if not st.proc.startswith("manager"):
+                continue
+            e = st.latest.get(_audit.SEC_VIEW)
+            if e is not None:
+                views[f"{st.ns or name}"] = {
+                    "digest": _audit.digest_hex(e.digest),
+                    "inflight": e.count}
+        red = [d for d in watch.agg.audit.active()
+               if d["class"] in _audit.RED_CLASSES]
+        views_drained = bool(views) and all(
+            v["inflight"] == 0 for v in views.values())
+        # with per-region solverd pairs, the daemons must have admitted
+        # handed-off lanes through the re-admission path (the
+        # cause=handoff attribution the managers flag on plan_request)
+        lanes_admitted = {}
+        for peer, p in rollup["peers"].items():
+            for cause, v in (p.get("lanes_admitted") or {}).items():
+                lanes_admitted[cause] = lanes_admitted.get(cause, 0) + v
+        solverd_ok = (args.solver != "tpu"
+                      or lanes_admitted.get("handoff", 0) >= 1)
+
+        ok = (not missing and not extra
+              and mgr_completed <= len(expected)
+              and handoffs_sent >= 1 and handoffs_acked >= 1
+              and 1 <= cross < len(expected)  # mixed: both task kinds ran
+              and not red and views_drained
+              and solverd_ok)
+        print("federation smoke: " + json.dumps({
+            "injected": len(expected),
+            "cross_region_tasks": cross,
+            "completed": len(sim.done_ids & expected),
+            "missing": missing,
+            "extra_done": extra,
+            "done_dups": sim.done_dups,
+            "mgr_completed": mgr_completed,
+            "handoffs_sent": handoffs_sent,
+            "handoffs_acked": handoffs_acked,
+            "per_region": fed.get("per_region"),
+            "region_views": views,
+            "views_drained": views_drained,
+            "lanes_admitted": lanes_admitted or None,
+            "active_red": red,
+            "ok": ok}), flush=True)
+        if not ok:
+            print("federation smoke FAILED", file=sys.stderr)
+        return 0 if ok else 1
+    finally:
+        for obj in (sim, watch):
+            if obj is not None:
+                obj.close()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if pool is not None:
+            pool.close()
+        for log in logs:
+            log.close()
+        os.environ.clear()
+        os.environ.update(saved_env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

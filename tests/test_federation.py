@@ -1,0 +1,768 @@
+"""Federated world regions (ISSUE 14): ownership canon, handoff1 codec,
+py≡cpp goldens, observability surfaces, chaos classifier, and the live
+handoff protocol edges (ack-lost retransmit + dedup, border ping-pong
+hysteresis, cross-region task endpoints, region-manager restart).
+
+Unit layers run pure-Python; the golden tests build codec_golden; the
+protocol-edge tests spawn busd + ONE real federated manager and play the
+neighbor region (and the agent) from the test over the real wire — the
+heaviest e2e (restart mid-handoff, full live smoke) are marked slow or
+run through scripts/federation_smoke.py.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+from p2p_distributed_tswap_tpu.runtime import region as rg
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# ownership canon
+# ---------------------------------------------------------------------------
+
+def test_fed_spec_parsing_edges():
+    assert rg.fed_parse_spec(None) == (1, 1)
+    assert rg.fed_parse_spec("") == (1, 1)
+    assert rg.fed_parse_spec("1") == (1, 1)
+    assert rg.fed_parse_spec("1x1") == (1, 1)
+    assert rg.fed_parse_spec("4") == (4, 1)
+    assert rg.fed_parse_spec("2x3") == (2, 3)
+    assert rg.fed_parse_spec("2X3") == (2, 3)
+    for bad in ("x", "2x", "x2", "0x2", "2x0", "-1", "a", "2x2x2", "1.5"):
+        with pytest.raises(ValueError):
+            rg.fed_parse_spec(bad)
+
+
+@pytest.mark.parametrize("cols,rows,w,h", [
+    (2, 1, 14, 14), (2, 2, 96, 96), (3, 2, 20, 17), (4, 1, 10, 10),
+])
+def test_fed_partition_covers_world(cols, rows, w, h):
+    """Every cell is owned by exactly one region, and that region's
+    rectangle contains it; rectangles tile the grid exactly."""
+    area = 0
+    for rid in range(cols * rows):
+        x0, y0, x1, y1 = rg.fed_rect(rid, cols, rows, w, h)
+        assert 0 <= x0 < x1 <= w and 0 <= y0 < y1 <= h
+        area += (x1 - x0) * (y1 - y0)
+    assert area == w * h
+    for y in range(h):
+        for x in range(w):
+            rid = rg.fed_region_of(x, y, cols, rows, w, h)
+            x0, y0, x1, y1 = rg.fed_rect(rid, cols, rows, w, h)
+            assert x0 <= x < x1 and y0 <= y < y1
+
+
+def test_fed_hysteresis_ping_pong_guard():
+    """An agent oscillating across the border within the margin NEVER
+    escapes its owner — only a position more than ``margin`` cells
+    outside the rect on some axis triggers a handoff."""
+    rect = rg.fed_rect(0, 2, 1, 20, 20)  # (0, 0, 10, 20)
+    assert rect == (0, 0, 10, 20)
+    margin = 2
+    # the ping-pong band: last owned column (9), then margin cells
+    # across the line (10, 11) — none of them escape
+    for x in (9, 10, 11):
+        assert not rg.fed_escaped(x, 5, rect, margin), x
+    assert rg.fed_escaped(12, 5, rect, margin)  # margin+1 across
+    assert rg.fed_escaped(9, 23, rect, margin)  # off the bottom
+    # margin 0 = no hysteresis: the first foreign cell escapes
+    assert rg.fed_escaped(10, 5, rect, 0)
+    assert not rg.fed_escaped(9, 5, rect, 0)
+
+
+def test_fed_border_strip():
+    rect = (0, 0, 10, 20)
+    border = 2
+    # inside: owned, never mirrored
+    assert not rg.fed_in_border(9, 5, rect, border)
+    # the strip: outside but within `border` cells
+    assert rg.fed_in_border(10, 5, rect, border)
+    assert rg.fed_in_border(11, 5, rect, border)
+    # beyond it: not ours to mirror
+    assert not rg.fed_in_border(12, 5, rect, border)
+    # diagonal corner: both axes must be within the band
+    assert rg.fed_in_border(11, 21, rect, border)
+    assert not rg.fed_in_border(11, 23, rect, border)
+
+
+def test_fed_assignment_deterministic():
+    a = rg.fed_assignment(3, 2, 2, 3)
+    assert a == {"region": 3, "manager": 3, "solverd": 3, "bus_shard": 0,
+                 "handoff_topic": "mapd.fed.3",
+                 "solver_topic": "solver.r3"}
+    assert rg.fed_assignment(1, 2, 1, 2)["bus_shard"] == 1
+    # single-region world keeps the legacy plan topic
+    assert rg.fed_solver_topic(0, 1) == "solver"
+    with pytest.raises(ValueError):
+        rg.fed_assignment(4, 2, 2, 1)
+    with pytest.raises(ValueError):
+        rg.fed_assignment(-1, 2, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# handoff1 codec
+# ---------------------------------------------------------------------------
+
+def test_handoff_round_trip_with_task():
+    r = pc.HandoffRec(seq=7, src_region=2, peer="12D3KooWabc", pos=45,
+                      goal=99, phase=2, task_id=7 * pc.HANDOFF_ID_BASE + 13,
+                      pickup=12, delivery=99)
+    out = pc.decode_handoff(pc.decode(pc.encode(pc.encode_handoff(r))))
+    assert out == r
+
+
+def test_handoff_round_trip_taskless_and_narrow():
+    r = pc.HandoffRec(seq=1, src_region=0, peer="p", pos=5, goal=5)
+    raw = pc.encode(pc.encode_handoff(r))
+    out = pc.decode_handoff(pc.decode(raw))
+    assert out.task_id is None and out.phase == 0 and out.peer == "p"
+    # small values stay on the narrow u16 wire: header 40 + 2*9 + names
+    assert len(raw) == 40 + 2 * (3 * 3 + 0 + 1) + 1
+
+
+def test_handoff_malformed_rejected():
+    with pytest.raises(pc.CodecError):
+        pc.decode_handoff(pc.Packet(kind=pc.KIND_RESPONSE, seq=1))
+    bad = pc.encode_handoff(pc.HandoffRec(seq=1, src_region=0, peer="p",
+                                          pos=1, goal=1))
+    bad.idx = bad.idx[:2]  # truncated arrays must raise, not misparse
+    with pytest.raises(pc.CodecError):
+        pc.decode_handoff(bad)
+    with pytest.raises(pc.CodecError):
+        pc.encode_handoff(pc.HandoffRec(seq=1, src_region=0, peer="p",
+                                        pos=1, goal=1, task_id=-5))
+
+
+# ---------------------------------------------------------------------------
+# py ≡ cpp goldens (codec_golden --fedmap / --handoff-encode)
+# ---------------------------------------------------------------------------
+
+def _golden():
+    from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu
+
+    binary = build_single_tu("mapd_codec_golden",
+                             "cpp/probes/codec_golden.cpp")
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    return binary
+
+
+def _run_golden(binary, mode, lines):
+    out = subprocess.run([str(binary), mode], input="\n".join(lines) + "\n",
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    return out.stdout.splitlines()
+
+
+def test_fedmap_golden_vs_cpp():
+    """The native FedMap must be RULE-IDENTICAL to the python canon:
+    region ids, rectangles, hysteresis, border strip, shard assignment
+    and topics over a sweep of cells and specs."""
+    binary = _golden()
+    cases = []
+    for spec, w, h in [("2x1", 14, 14), ("2x2", 96, 96), ("3x2", 20, 17)]:
+        cols, rows = rg.fed_parse_spec(spec)
+        for x in range(0, w, 3):
+            for y in range(0, h, 3):
+                cases.append((spec, cols, rows, w, h, x, y))
+    lines = [json.dumps({"spec": s, "w": w, "h": h, "x": x, "y": y,
+                         "margin": 2, "border": 2, "shards": 3})
+             for s, _, _, w, h, x, y in cases]
+    outs = _run_golden(binary, "--fedmap", lines)
+    assert len(outs) == len(cases)
+    for (spec, cols, rows, w, h, x, y), line in zip(cases, outs):
+        got = json.loads(line)
+        rid = rg.fed_region_of(x, y, cols, rows, w, h)
+        rect0 = rg.fed_rect(0, cols, rows, w, h)
+        assert got["region"] == rid, (spec, x, y)
+        assert tuple(got["rect"]) == rg.fed_rect(rid, cols, rows, w, h)
+        assert got["escaped"] == rg.fed_escaped(x, y, rect0, 2)
+        assert got["border"] == rg.fed_in_border(x, y, rect0, 2)
+        assert got["shard"] == rid % 3
+        assert got["topic"] == rg.fed_topic(rid)
+        assert got["solver"] == rg.fed_solver_topic(rid, cols * rows)
+    # a malformed spec is null on the cpp side, ValueError on ours
+    assert _run_golden(binary, "--fedmap",
+                       [json.dumps({"spec": "bogus", "w": 4, "h": 4,
+                                    "x": 0, "y": 0})]) == ["null"]
+
+
+def test_handoff_golden_vs_cpp():
+    """Byte-identical handoff1 packets from both encoders, and the cpp
+    decoder round-trips ours."""
+    binary = _golden()
+    recs = [
+        pc.HandoffRec(seq=3, src_region=0, peer="12D3KooWtest", pos=45,
+                      goal=99, phase=2, task_id=70001, pickup=12,
+                      delivery=99),
+        pc.HandoffRec(seq=1, src_region=1, peer="p", pos=5, goal=5),
+        pc.HandoffRec(seq=9, src_region=2, peer="q" * 40, pos=70000,
+                      goal=70001, phase=1, task_id=123, pickup=70000,
+                      delivery=3),
+    ]
+    lines = []
+    for r in recs:
+        d = {"seq": r.seq, "src": r.src_region, "peer": r.peer,
+             "pos": r.pos, "goal": r.goal, "phase": r.phase}
+        if r.task_id is not None:
+            d.update(task=r.task_id, pickup=r.pickup, delivery=r.delivery)
+        lines.append(json.dumps(d))
+    outs = _run_golden(binary, "--handoff-encode", lines)
+    py = [pc.encode_b64(pc.encode_handoff(r)) for r in recs]
+    assert outs == py
+    # cpp --decode parses our bytes back to the same arrays
+    decs = _run_golden(binary, "--decode", py)
+    for r, line in zip(recs, decs):
+        got = json.loads(line)
+        assert got["kind"] == pc.KIND_HANDOFF
+        assert got["names"] == [r.peer]
+        assert got["idx"] == [r.pos, r.goal, r.phase]
+
+
+# ---------------------------------------------------------------------------
+# observability: aggregator federation section + REGIONS line
+# ---------------------------------------------------------------------------
+
+def _fed_beacon(peer, region, regions=2, sent=3, acked=3, dup=0,
+                pending=0, completed=5, dispatched=6):
+    return {
+        "type": "metrics_beacon", "peer_id": peer,
+        "proc": "manager_centralized", "pid": 1,
+        "metrics": {
+            "uptime_s": 10.0,
+            "counters": {"manager.handoffs_sent": sent,
+                         "manager.handoffs_acked": acked,
+                         "manager.handoffs_received": 2,
+                         "manager.handoffs_dup_dropped": dup,
+                         "manager.handoff_retransmits": 0,
+                         "manager.tasks_dispatched": dispatched,
+                         "manager.tasks_completed": completed},
+            "gauges": {"manager.region": region,
+                       "manager.regions": regions,
+                       "manager.fed_pending_handoffs": pending,
+                       "manager.fed_mirrors": 1},
+            "hists": {}}}
+
+
+def test_aggregator_federation_section_and_regions_line():
+    """ISSUE 14: region managers' gauges/counters roll up into per-peer
+    federation sections + a fleet-level per-region table, rendered as
+    the REGIONS line; non-federated managers get neither."""
+    from analysis.fleet_top import render
+    from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (
+        FleetAggregator)
+
+    agg = FleetAggregator()
+    agg.ingest(_fed_beacon("mgr-a", 0, sent=3, acked=3), now_ms=1000)
+    agg.ingest(_fed_beacon("mgr-b", 1, sent=4, acked=3, dup=2,
+                           pending=1), now_ms=1000)
+    roll = agg.rollup(now_ms=1000)
+    fa = roll["peers"]["mgr-a"]["federation"]
+    assert fa["region"] == 0 and fa["regions"] == 2
+    assert fa["handoffs_sent"] == 3 and fa["mirrors"] == 1
+    fed = roll["federation"]
+    assert fed["regions"] == 2 and fed["managers"] == 2
+    assert list(fed["per_region"]) == ["r0", "r1"]
+    assert fed["per_region"]["r1"]["pending_handoffs"] == 1
+    assert fed["handoffs_sent"] == 7 and fed["handoffs_dup_dropped"] == 2
+    text = render(roll)
+    assert "REGIONS 2 (2 mgr)" in text
+    assert "r0:" in text and "r1:" in text
+    assert "hs=3/3" in text and "pend=1!" in text and "dup=2" in text
+    # a restarted region manager: the dead incarnation's stale beacon
+    # must neither shadow the live peer's row nor inflate the count
+    agg.ingest(_fed_beacon("mgr-b-dead", 1, sent=99), now_ms=1000)
+    # refresh the LIVE peers at a later clock so only the dead one ages
+    agg.ingest(_fed_beacon("mgr-a", 0, sent=3, acked=3),
+               now_ms=1000 + 60_000)
+    agg.ingest(_fed_beacon("mgr-b", 1, sent=4, acked=3, pending=1),
+               now_ms=1000 + 60_000)
+    roll3 = agg.rollup(now_ms=1000 + 60_000)
+    assert roll3["federation"]["managers"] == 2
+    assert roll3["federation"]["per_region"]["r1"]["peer"] == "mgr-b"
+    # a non-federated manager beacon: no section, no line
+    agg2 = FleetAggregator()
+    b = _fed_beacon("solo", 0)
+    b["metrics"]["gauges"] = {}
+    agg2.ingest(b, now_ms=1000)
+    roll2 = agg2.rollup(now_ms=1000)
+    assert roll2["peers"]["solo"].get("federation") is None
+    assert roll2["federation"] is None
+    assert "REGIONS" not in render(roll2)
+
+
+def test_aggregator_lanes_admitted_by_cause():
+    from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (
+        FleetAggregator)
+
+    agg = FleetAggregator()
+    agg.ingest({
+        "type": "metrics_beacon", "peer_id": "solverd",
+        "proc": "solverd", "pid": 2,
+        "metrics": {"uptime_s": 4.0,
+                    "counters": {
+                        'solverd.lanes_admitted{cause="fresh"}': 6,
+                        'solverd.lanes_admitted{cause="handoff"}': 2},
+                    "gauges": {}, "hists": {}}}, now_ms=1000)
+    roll = agg.rollup(now_ms=1000)
+    assert roll["peers"]["solverd"]["lanes_admitted"] == {
+        "fresh": 6, "handoff": 2}
+
+
+def test_solverd_attributes_handoff_lane_admissions():
+    """TickRunner counts newly named lanes as admissions, attributed by
+    the request's handoff_peers flag; re-declared names (snapshots) are
+    never re-counted."""
+    from p2p_distributed_tswap_tpu.core.grid import Grid
+    from p2p_distributed_tswap_tpu.runtime.solverd import (
+        PlanService, TickRunner)
+
+    grid = Grid.default()
+    runner = TickRunner(PlanService(grid, capacity_min=4), grid)
+    enc = pc.PackedFleetEncoder()
+
+    def req(pkt, seq, handoff=None):
+        d = {"type": "plan_request", "seq": seq, "codec": pc.CODEC_NAME,
+             "caps": [pc.CODEC_NAME], "data": pc.encode_b64(pkt)}
+        if handoff:
+            d["handoff_peers"] = handoff
+        return d
+
+    reg = runner.registry
+
+    def admitted(cause):
+        return reg.counter_value("solverd.lanes_admitted", cause=cause)
+
+    fresh0, hand0 = admitted("fresh"), admitted("handoff")
+    runner.handle(req(enc.encode_tick(1, [("a", 3, 9)]), 1))
+    assert admitted("fresh") == fresh0 + 1
+    # lane b arrives flagged as a cross-region handoff
+    runner.handle(req(enc.encode_tick(2, [("a", 3, 9), ("b", 4, 8)]), 2,
+                      handoff=["b"]))
+    assert admitted("handoff") == hand0 + 1
+    assert admitted("fresh") == fresh0 + 1
+    # a forced snapshot re-declares both names: no new admissions
+    enc.request_snapshot()
+    runner.handle(req(enc.encode_tick(3, [("a", 3, 9), ("b", 4, 8)]), 3))
+    assert admitted("fresh") == fresh0 + 1
+    assert admitted("handoff") == hand0 + 1
+
+
+# ---------------------------------------------------------------------------
+# chaos classifier (manager_handoff_kill)
+# ---------------------------------------------------------------------------
+
+def _kill_res(extra_done=(), overcount=0, handoffs=3, completed=5,
+              silent_proc="manager_centralized"):
+    confirmed = ([{"class": "silent", "ns": "", "peer_a": "mgr-b",
+                   "peer_b": "", "detail": "quiet"}]
+                 if silent_proc else [])
+    return {
+        "expected": 6, "completed": completed,
+        "missing": [5] if completed < 6 else [],
+        "extra_done": list(extra_done),
+        "mgr_completed": (6 + overcount) if overcount else completed,
+        "federation": {"handoffs_sent": handoffs,
+                       "handoffs_dup_dropped": 1},
+        "audit": {"confirmed": confirmed, "active": confirmed,
+                  "epochs": {"mgr-b": {"proc": silent_proc or "x"}}},
+    }
+
+
+def test_chaos_handoff_kill_classifier():
+    sys.path.insert(0, str(ROOT / "scripts"))
+    import chaos_gate
+
+    # green: detection fired, no duplication, handoffs exercised —
+    # the killed region's stranded task is NOT a red line (HA is
+    # ROADMAP item 1), and the dead manager's own silence staying
+    # active is the expected end state
+    v = chaos_gate.classify("manager_handoff_kill", _kill_res())
+    assert v["verdict"] == "green" and v["detected"] and v["localized"]
+    # red: double-dispatch (uncaptured id completed)
+    v = chaos_gate.classify("manager_handoff_kill",
+                            _kill_res(extra_done=[99]))
+    assert v["verdict"] == "red"
+    # red: ledger overcount
+    v = chaos_gate.classify("manager_handoff_kill", _kill_res(overcount=1))
+    assert v["verdict"] == "red"
+    # red: the kill landed before any handoff — it tested nothing
+    v = chaos_gate.classify("manager_handoff_kill", _kill_res(handoffs=0))
+    assert v["verdict"] == "red"
+    # red: the auditor never noticed the dead region
+    res = _kill_res(silent_proc=None)
+    v = chaos_gate.classify("manager_handoff_kill", res)
+    assert v["verdict"] == "red" and v["detected"] is False
+
+
+# ---------------------------------------------------------------------------
+# live protocol edges: one real federated manager + the test as its
+# neighbor region and as the agent (real busd, real wire)
+# ---------------------------------------------------------------------------
+
+TINY20 = "\n".join(["." * 20] * 20) + "\n"
+
+
+@pytest.fixture(scope="module")
+def built():
+    from p2p_distributed_tswap_tpu.runtime.fleet import ensure_built
+
+    ensure_built()
+
+
+class _FedHarness:
+    """busd + ONE federated manager (region 0 of 2x1 on a 20x20 world);
+    the test plays region 1 (subscribes mapd.fed.1) and any agents."""
+
+    def __init__(self, tmp_path, extra_env=None, extra_args=None):
+        from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+        from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+        mapf = tmp_path / "t20.map.txt"
+        mapf.write_text(TINY20)
+        self.port = _free_port()
+        self.bus = subprocess.Popen(
+            [str(Path(BUILD_DIR) / "mapd_bus"), str(self.port)],
+            stdout=subprocess.DEVNULL)
+        time.sleep(0.3)
+        self.log = tmp_path / "mgr_r0.log"
+        self._logf = open(self.log, "w")
+        self.mgr = subprocess.Popen(
+            [str(Path(BUILD_DIR) / "mapd_manager_centralized"),
+             "--port", str(self.port), "--map", str(mapf),
+             "--regions", "2x1", "--region-id", "0",
+             "--planning-interval-ms", "120",
+             "--handoff-retry-ms", "400",
+             "--open-loop", *(extra_args or [])],
+            stdin=subprocess.PIPE, stdout=self._logf,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "JG_AUDIT": "0", **(extra_env or {})})
+        # the test IS region 1 and the agent pool
+        self.cli = BusClient(port=self.port, peer_id="fed-test-peer")
+        self.cli.subscribe("mapd")
+        self.cli.subscribe(rg.fed_topic(1))
+        time.sleep(0.4)
+
+    def beacon(self, peer, x, y, task_id=None):
+        self.cli.publish("mapd", {
+            "type": "position_update", "peer_id": peer,
+            "position": [x, y],
+            **({"busy_task": task_id} if task_id is not None else {})})
+
+    def taskat(self, px, py, dx, dy, tid):
+        self.mgr.stdin.write(
+            f"taskat {px} {py} {dx} {dy} {tid}\n".encode())
+        self.mgr.stdin.flush()
+
+    def drain(self, seconds, want=None):
+        """Collect frames for ``seconds`` (or until ``want(frame)``)."""
+        out = []
+        end = time.monotonic() + seconds
+        while time.monotonic() < end:
+            f = self.cli.recv(timeout=0.2)
+            if f and f.get("op") == "msg":
+                out.append(f)
+                if want is not None and want(f):
+                    break
+        return out
+
+    def log_text(self):
+        self._logf.flush()
+        return self.log.read_text()
+
+    def close(self):
+        for p in (self.mgr, self.bus):
+            p.terminate()
+        for p in (self.mgr, self.bus):
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.cli.close()
+        self._logf.close()
+
+
+def _handoffs(frames):
+    return [f for f in frames if f.get("topic") == rg.fed_topic(1)
+            and (f.get("data") or {}).get("type") == "handoff1"]
+
+
+def test_handoff_ack_lost_retransmit_then_dedup(built, tmp_path):
+    """The full at-least-once/exactly-once pair on the real wire:
+
+    - outbound: region 0's manager hands an escaped agent off; region 1
+      (the test) withholds the ack — the SAME seq must retransmit until
+      acked, then stop;
+    - inbound: region 1 sends one handoff1 record TWICE — the manager
+      must adopt once, ack BOTH (the second ack heals a lost-ack), and
+      count the duplicate as dropped (its log says so)."""
+    h = _FedHarness(tmp_path)
+    try:
+        agent = "12D3KooWfedAgentA"
+        h.beacon(agent, 4, 10)
+        time.sleep(0.5)
+        h.taskat(5, 10, 17, 10, 501)  # delivery deep in region 1
+        time.sleep(0.5)
+        # walk the agent across the border, past the hysteresis margin
+        for x in (8, 10, 12, 14):
+            h.beacon(agent, x, 10, task_id=501)
+            time.sleep(0.15)
+        frames = h.drain(3.0, want=lambda f: len(_handoffs([f])) > 0)
+        first = _handoffs(frames)
+        assert first, "no handoff1 ever arrived at region 1"
+        d0 = first[0]["data"]
+        assert d0["src"] == 0 and d0["dst"] == 1
+        rec = pc.decode_handoff(pc.decode_b64(d0["data"]))
+        assert rec.peer == agent and rec.task_id == 501
+        assert rec.src_region == 0
+        # ack withheld: the same seq must come around again
+        more = h.drain(2.0, want=lambda f: len(_handoffs([f])) > 0)
+        retx = _handoffs(more)
+        assert retx and retx[0]["data"]["seq"] == d0["seq"]
+        # now ack (echoing the sender's incarnation epoch — an ack for
+        # another epoch must NOT cancel the in-flight record):
+        # retransmits stop
+        h.cli.publish(rg.fed_topic(0), {
+            "type": "handoff_ack", "src": 0, "dst": 1,
+            "seq": d0["seq"], "epoch": d0["epoch"], "peer_id": agent})
+        time.sleep(0.8)
+        quiet = _handoffs(h.drain(1.5))
+        assert not quiet, "manager kept retransmitting after the ack"
+
+        # ---- inbound dedup: replay one record twice ----
+        rec_in = pc.HandoffRec(seq=1, src_region=1,
+                               peer="12D3KooWfedAgentB", pos=44,
+                               goal=44, phase=1, task_id=777,
+                               pickup=44, delivery=4)
+        frame = {"type": "handoff1", "src": 1, "dst": 0, "seq": 1,
+                 "peer_id": rec_in.peer,
+                 "data": pc.encode_b64(pc.encode_handoff(rec_in))}
+        acks = []
+
+        def is_ack(f):
+            d = f.get("data") or {}
+            if d.get("type") == "handoff_ack" and d.get("seq") == 1:
+                acks.append(d)
+            return len(acks) >= 1
+
+        h.cli.publish(rg.fed_topic(0), frame)
+        h.drain(3.0, want=is_ack)
+        assert len(acks) == 1, "first handoff never acked"
+        h.cli.publish(rg.fed_topic(0), frame)  # the replay
+
+        def is_ack2(f):
+            d = f.get("data") or {}
+            if d.get("type") == "handoff_ack" and d.get("seq") == 1:
+                acks.append(d)
+            return len(acks) >= 2
+
+        h.drain(3.0, want=is_ack2)
+        assert len(acks) == 2, "replayed handoff must be re-acked"
+        log = h.log_text()
+        assert log.count("adopted 12D3KooWfedAgentB") == 1, log
+        assert "duplicate" in log or "dup" in log.lower() \
+            or log.count("handoff 1 from region 1") == 1
+    finally:
+        h.close()
+
+
+def test_border_ping_pong_never_thrashes_ownership(built, tmp_path):
+    """An agent oscillating one cell across the border (inside the
+    hysteresis margin) stays owned — ZERO handoffs; only a move beyond
+    the margin hands it off, exactly once."""
+    h = _FedHarness(tmp_path)
+    try:
+        agent = "12D3KooWpingPong"
+        # first sighting DEEP inside region 0: immediately claimable
+        # (inside the border band adoption defers to the claim grace —
+        # the double-tracking guard)
+        h.beacon(agent, 5, 5)
+        time.sleep(0.5)
+        # oscillate across the line (border at x=10): 9 <-> 11, all
+        # within margin 2 of region 0's rect
+        for _ in range(4):
+            for x in (9, 11, 10, 9):
+                h.beacon(agent, x, 5)
+                time.sleep(0.08)
+        frames = h.drain(1.5)
+        assert not _handoffs(frames), "ping-pong thrash: handoff fired " \
+            "inside the hysteresis band"
+        assert "🔍 tracking agent" in h.log_text()
+        # now walk decisively into region 1
+        for x in (12, 13, 14):
+            h.beacon(agent, x, 5)
+            time.sleep(0.15)
+        crossed = _handoffs(h.drain(3.0,
+                                    want=lambda f: bool(_handoffs([f]))))
+        assert len(crossed) == 1
+        assert pc.decode_handoff(
+            pc.decode_b64(crossed[0]["data"]["data"])).peer == agent
+    finally:
+        h.close()
+
+
+def test_cross_region_endpoints_live_exact_once(built, tmp_path):
+    """The ISSUE 14 live acceptance at CI scale: a 2-region fleet with
+    world-spanning tasks (pickup and delivery in different regions,
+    agents handed off mid-route) completes EVERY task exactly once,
+    handoffs ack, per-region ledgers reconcile drained — the full
+    assertion set lives in scripts/federation_smoke.py; this test runs
+    it for real."""
+    sys.path.insert(0, str(ROOT / "scripts"))
+    import federation_smoke
+
+    rc = federation_smoke.main([
+        "--agents", "6", "--tasks", "6", "--side", "18",
+        "--drain-s", "75",
+        "--log-dir", str(tmp_path / "fed_smoke_logs")])
+    assert rc == 0
+
+
+def test_regions_off_keeps_wire_free_of_federation(built, tmp_path):
+    """JG_REGIONS unset/1 = kill switch: the manager's byte stream
+    carries NO federation traffic (no mapd.fed subscription, no
+    handoff frames, no region gauges); 2x1 region 0 subscribes its fed
+    topic (same token-pin pattern as the JG_AUDIT/JG_BUS_SHARDS
+    switches)."""
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    mapf = tmp_path / "t20.map.txt"
+    mapf.write_text(TINY20)
+
+    def capture(extra_args, extra_env, seconds=2.0):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        got = []
+
+        def server():
+            conn, _ = srv.accept()
+            conn.sendall(b'{"op":"welcome","peer_id":"x",'
+                         b'"caps":["relay1"]}\n')
+            end = time.monotonic() + seconds
+            buf = b""
+            conn.settimeout(0.25)
+            while time.monotonic() < end:
+                try:
+                    chunk = conn.recv(65536)
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    break
+                buf += chunk
+            got.append(buf)
+            conn.close()
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        mgr = subprocess.Popen(
+            [str(Path(BUILD_DIR) / "mapd_manager_centralized"),
+             "--port", str(port), "--map", str(mapf), *extra_args],
+            stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+            env={**os.environ, "JG_TRACE_CTX": "0", "JG_AUDIT": "0",
+                 **extra_env})
+        try:
+            t.join(timeout=seconds + 15)
+        finally:
+            mgr.terminate()
+            mgr.wait(timeout=10)
+            srv.close()
+        assert got, "manager never connected to the pin socket"
+        return got[0]
+
+    quiet = capture([], {})
+    assert b"mapd.fed" not in quiet and b"handoff" not in quiet \
+        and b"manager.region" not in quiet, quiet[:2000]
+    quiet1 = capture([], {"JG_REGIONS": "1"})
+    assert b"mapd.fed" not in quiet1 and b"handoff" not in quiet1
+    loud = capture(["--regions", "2x1", "--region-id", "0"], {})
+    assert b"mapd.fed.0" in loud  # the fed-topic subscription
+    assert b"manager.region" in loud  # the federation gauges beacon
+
+
+@pytest.mark.slow
+def test_region_manager_restart_mid_handoff_relearns(built, tmp_path):
+    """Kill region 0's manager while a handoff TO it is unacked: the
+    sender keeps retransmitting, the RESTARTED manager (fresh dedup
+    state, fresh encoder) applies the retransmitted record, acks it and
+    carries the task — and a fresh task through the revived region
+    completes exactly once."""
+    h = _FedHarness(tmp_path)
+    try:
+        # an unacked inbound handoff: sent while the manager is ALIVE,
+        # acked once — then the manager dies and revives; the replayed
+        # record must be re-acked (fresh dedup set = at-least-once is
+        # preserved across the restart by sender retransmission)
+        rec_in = pc.HandoffRec(seq=4, src_region=1,
+                               peer="12D3KooWrestart", pos=30, goal=30,
+                               phase=1, task_id=900, pickup=30,
+                               delivery=5)
+        frame = {"type": "handoff1", "src": 1, "dst": 0, "seq": 4,
+                 "peer_id": rec_in.peer,
+                 "data": pc.encode_b64(pc.encode_handoff(rec_in))}
+        h.mgr.kill()
+        h.mgr.wait(timeout=5)
+        # retransmit into the void (the real sender would keep doing
+        # this on its retry timer)
+        h.cli.publish(rg.fed_topic(0), frame)
+        time.sleep(0.3)
+        # revive region 0's manager
+        from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+        mapf = tmp_path / "t20.map.txt"
+        log2 = open(tmp_path / "mgr_r0_revived.log", "w")
+        h.mgr = subprocess.Popen(
+            [str(Path(BUILD_DIR) / "mapd_manager_centralized"),
+             "--port", str(h.port), "--map", str(mapf),
+             "--regions", "2x1", "--region-id", "0",
+             "--planning-interval-ms", "120",
+             "--handoff-retry-ms", "400", "--open-loop"],
+            stdin=subprocess.PIPE, stdout=log2,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "JG_AUDIT": "0"})
+        h._logf.close()
+        h._logf = log2
+        h.log = tmp_path / "mgr_r0_revived.log"
+        time.sleep(0.6)
+        acks = []
+
+        def is_ack(f):
+            d = f.get("data") or {}
+            if d.get("type") == "handoff_ack" and d.get("seq") == 4:
+                acks.append(d)
+            return bool(acks)
+
+        h.cli.publish(rg.fed_topic(0), frame)  # the retry that lands
+        h.drain(4.0, want=is_ack)
+        assert acks, "revived manager never acked the retransmit"
+        assert "adopted 12D3KooWrestart" in h.log_text()
+        # the revived region still serves: dispatch + positional done
+        agent = "12D3KooWrestart"
+        h.beacon(agent, 6, 5, task_id=900)
+        time.sleep(0.3)
+        done = {"status": "done", "task_id": 900, "peer_id": agent}
+        h.cli.publish("mapd", done)
+        got = h.drain(3.0, want=lambda f: (f.get("data") or {}).get(
+            "type") == "done_ack")
+        assert any((f.get("data") or {}).get("type") == "done_ack"
+                   for f in got), "revived manager never acked the done"
+    finally:
+        h.close()

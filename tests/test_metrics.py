@@ -2,6 +2,7 @@
 contract — the REFERENCE's own pandas analysis scripts must consume our CSVs
 unchanged (SURVEY C16)."""
 
+import os
 import subprocess
 import sys
 
@@ -16,6 +17,13 @@ from p2p_distributed_tswap_tpu.metrics.task_metrics import (
 )
 
 REF = "/root/reference"
+# the two reference-consumption tests need the reference checkout's own
+# pandas scripts; environments without it (most CI containers) must
+# SKIP with a visible reason, not fail — the schema itself is locked by
+# the pure-python tests above either way
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(REF),
+    reason=f"reference checkout {REF} not present in this environment")
 
 
 def _collector_with_history():
@@ -79,6 +87,7 @@ def test_network_metrics_counters():
     assert "Messages sent: 2" in str(n)
 
 
+@needs_reference
 def test_reference_analyze_metrics_consumes_our_csv(tmp_path):
     """analyze_metrics.py --all must run cleanly on our task CSV."""
     csv_path = tmp_path / "task_metrics.csv"
@@ -90,6 +99,7 @@ def test_reference_analyze_metrics_consumes_our_csv(tmp_path):
     assert "Success Rate" in out.stdout or "成功率" in out.stdout
 
 
+@needs_reference
 def test_reference_compare_path_metrics_consumes_our_csvs(tmp_path):
     """compare_path_metrics.py must compare our centralized/decentralized
     path CSVs (the decentralized one with timestamp_ms bucketing)."""
