@@ -33,6 +33,7 @@ from p2p_distributed_tswap_tpu.obs.beacon import METRICS_TOPIC  # noqa: E402
 from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (  # noqa: E402
     FleetAggregator,
 )
+from p2p_distributed_tswap_tpu.runtime import ha as _ha  # noqa: E402
 from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402
 
 
@@ -142,6 +143,34 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
             cells.append(cell)
         lines.append(f"REGIONS {fed['regions']} "
                      f"({fed['managers']} mgr) " + " | ".join(cells))
+    # control-plane HA (ISSUE 15): live role census, replica lag, and
+    # the last takeover — the operator's one-line answer to "who is the
+    # system of record right now, and did a failover happen?"
+    ha = rollup.get("ha")
+    if ha:
+        def _names(peers):
+            return ",".join(p[:16] for p in peers) or "-"
+
+        line = (f"HA active={_names(ha['active'])}"
+                f" standby={_names(ha['standby'])}"
+                f" lag={ha['replica_lag']}")
+        if ha.get("takeovers"):
+            line += (f" takeovers={ha['takeovers']}"
+                     f" lease_expiries={ha['lease_expiries']}")
+        if ha.get("demotions"):
+            line += f" demotions={ha['demotions']}"
+        last = ha.get("last_takeover")
+        if last:
+            # the ONE digest-equality rule (runtime/ha.py): a
+            # cold-start takeover shipped no active digests — there is
+            # nothing to compare, which must not render as an alarm
+            eq = _ha.takeover_digests_equal(last)
+            tag = ("n/a" if eq is None
+                   else "EQUAL" if eq else "DIFFER!")
+            line += (f" last={str(last.get('peer_id'))[:16]}"
+                     f"@{last.get('repl_seq')}"
+                     f" digests={tag}")
+        lines.append(line)
     # world-epoch tracking (ISSUE 10 satellite): every peer carrying a
     # world_seq gauge, plus the audit beacons' per-tenant epochs — a
     # dynamic-world-OFF peer in a toggling fleet renders "OFF!", the
@@ -246,7 +275,8 @@ def collect(agg: FleetAggregator, bus: BusClient, duration: float) -> int:
         frame = bus.recv(timeout=min(0.5, remaining))
         if not frame or frame.get("op") != "msg":
             continue
-        if frame.get("topic") not in (METRICS_TOPIC, _audit.AUDIT_TOPIC):
+        if frame.get("topic") not in (METRICS_TOPIC, _audit.AUDIT_TOPIC,
+                                      _ha.HA_TOPIC):
             continue
         if agg.ingest(frame.get("data") or {}):
             n += 1
@@ -295,6 +325,10 @@ def main(argv=None) -> int:
         # the embedded auditor's feed (ISSUE 10); raw — audit beacons
         # ride the un-namespaced operator plane like mapd.metrics
         bus.subscribe(_audit.AUDIT_TOPIC, raw=True)
+    if _ha.enabled():
+        # takeover announcements (ISSUE 15) feed the HA line's
+        # digest-equality tag; subscribed only when the HA plane is on
+        bus.subscribe(_ha.HA_TOPIC, raw=True)
 
     if args.once:
         collect(agg, bus, args.wait)

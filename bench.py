@@ -97,6 +97,13 @@ carries a ``federation`` record — the live 2x1-region smoke
 (manager) pairs, exact-once completion, handoff-protocol sent/acked
 evidence, per-region ledgers drained.
 
+HA axis (ISSUE 15): unless BENCH_HA=0, the headline carries an ``ha``
+record — ledger1 replication cost in-process (record bytes + µs for a
+256-task ledger, snapshot vs steady-state delta) and the live failover
+(scripts/ha_smoke.py): SIGKILL the active mid-flight, takeover latency
+in claim windows, replication stream bytes/s, digest-equal takeover +
+exact-once verdicts.
+
 Replay axis (ISSUE 11): unless BENCH_REPLAY=0, the headline carries a
 ``replay`` record — replay FIDELITY of the committed CI capture
 (results/captures/ci_small.capture.json re-driven open-loop through
@@ -713,6 +720,94 @@ def run_federation_axis() -> dict:
     }
 
 
+def run_ha_axis() -> dict:
+    """Control-plane HA rung (ISSUE 15): ledger1 replication cost
+    in-process (encode+apply µs and record bytes for a 256-task ledger,
+    snapshot vs small-churn delta) plus the LIVE takeover latency —
+    kill the active mid-flight via scripts/ha_smoke.py and measure
+    detect -> ha_takeover in claim windows.  Failures are recorded,
+    never fatal."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from p2p_distributed_tswap_tpu.runtime import ha
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    out: dict = {}
+    root = os.path.dirname(os.path.abspath(__file__))
+    n_tasks = 256
+    reps = 50
+    try:
+        enc = ha.LedgerEncoder(incarnation=12345)
+        rep = ha.LedgerReplica()
+        tasks = [ha.LedgerTask(k + 1, k % 3, k, k + 7,
+                               "" if k % 3 == 0 else f"peer{k:03d}")
+                 for k in range(n_tasks)]
+        snap = enc.encode_tick(1, 0, n_tasks + 1, tasks, {})
+        out["snapshot_bytes"] = len(ha.encode_ledger(snap))
+        rep.apply(snap)
+        # steady-state delta: 4-task churn per beat (one done, one
+        # dispatched, two state moves) — the common replication record
+        t0 = time.perf_counter()
+        seq = 1
+        for r in range(reps):
+            churn = list(tasks)
+            del churn[r % n_tasks]
+            base = (r * 4) % n_tasks
+            for k in (base, (base + 11) % (n_tasks - 1)):
+                t = churn[k]
+                churn[k] = ha.LedgerTask(t.task_id, (t.state + 1) % 3,
+                                         t.pickup, t.delivery, t.peer)
+            churn.append(ha.LedgerTask(n_tasks + 2 + r, 1, 5, 9, "peerX"))
+            rec = enc.encode_tick(seq + 1, 0, n_tasks + 3 + r, churn, {})
+            seq += 1
+            blob = ha.encode_ledger(rec)
+            rep.apply(ha.decode_ledger(blob))
+            if r == 0:
+                out["delta_bytes"] = len(blob)
+        out["delta_us_per_record"] = round(
+            1e6 * (time.perf_counter() - t0) / reps, 1)
+        out["ledger_tasks"] = n_tasks
+        out["replica_divergences"] = rep.divergences
+    except Exception as e:  # pragma: no cover - measurement best-effort
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    if not (BUILD_DIR / "mapd_bus").exists() \
+            and (shutil.which("cmake") is None
+                 or shutil.which("ninja") is None):
+        out["live"] = {"skipped": "C++ runtime unavailable"}
+        return out
+    art = Path(tempfile.mkdtemp(prefix="jg-bench-ha-")) / "ha.json"
+    cmd = [sys.executable, os.path.join(root, "scripts", "ha_smoke.py"),
+           "--out", str(art), "--log-dir", "/tmp/jg_bench_ha_logs"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=420,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        out["live"] = {"error": "ha smoke timeout"}
+        return out
+    if not art.exists():
+        out["live"] = {"error":
+                       (proc.stderr or proc.stdout or "no output")[-300:]}
+        return out
+    rec = json.loads(art.read_text())
+    claim_s = rec.get("claim_window_s") or 5.0
+    lat = rec.get("takeover_latency_s")
+    out["live"] = {
+        "takeover_latency_s": lat,
+        "takeover_claim_windows": (round(lat / claim_s, 2)
+                                   if lat is not None else None),
+        "replication_bytes_per_s": (rec.get("replication")
+                                    or {}).get("bytes_per_s"),
+        "digests_equal": rec.get("digests_equal"),
+        "exact_once_ok": rec.get("ok"),
+    }
+    return out
+
+
 def run_field_engine_axis() -> dict:
     """Field-engine rung for the BENCH trajectory (ISSUE 9): ms/field of
     a full resweep vs the bounded-region incremental repair at CI scale
@@ -1013,6 +1108,10 @@ def main():
         # federation axis (ISSUE 14): 2x1 region pairs, exact-once
         # world-spanning completion + handoff evidence
         head["federation"] = run_federation_axis()
+    if os.environ.get("BENCH_HA", "1") != "0":
+        # HA axis (ISSUE 15): ledger1 replication cost + live takeover
+        # latency in claim windows
+        head["ha"] = run_ha_axis()
     print(json.dumps(head), flush=True)
 
 

@@ -1,0 +1,472 @@
+// Control-plane HA (ISSUE 15) — native mirror of
+// p2p_distributed_tswap_tpu/runtime/ha.py: the ledger1 replication
+// record, active-side delta encoder, standby-side replica, and the
+// lease/election rules.  BYTE-IDENTICAL to the Python side (golden-
+// tested via codec_golden --ledger-encode/--ledger-decode, fuzzed by
+// scripts/codec_fuzz.py) — keep every packing and diff rule in
+// lockstep.
+//
+// ledger1 record (little-endian):
+//   u32 magic "LDG1"  u8 version=1  u8 flags(bit0 snapshot)
+//   u16 reserved=0
+//   u32 n_tasks  u32 n_removed  u32 n_world  u32 n_handoffs
+//   i64 seq  i64 base_seq  i64 incarnation  i64 plan_seq
+//   i64 world_seq  i64 next_task_id
+//   u64 ledger_digest  u64 view_digest     (audit canon, FULL ledger)
+//   per task:    i64 id  u8 state  i32 pickup  i32 delivery
+//                u16 peer_len  u8 peer[]
+//   per removed: i64 id
+//   per world:   i32 cell  u8 blocked
+//   per handoff: i32 dst  i64 seq  i64 epoch  i32 pos  i32 goal
+//                u8 phase  u8 has_task  i64 task_id  i32 pickup
+//                i32 delivery  u16 peer_len  u8 peer[]
+//                (the sender's FULL unacked cross-region handoff
+//                outbox, shipped wholesale — a promoted standby
+//                RESUMES the retransmit-until-ack loop instead of
+//                losing a mid-transfer task)
+//
+// Framing: base64 in the "data" field of a {"type":"ledger1"} frame on
+// raw bus topic "mapd.ha"; liveness rides a separate tiny "ha_lease"
+// frame.  JG_HA unset/0 = nothing published or subscribed (the
+// single-manager wire stays byte-identical).
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <optional>
+#include <set>
+#include <string>
+#include <tuple>
+#include <vector>
+
+#include "audit.hpp"
+
+namespace mapd {
+namespace ha {
+
+constexpr const char* kHaTopic = "mapd.ha";
+constexpr uint32_t kLedgerMagic = 0x3147444C;  // b"LDG1"
+constexpr uint8_t kLedgerVersion = 1;
+constexpr uint8_t kFlagSnapshot = 1;
+constexpr int kSnapshotEvery = 64;
+constexpr int64_t kDefaultLeaseMs = 500;
+
+struct LedgerTask {
+  int64_t task_id = 0;
+  uint8_t state = 0;  // 0 pending, 1 to-pickup, 2 to-delivery
+  int32_t pickup = 0;
+  int32_t delivery = 0;
+  std::string peer;  // assigned agent for in-flight entries, else ""
+
+  bool operator==(const LedgerTask& o) const {
+    return task_id == o.task_id && state == o.state &&
+           pickup == o.pickup && delivery == o.delivery && peer == o.peer;
+  }
+  bool operator!=(const LedgerTask& o) const { return !(*this == o); }
+};
+
+// One UNACKED outbound cross-region handoff (the sender's outbox
+// entry): everything needed to rebuild the exact original handoff1
+// frame — same seq + SENDER epoch, so the receiver's dedup guard
+// keeps working across the takeover.
+struct HandoffOut {
+  int32_t dst = 0;
+  int64_t seq = 0;
+  int64_t epoch = 0;
+  std::string peer;
+  int32_t pos = 0;
+  int32_t goal = 0;
+  uint8_t phase = 0;
+  bool has_task = false;
+  int64_t task_id = 0;
+  int32_t pickup = 0;
+  int32_t delivery = 0;
+
+  bool operator==(const HandoffOut& o) const {
+    return dst == o.dst && seq == o.seq && epoch == o.epoch &&
+           peer == o.peer && pos == o.pos && goal == o.goal &&
+           phase == o.phase && has_task == o.has_task &&
+           task_id == o.task_id && pickup == o.pickup &&
+           delivery == o.delivery;
+  }
+};
+
+struct LedgerRec {
+  int64_t seq = 0;
+  int64_t base_seq = 0;
+  int64_t incarnation = 0;
+  int64_t plan_seq = 0;
+  int64_t world_seq = 0;
+  int64_t next_task_id = 0;
+  bool snapshot = false;
+  std::vector<LedgerTask> tasks;
+  std::vector<int64_t> removed;
+  std::vector<std::pair<int32_t, int>> world;  // (cell, blocked)
+  std::vector<HandoffOut> handoffs;  // full outbox, every record
+  uint64_t ledger_digest = 0;
+  uint64_t view_digest = 0;
+};
+
+// (ledger_digest, view_digest) over a FULL ledger, audit canon
+// (audit.hpp): ledger tuples sorted by (id, state), view = sorted
+// in-flight ids.
+inline std::pair<uint64_t, uint64_t> ledger_view_digests(
+    const std::vector<LedgerTask>& tasks) {
+  std::vector<std::tuple<int64_t, uint8_t, int32_t, int32_t>> tup;
+  std::vector<int64_t> inflight;
+  tup.reserve(tasks.size());
+  for (const auto& t : tasks) {
+    tup.emplace_back(t.task_id, t.state, t.pickup, t.delivery);
+    if (t.state != audit::kTaskPending) inflight.push_back(t.task_id);
+  }
+  std::sort(tup.begin(), tup.end());
+  std::sort(inflight.begin(), inflight.end());
+  audit::LedgerDigest ld;
+  for (const auto& [id, st, pk, dl] : tup) ld.add(id, st, pk, dl);
+  return {ld.digest(), audit::view_digest(inflight)};
+}
+
+namespace detail {
+inline void put_u16(std::string& b, uint16_t v) {
+  b += static_cast<char>(v & 0xFF);
+  b += static_cast<char>((v >> 8) & 0xFF);
+}
+inline void put_i32(std::string& b, int32_t v) {
+  uint32_t u = static_cast<uint32_t>(v);
+  for (int k = 0; k < 4; ++k) b += static_cast<char>((u >> (8 * k)) & 0xFF);
+}
+inline void put_i64(std::string& b, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  for (int k = 0; k < 8; ++k) b += static_cast<char>((u >> (8 * k)) & 0xFF);
+}
+inline uint16_t get_u16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0] | (p[1] << 8));
+}
+inline uint32_t get_u32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+inline uint64_t get_u64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int k = 7; k >= 0; --k) v = (v << 8) | p[k];
+  return v;
+}
+}  // namespace detail
+
+inline std::string encode_ledger(const LedgerRec& r) {
+  std::string out;
+  out.reserve(84 + r.tasks.size() * 32 + r.removed.size() * 8 +
+              r.world.size() * 5 + r.handoffs.size() * 96);
+  detail::put_i32(out, static_cast<int32_t>(kLedgerMagic));
+  out += static_cast<char>(kLedgerVersion);
+  out += static_cast<char>(r.snapshot ? kFlagSnapshot : 0);
+  detail::put_u16(out, 0);  // reserved
+  detail::put_i32(out, static_cast<int32_t>(r.tasks.size()));
+  detail::put_i32(out, static_cast<int32_t>(r.removed.size()));
+  detail::put_i32(out, static_cast<int32_t>(r.world.size()));
+  detail::put_i32(out, static_cast<int32_t>(r.handoffs.size()));
+  detail::put_i64(out, r.seq);
+  detail::put_i64(out, r.base_seq);
+  detail::put_i64(out, r.incarnation);
+  detail::put_i64(out, r.plan_seq);
+  detail::put_i64(out, r.world_seq);
+  detail::put_i64(out, r.next_task_id);
+  detail::put_i64(out, static_cast<int64_t>(r.ledger_digest));
+  detail::put_i64(out, static_cast<int64_t>(r.view_digest));
+  for (const auto& t : r.tasks) {
+    detail::put_i64(out, t.task_id);
+    out += static_cast<char>(t.state);
+    detail::put_i32(out, t.pickup);
+    detail::put_i32(out, t.delivery);
+    detail::put_u16(out, static_cast<uint16_t>(t.peer.size()));
+    out += t.peer;
+  }
+  for (int64_t tid : r.removed) detail::put_i64(out, tid);
+  for (const auto& [cell, blocked] : r.world) {
+    detail::put_i32(out, cell);
+    out += static_cast<char>(blocked ? 1 : 0);
+  }
+  for (const auto& h : r.handoffs) {
+    detail::put_i32(out, h.dst);
+    detail::put_i64(out, h.seq);
+    detail::put_i64(out, h.epoch);
+    detail::put_i32(out, h.pos);
+    detail::put_i32(out, h.goal);
+    out += static_cast<char>(h.phase);
+    out += static_cast<char>(h.has_task ? 1 : 0);
+    detail::put_i64(out, h.has_task ? h.task_id : 0);
+    detail::put_i32(out, h.pickup);
+    detail::put_i32(out, h.delivery);
+    detail::put_u16(out, static_cast<uint16_t>(h.peer.size()));
+    out += h.peer;
+  }
+  return out;
+}
+
+inline std::optional<LedgerRec> decode_ledger(const std::string& buf) {
+  constexpr size_t kFixed = 24 + 64;  // head + watermarks
+  if (buf.size() < kFixed) return std::nullopt;
+  const uint8_t* b = reinterpret_cast<const uint8_t*>(buf.data());
+  if (detail::get_u32(b) != kLedgerMagic) return std::nullopt;
+  if (b[4] != kLedgerVersion) return std::nullopt;
+  LedgerRec r;
+  r.snapshot = (b[5] & kFlagSnapshot) != 0;
+  const uint32_t n_tasks = detail::get_u32(b + 8);
+  const uint32_t n_removed = detail::get_u32(b + 12);
+  const uint32_t n_world = detail::get_u32(b + 16);
+  const uint32_t n_handoffs = detail::get_u32(b + 20);
+  r.seq = static_cast<int64_t>(detail::get_u64(b + 24));
+  r.base_seq = static_cast<int64_t>(detail::get_u64(b + 32));
+  r.incarnation = static_cast<int64_t>(detail::get_u64(b + 40));
+  r.plan_seq = static_cast<int64_t>(detail::get_u64(b + 48));
+  r.world_seq = static_cast<int64_t>(detail::get_u64(b + 56));
+  r.next_task_id = static_cast<int64_t>(detail::get_u64(b + 64));
+  r.ledger_digest = detail::get_u64(b + 72);
+  r.view_digest = detail::get_u64(b + 80);
+  size_t off = kFixed;
+  for (uint32_t k = 0; k < n_tasks; ++k) {
+    if (off + 19 > buf.size()) return std::nullopt;
+    LedgerTask t;
+    t.task_id = static_cast<int64_t>(detail::get_u64(b + off));
+    t.state = b[off + 8];
+    if (t.state > audit::kTaskToDelivery) return std::nullopt;
+    t.pickup = static_cast<int32_t>(detail::get_u32(b + off + 9));
+    t.delivery = static_cast<int32_t>(detail::get_u32(b + off + 13));
+    const uint16_t peer_len = detail::get_u16(b + off + 17);
+    off += 19;
+    if (off + peer_len > buf.size()) return std::nullopt;
+    t.peer.assign(buf, off, peer_len);
+    off += peer_len;
+    r.tasks.push_back(std::move(t));
+  }
+  if (off + static_cast<size_t>(n_removed) * 8 +
+          static_cast<size_t>(n_world) * 5 > buf.size())
+    return std::nullopt;
+  for (uint32_t k = 0; k < n_removed; ++k, off += 8)
+    r.removed.push_back(static_cast<int64_t>(detail::get_u64(b + off)));
+  for (uint32_t k = 0; k < n_world; ++k, off += 5)
+    r.world.emplace_back(static_cast<int32_t>(detail::get_u32(b + off)),
+                         b[off + 4] ? 1 : 0);
+  for (uint32_t k = 0; k < n_handoffs; ++k) {
+    if (off + 48 > buf.size()) return std::nullopt;
+    HandoffOut h;
+    h.dst = static_cast<int32_t>(detail::get_u32(b + off));
+    h.seq = static_cast<int64_t>(detail::get_u64(b + off + 4));
+    h.epoch = static_cast<int64_t>(detail::get_u64(b + off + 12));
+    h.pos = static_cast<int32_t>(detail::get_u32(b + off + 20));
+    h.goal = static_cast<int32_t>(detail::get_u32(b + off + 24));
+    h.phase = b[off + 28];
+    h.has_task = b[off + 29] != 0;
+    h.task_id = static_cast<int64_t>(detail::get_u64(b + off + 30));
+    h.pickup = static_cast<int32_t>(detail::get_u32(b + off + 38));
+    h.delivery = static_cast<int32_t>(detail::get_u32(b + off + 42));
+    const uint16_t peer_len = detail::get_u16(b + off + 46);
+    off += 48;
+    if (off + peer_len > buf.size()) return std::nullopt;
+    h.peer.assign(buf, off, peer_len);
+    off += peer_len;
+    r.handoffs.push_back(std::move(h));
+  }
+  if (buf.size() != off) return std::nullopt;
+  return r;
+}
+
+// ---------- active-side delta tracking ----------
+// Mirrors ha.py LedgerEncoder exactly: removed ids ascend, changed
+// tasks keep caller order, world diffs sorted by cell, snapshot resets
+// the chain and ships the full world sorted by cell.
+class LedgerEncoder {
+ public:
+  explicit LedgerEncoder(int64_t incarnation,
+                         int snapshot_every = kSnapshotEvery)
+      : incarnation_(incarnation), snapshot_every_(snapshot_every) {}
+
+  void request_snapshot() { force_snapshot_ = true; }
+  int64_t last_seq() const { return last_seq_; }
+  void set_incarnation(int64_t inc) { incarnation_ = inc; }
+
+  std::optional<LedgerRec> encode_tick(
+      int64_t plan_seq, int64_t world_seq, int64_t next_task_id,
+      const std::vector<LedgerTask>& tasks,
+      const std::map<int32_t, int>& world,
+      const std::vector<HandoffOut>& handoffs_in = {}) {
+    auto [ld, vd] = ledger_view_digests(tasks);
+    // the outbox ships wholesale, sorted by (dst, seq) like ha.py
+    std::vector<HandoffOut> handoffs = handoffs_in;
+    std::sort(handoffs.begin(), handoffs.end(),
+              [](const HandoffOut& a, const HandoffOut& b) {
+                return a.dst != b.dst ? a.dst < b.dst : a.seq < b.seq;
+              });
+    const bool snapshot =
+        force_snapshot_ || since_snapshot_ + 1 >= snapshot_every_;
+    if (snapshot) {
+      LedgerRec rec;
+      rec.seq = last_seq_ + 1;
+      rec.base_seq = 0;
+      rec.incarnation = incarnation_;
+      rec.plan_seq = plan_seq;
+      rec.world_seq = world_seq;
+      rec.next_task_id = next_task_id;
+      rec.snapshot = true;
+      rec.tasks = tasks;
+      for (const auto& [c, bl] : world) rec.world.emplace_back(c, bl);
+      rec.handoffs = handoffs;
+      rec.ledger_digest = ld;
+      rec.view_digest = vd;
+      shadow_.clear();
+      for (const auto& t : tasks) shadow_[t.task_id] = t;
+      world_shadow_ = world;
+      handoff_shadow_ = handoffs;
+      last_seq_ = rec.seq;
+      since_snapshot_ = 0;
+      force_snapshot_ = false;
+      return rec;
+    }
+    LedgerRec rec;
+    rec.snapshot = false;
+    std::set<int64_t> current;
+    for (const auto& t : tasks) current.insert(t.task_id);
+    for (const auto& [tid, t] : shadow_) {
+      (void)t;
+      if (!current.count(tid))
+        rec.removed.push_back(tid);  // std::map: ascending
+    }
+    for (const auto& t : tasks) {
+      auto it = shadow_.find(t.task_id);
+      if (it == shadow_.end() || it->second != t) rec.tasks.push_back(t);
+    }
+    for (const auto& [c, bl] : world) {
+      auto it = world_shadow_.find(c);
+      if (it == world_shadow_.end() || it->second != bl)
+        rec.world.emplace_back(c, bl);  // std::map: ascending by cell
+    }
+    if (rec.removed.empty() && rec.tasks.empty() && rec.world.empty() &&
+        handoffs == handoff_shadow_)
+      return std::nullopt;
+    rec.seq = last_seq_ + 1;
+    rec.base_seq = last_seq_;
+    rec.incarnation = incarnation_;
+    rec.plan_seq = plan_seq;
+    rec.world_seq = world_seq;
+    rec.next_task_id = next_task_id;
+    rec.handoffs = handoffs;
+    rec.ledger_digest = ld;
+    rec.view_digest = vd;
+    for (int64_t tid : rec.removed) shadow_.erase(tid);
+    for (const auto& t : rec.tasks) shadow_[t.task_id] = t;
+    for (const auto& [c, bl] : rec.world) world_shadow_[c] = bl;
+    handoff_shadow_ = handoffs;
+    last_seq_ = rec.seq;
+    ++since_snapshot_;
+    return rec;
+  }
+
+ private:
+  int64_t incarnation_;
+  int snapshot_every_;
+  std::map<int64_t, LedgerTask> shadow_;
+  std::map<int32_t, int> world_shadow_;
+  std::vector<HandoffOut> handoff_shadow_;
+  int64_t last_seq_ = 0;
+  int since_snapshot_ = 0;
+  bool force_snapshot_ = true;
+};
+
+// ---------- standby-side replica ----------
+// Mirrors ha.py LedgerReplica.  apply() outcomes:
+//   kApplied       applied, digests verified
+//   kDivergent     applied but the recomputed full-ledger digests
+//                  disagree with the record's — resync, never promote
+//   kGap           chain break (incl. a new incarnation opening with a
+//                  delta) — request a snapshot
+//   kStale         dead-incarnation frame, dropped
+enum class ApplyResult { kApplied, kDivergent, kGap, kStale };
+
+class LedgerReplica {
+ public:
+  std::map<int64_t, LedgerTask> tasks;
+  std::map<int32_t, int> world;
+  // the active's unacked handoff outbox as last shipped — a promoted
+  // standby resumes retransmitting exactly these
+  std::vector<HandoffOut> handoffs;
+  int64_t seq = 0;
+  int64_t incarnation = 0;
+  int64_t plan_seq = 0;
+  int64_t world_seq = 0;
+  int64_t next_task_id = 0;
+  int64_t applied = 0;
+  int64_t divergences = 0;
+
+  ApplyResult apply(const LedgerRec& rec) {
+    if (incarnation && rec.incarnation < incarnation)
+      return ApplyResult::kStale;
+    if (rec.incarnation > incarnation) {
+      tasks.clear();
+      world.clear();
+      handoffs.clear();
+      seq = 0;
+      incarnation = rec.incarnation;
+      if (!rec.snapshot) return ApplyResult::kGap;
+    }
+    if (rec.snapshot) {
+      tasks.clear();
+      for (const auto& t : rec.tasks) tasks[t.task_id] = t;
+      world.clear();
+      for (const auto& [c, bl] : rec.world) world[c] = bl;
+    } else {
+      if (rec.base_seq != seq) return ApplyResult::kGap;
+      for (int64_t tid : rec.removed) tasks.erase(tid);
+      for (const auto& t : rec.tasks) tasks[t.task_id] = t;
+      for (const auto& [c, bl] : rec.world) world[c] = bl;
+    }
+    handoffs = rec.handoffs;  // wholesale, every record
+    seq = rec.seq;
+    plan_seq = rec.plan_seq;
+    world_seq = rec.world_seq;
+    next_task_id = rec.next_task_id;
+    ++applied;
+    std::vector<LedgerTask> all;
+    all.reserve(tasks.size());
+    for (const auto& [tid, t] : tasks) {
+      (void)tid;
+      all.push_back(t);
+    }
+    auto [ld, vd] = ledger_view_digests(all);
+    if (ld != rec.ledger_digest || vd != rec.view_digest) {
+      ++divergences;
+      return ApplyResult::kDivergent;
+    }
+    return ApplyResult::kApplied;
+  }
+};
+
+// The standby's lease rule — the auditor's silent-peer threshold:
+// quiet past 3 of the active's own advertised intervals + 1 s grace.
+inline bool lease_expired(int64_t now_ms, int64_t last_ms,
+                          int64_t interval_ms) {
+  if (!last_ms) return false;
+  return now_ms - last_ms > 3 * interval_ms + 1000;
+}
+
+// Split-brain guard: between two claimants of one active role, the
+// LOWER (incarnation, peer_id) demotes — both sides apply the same
+// rule, so exactly one yields.  Mirrors ha.py should_demote.
+inline bool should_demote(int64_t my_inc, const std::string& my_peer,
+                          int64_t other_inc,
+                          const std::string& other_peer) {
+  if (other_inc != my_inc) return other_inc > my_inc;
+  return other_peer > my_peer;
+}
+
+// HA is OFF unless JG_HA is set truthy (the kill switch that keeps the
+// single-manager wire byte-identical: no mapd.ha frames at all).
+inline bool ha_enabled() {
+  const char* v = getenv("JG_HA");
+  return v && v[0] && !(v[0] == '0' && v[1] == '\0');
+}
+
+}  // namespace ha
+}  // namespace mapd

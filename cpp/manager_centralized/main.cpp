@@ -42,6 +42,7 @@
 #include "../common/bus.hpp"
 #include "../common/events.hpp"
 #include "../common/grid.hpp"
+#include "../common/ha.hpp"
 #include "../common/json.hpp"
 #include "../common/knobs.hpp"
 #include "../common/log.hpp"
@@ -172,6 +173,27 @@ int main(int argc, char** argv) {
   // namespacing; defaults to the tenant ns for namespaced fleets
   const std::string audit_ns = knobs.get_str(
       "--audit-ns", "JG_AUDIT_NS", (ns_env && *ns_env) ? ns_env : "");
+  // control-plane HA (ISSUE 15): with --ha/JG_HA=1 the active manager
+  // continuously ships its task ledger + dispatch watermarks as
+  // ledger1 records on raw topic mapd.ha and renews a liveness lease;
+  // --standby tails that stream as a warm replica, promotes on lease
+  // expiry inside one claim window, and an old-incarnation active that
+  // resumes DEMOTES instead of dual-dispatching.  JG_HA unset/0 keeps
+  // the single-manager wire byte-identical: nothing published or
+  // subscribed on mapd.ha (raw-socket pin test in tests/test_ha.py).
+  const bool ha_standby_boot = knobs.get_bool("--standby",
+                                              "JG_HA_STANDBY");
+  const bool ha_on =
+      ha_standby_boot || knobs.get_int("--ha", "JG_HA", 0) != 0;
+  const int64_t ha_lease_ms = knobs.get_int(
+      "--ha-lease-ms", "JG_HA_LEASE_MS", ha::kDefaultLeaseMs);
+  // the takeover sweep-hold (PR 4's post-outage hold, reused): a
+  // promoted standby waits this long for an in-flight task's agent to
+  // report before re-queueing it — an agent already claiming the task
+  // must never be double-dispatched.  Defaults to one claim window
+  // (the idle-but-dispatched re-send grace).
+  const int64_t ha_hold_ms = knobs.get_int(
+      "--ha-hold-ms", "JG_HA_HOLD_MS", task_resend_ms);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -232,9 +254,13 @@ int main(int argc, char** argv) {
     fprintf(stderr, "cannot connect to bus on port %u\n", port);
     return 1;
   }
-  bus.subscribe("mapd");
-  if (region_gossip) {
-    if (fed_on) {
+  // the active-role subscription set, shared by normal startup and a
+  // standby's promotion (a warm standby subscribes ONLY mapd.ha until
+  // it takes over — it must not ingest fleet traffic it cannot act on)
+  auto subscribe_active = [&]() {
+    bus.subscribe("mapd");
+    if (region_gossip) {
+      if (fed_on) {
       // interest-scoped gossip (ISSUE 14): a region manager needs only
       // the beacon topics covering ITS rectangle expanded by the
       // handoff/mirror margin — subscribing the fleet-wide wildcard
@@ -263,16 +289,21 @@ int main(int argc, char** argv) {
       log_info("🗺️  region %d gossip scope: %d topic(s) over "
                "[%d,%d)x[%d,%d)+%d\n", region_id, n_topics, my_rect.x0,
                my_rect.x1, my_rect.y0, my_rect.y1, exp);
-    } else {
-      bus.subscribe(kPosTopicWildcard);
+      } else {
+        bus.subscribe(kPosTopicWildcard);
+      }
     }
-  }
-  if (solver == "tpu") bus.subscribe(solver_topic);
-  // cross-region handoffs arrive on this region's own fed topic
-  if (fed_on) bus.subscribe(FedMap::fed_topic(region_id));
-  // audit plane rides the un-namespaced operator topic (raw): a tenant
-  // manager's digests must reach the cross-tenant auditor
-  if (audit_on) bus.subscribe(audit::kAuditTopic, /*raw=*/true);
+    if (solver == "tpu") bus.subscribe(solver_topic);
+    // cross-region handoffs arrive on this region's own fed topic
+    if (fed_on) bus.subscribe(FedMap::fed_topic(region_id));
+    // audit plane rides the un-namespaced operator topic (raw): a
+    // tenant manager's digests must reach the cross-tenant auditor
+    if (audit_on) bus.subscribe(audit::kAuditTopic, /*raw=*/true);
+  };
+  if (!ha_standby_boot) subscribe_active();
+  // the HA plane rides its own raw topic — active (replication source,
+  // rival-claim detection) and standby (the replica tail) both join
+  if (ha_on) bus.subscribe(ha::kHaTopic, /*raw=*/true);
   // survive a bus restart (reconnect + resubscribe inside BusClient);
   // agents re-announce themselves on their own reconnect, so tracking
   // repopulates within a heartbeat
@@ -344,19 +375,25 @@ int main(int argc, char** argv) {
                                 // retransmits refresh last_send_ms
                                 // even for a dead neighbor's backlog)
     int64_t last_send_ms = 0;
+    // the replication-stream view of this record (ISSUE 15): a warm
+    // standby receives the full unacked outbox and, on takeover,
+    // RESUMES the retransmit with the original seq + epoch
+    ha::HandoffOut ho;
   };
   std::map<std::pair<int, int64_t>, OutHandoff> handoff_unacked;
   std::map<int, int64_t> handoff_next_seq;
   // sender incarnation: a RESTARTED manager reuses seq numbers from 1,
   // and a receiver whose dedup set remembered the old incarnation
   // would ack-without-applying — silently losing the lane and its
-  // task.  Every handoff frame carries this epoch; the receiver keys
-  // its dedup set by (src, epoch) and resets it when the epoch moves.
+  // task.  Every handoff frame carries this epoch.
   const int64_t fed_epoch = unix_ms();
-  // receiver dedup: per source region, the sender epoch + applied seq
-  // set (bounded) — a replayed/retransmitted handoff can never
-  // double-admit an agent (or double-dispatch its task)
-  std::map<int, std::pair<int64_t, std::set<int64_t>>> handoff_applied;
+  // receiver dedup: per source region, PER SENDER EPOCH applied-seq
+  // sets (bounded) — a replayed/retransmitted handoff can never
+  // double-admit an agent (or double-dispatch its task).  Per-epoch
+  // (not newest-epoch-only, ISSUE 15): a promoted standby legitimately
+  // retransmits its dead active's old-epoch records while sending new
+  // ones under its own epoch, and BOTH chains must stay exactly-once.
+  std::map<int, std::map<int64_t, std::set<int64_t>>> handoff_applied;
   std::set<std::string> handing_off;
   // peers recently adopted via handoff (peer -> flag expiry): shipped
   // as "handoff_peers" on plan_requests so solverd attributes the
@@ -405,6 +442,14 @@ int main(int argc, char** argv) {
   // already re-dispatched — is counted once and never double-refilled.
   std::set<long long> requeued_ids;
   std::set<long long> completed_ids;
+  // HA write-ahead (ISSUE 15): fresh Task dispatches are deferred here
+  // until the ledger record covering them has shipped on the
+  // replication stream — an agent must never hold a task no shipped
+  // record knows, or a takeover loses it (found live by the failover
+  // chaos row: a dispatch landing between 500 ms replication beats
+  // died with the active).  Flushed once per main-loop iteration,
+  // AFTER ha_replicate — sub-tick added latency, zero-loss ordering.
+  std::deque<Json> ha_task_outbox;
   TaskMetricsCollector task_metrics;
   PathComputationMetrics path_metrics;
   // task-id allocation: federated managers mint from DISJOINT residue
@@ -518,7 +563,10 @@ int main(int argc, char** argv) {
       a.task = task;  // the stored copy carries the context for re-sends
       event_emit("task.dispatch", &t, static_cast<long long>(id), peer);
     }
-    bus.publish("mapd", task);
+    if (ha_on)
+      ha_task_outbox.push_back(task);  // write-ahead: record ships first
+    else
+      bus.publish("mapd", task);
     // live dispatch counter: the fleet rollup derives tasks/s and the
     // completion ratio from the dispatched/completed counter pair
     metrics_count("manager.tasks_dispatched");
@@ -587,9 +635,21 @@ int main(int argc, char** argv) {
         .set("peer_id", peer)
         .set("data", codec::encode_b64(codec::encode_handoff(r)));
     bus.publish(FedMap::fed_topic(dst), f);
+    ha::HandoffOut ho;
+    ho.dst = dst;
+    ho.seq = hseq;
+    ho.epoch = fed_epoch;
+    ho.peer = peer;
+    ho.pos = r.pos;
+    ho.goal = r.goal;
+    ho.phase = static_cast<uint8_t>(r.phase);
+    ho.has_task = r.has_task;
+    ho.task_id = r.task_id;
+    ho.pickup = r.pickup;
+    ho.delivery = r.delivery;
     const int64_t send_ms = mono_ms();
     handoff_unacked[{dst, hseq}] =
-        OutHandoff{f, peer, dst, send_ms, send_ms};
+        OutHandoff{f, peer, dst, send_ms, send_ms, ho};
     handing_off.insert(peer);
     metrics_count("manager.handoffs_sent");
     metrics_gauge("manager.fed_pending_handoffs",
@@ -897,6 +957,98 @@ int main(int argc, char** argv) {
   // watermark despite the 2 s beacon cadence vs the 500 ms tick
   std::deque<audit::Entry> audit_ring;
 
+  // ---- control-plane HA state (ISSUE 15) ----
+  bool ha_role_standby = ha_standby_boot;   // current role (can flip)
+  // incarnation epoch: every HA frame carries it; a takeover bumps it
+  // past the dead active's, and the lower (incarnation, peer) of two
+  // claimants always demotes (split-brain guard, ha::should_demote)
+  int64_t ha_incarnation = unix_ms();
+  ha::LedgerEncoder ha_enc(ha_incarnation);
+  ha::LedgerReplica ha_rep;
+  // the active's lease as the standby sees it (auditor silent-peer rule)
+  std::string ha_active_peer;
+  int64_t ha_active_inc = 0;
+  int64_t ha_lease_last = 0;
+  int64_t ha_lease_interval = ha_lease_ms;
+  int64_t ha_active_repl_seq = 0;
+  // the last record's shipped digests — the takeover announcement
+  // proves digest equality against exactly these
+  uint64_t ha_active_ld = 0, ha_active_vd = 0;
+  bool ha_have_active_digests = false;
+  // a fresh standby knows nothing: ask for a snapshot immediately (the
+  // plan wire's snapshot-resync path, reused) instead of waiting for
+  // the active's next organic delta — which would gap anyway
+  bool ha_need_resync = ha_standby_boot;
+  int64_t ha_last_resync_req = 0;
+  // post-takeover restore set: in-flight replica entries wait here for
+  // their agent's next beacon (sweep-hold) instead of being re-queued
+  // into a double dispatch; the hold expiry re-queues survivors
+  std::map<std::string, Json> ha_restore_task;
+  std::map<std::string, Phase> ha_restore_phase;
+  int64_t ha_hold_until = 0;
+  bool ha_promoted = false;  // this process took over at least once
+  const int64_t ha_started = mono_ms();
+  // operator lines (taskat under replay) arriving while still standby
+  // are deferred and drained at promotion, never dropped
+  std::deque<std::string> ha_deferred_cmds;
+  bool ha_drain_cmds = false;
+  int64_t last_ha_lease = 0, last_ha_repl = 0;
+  auto ha_role_gauges = [&]() {
+    metrics_gauge("manager.ha_role", ha_role_standby ? 0.0 : 1.0,
+                  "role=\"active\"");
+    metrics_gauge("manager.ha_role", ha_role_standby ? 1.0 : 0.0,
+                  "role=\"standby\"");
+  };
+  if (ha_on) {
+    ha_role_gauges();
+    metrics_gauge("manager.ha_replica_lag_entries", 0.0);
+  }
+
+  // one world_update fan-out for all three broadcast sites (operator
+  // toggles, snapshot-resync replay, HA takeover replay — a wire
+  // change applied to one site but not the others would silently
+  // desynchronize world state): JSON [x,y,b] on "mapd" for
+  // agents/harnesses, packed world1 (or [cell,b] JSON on a JSON plan
+  // wire) on the solver topic.  Frames carry the CURRENT world_seq.
+  auto publish_world_update = [&](const std::vector<int32_t>& cells,
+                                  const std::vector<int32_t>& blocked,
+                                  bool to_mapd) {
+    if (to_mapd) {
+      Json fleet_toggles;
+      for (size_t k = 0; k < cells.size(); ++k) {
+        Json t;
+        t.push_back(Json(static_cast<int64_t>(grid.x_of(cells[k]))));
+        t.push_back(Json(static_cast<int64_t>(grid.y_of(cells[k]))));
+        t.push_back(Json(static_cast<int64_t>(blocked[k])));
+        fleet_toggles.push_back(t);
+      }
+      Json wu;
+      wu.set("type", "world_update")
+          .set("world_seq", world_seq)
+          .set("toggles", fleet_toggles);
+      bus.publish("mapd", wu);
+    }
+    if (solver == "tpu") {
+      Json su;
+      su.set("type", "world_update").set("world_seq", world_seq);
+      if (use_packed) {
+        su.set("codec", codec::kCodecName)
+            .set("data", codec::encode_b64(
+                     codec::encode_world(world_seq, cells, blocked)));
+      } else {
+        Json st;
+        for (size_t k = 0; k < cells.size(); ++k) {
+          Json t;
+          t.push_back(Json(static_cast<int64_t>(cells[k])));
+          t.push_back(Json(static_cast<int64_t>(blocked[k])));
+          st.push_back(t);
+        }
+        su.set("toggles", st);
+      }
+      bus.publish(solver_topic, su);
+    }
+  };
+
   auto plan_request_tpu = [&]() {
     Span sp("manager.plan_request_encode");
     if (use_packed) {
@@ -1087,38 +1239,7 @@ int main(int argc, char** argv) {
       metrics_count("manager.world_toggles",
                     static_cast<double>(cells.size()));
       metrics_gauge("manager.world_seq", static_cast<double>(world_seq));
-      Json fleet_toggles;
-      for (size_t k = 0; k < cells.size(); ++k) {
-        Json t;
-        t.push_back(Json(static_cast<int64_t>(grid.x_of(cells[k]))));
-        t.push_back(Json(static_cast<int64_t>(grid.y_of(cells[k]))));
-        t.push_back(Json(static_cast<int64_t>(blocked[k])));
-        fleet_toggles.push_back(t);
-      }
-      Json wu;
-      wu.set("type", "world_update")
-          .set("world_seq", world_seq)
-          .set("toggles", fleet_toggles);
-      bus.publish("mapd", wu);
-      if (solver == "tpu") {
-        Json su;
-        su.set("type", "world_update").set("world_seq", world_seq);
-        if (use_packed) {
-          su.set("codec", codec::kCodecName)
-              .set("data", codec::encode_b64(
-                       codec::encode_world(world_seq, cells, blocked)));
-        } else {
-          Json st;
-          for (size_t k = 0; k < cells.size(); ++k) {
-            Json t;
-            t.push_back(Json(static_cast<int64_t>(cells[k])));
-            t.push_back(Json(static_cast<int64_t>(blocked[k])));
-            st.push_back(t);
-          }
-          su.set("toggles", st);
-        }
-        bus.publish(solver_topic, su);
-      }
+      publish_world_update(cells, blocked, /*to_mapd=*/true);
       log_info("🌍 world update %lld: %zu toggle(s) applied, %zu free "
                "cell(s) remain\n",
                static_cast<long long>(world_seq), cells.size(),
@@ -1133,11 +1254,15 @@ int main(int argc, char** argv) {
     bus.publish("mapd", ack);
   };
 
-  // ---- audit plane (ISSUE 10): ledger digests, beacon, drill ----
-  // (task_id, state, pickup, delivery) tuples over pending + in-flight
-  // tasks, sorted by (id, state) — the ledger canon of obs/audit.py.
-  auto ledger_tuples = [&]() {
-    std::vector<std::tuple<int64_t, uint8_t, int32_t, int32_t>> tup;
+  // ---- the ledger, enumerated once (ISSUE 10 + ISSUE 15) ----
+  // Pending queue + every in-flight assignment + post-takeover hold
+  // entries, in deterministic order (pending, agents, restore).  BOTH
+  // the audit digests (ledger_tuples below) and the HA replication
+  // stream derive from this ONE enumeration — the takeover
+  // digest-equality acceptance holds only while they enumerate
+  // identical state, so there is exactly one source.
+  auto ha_ledger_tasks = [&]() {
+    std::vector<ha::LedgerTask> out;
     auto cells_of = [&](const Json& t, int32_t* pk, int32_t* dl) {
       auto p = parse_point(t["pickup"]);
       auto d2 = parse_point(t["delivery"]);
@@ -1145,28 +1270,70 @@ int main(int argc, char** argv) {
       *dl = d2 ? static_cast<int32_t>(*d2) : -1;
     };
     for (const auto& t : pending_tasks) {
-      int32_t pk, dl;
-      cells_of(t, &pk, &dl);
-      tup.emplace_back(t["task_id"].as_int(), audit::kTaskPending, pk, dl);
+      ha::LedgerTask lt;
+      lt.task_id = t["task_id"].as_int();
+      lt.state = audit::kTaskPending;
+      cells_of(t, &lt.pickup, &lt.delivery);
+      out.push_back(std::move(lt));
     }
     for (auto& [peer, a] : agents) {
       if (!a.task) continue;
-      int32_t pk, dl;
-      cells_of(*a.task, &pk, &dl);
-      tup.emplace_back((*a.task)["task_id"].as_int(),
-                       a.phase == Phase::ToDelivery
-                           ? audit::kTaskToDelivery
-                           : audit::kTaskToPickup,
-                       pk, dl);
+      ha::LedgerTask lt;
+      lt.task_id = (*a.task)["task_id"].as_int();
+      lt.state = a.phase == Phase::ToDelivery ? audit::kTaskToDelivery
+                                              : audit::kTaskToPickup;
+      cells_of(*a.task, &lt.pickup, &lt.delivery);
+      lt.peer = peer;
+      out.push_back(std::move(lt));
     }
+    // post-takeover hold entries (ISSUE 15): an in-flight task waiting
+    // for its agent to report is STILL in this ledger — dropping it
+    // would read as a lost task at the very watermark the takeover is
+    // judged on
+    for (auto& [peer, tj] : ha_restore_task) {
+      ha::LedgerTask lt;
+      lt.task_id = tj["task_id"].as_int();
+      auto ph = ha_restore_phase.find(peer);
+      lt.state = (ph != ha_restore_phase.end()
+                  && ph->second == Phase::ToDelivery)
+                     ? audit::kTaskToDelivery
+                     : audit::kTaskToPickup;
+      cells_of(tj, &lt.pickup, &lt.delivery);
+      lt.peer = peer;
+      out.push_back(std::move(lt));
+    }
+    return out;
+  };
+
+  // ---- audit plane (ISSUE 10): ledger digests, beacon, drill ----
+  // (task_id, state, pickup, delivery) tuples sorted by (id, state) —
+  // the ledger canon of obs/audit.py, derived from the one enumeration
+  auto ledger_tuples = [&]() {
+    std::vector<std::tuple<int64_t, uint8_t, int32_t, int32_t>> tup;
+    for (const auto& t : ha_ledger_tasks())
+      tup.emplace_back(t.task_id, t.state, t.pickup, t.delivery);
     std::sort(tup.begin(), tup.end());
     return tup;
   };
 
   auto publish_audit_beacon = [&]() {
-    std::vector<audit::Entry> entries(audit_ring.begin(),
-                                      audit_ring.end());
-    auto tup = ledger_tuples();
+    // a warm standby (ISSUE 15) beacons its REPLICA's digests at the
+    // replicated watermarks — the auditor sees replica convergence
+    // live, and the takeover digest-equality is externally checkable
+    const bool stby = ha_on && ha_role_standby;
+    std::vector<audit::Entry> entries;
+    if (!stby)
+      entries.assign(audit_ring.begin(), audit_ring.end());
+    std::vector<std::tuple<int64_t, uint8_t, int32_t, int32_t>> tup;
+    if (stby) {
+      for (const auto& [tid, t] : ha_rep.tasks)
+        tup.emplace_back(tid, t.state, t.pickup, t.delivery);
+      std::sort(tup.begin(), tup.end());
+    } else {
+      tup = ledger_tuples();
+    }
+    const int64_t wm_seq = stby ? ha_rep.plan_seq : plan_seq;
+    const int64_t wm_epoch = stby ? ha_rep.world_seq : world_seq;
     audit::LedgerDigest ld;
     int64_t pending = 0, to_pickup = 0, to_delivery = 0;
     std::vector<int64_t> inflight;
@@ -1180,16 +1347,16 @@ int main(int argc, char** argv) {
     audit::Entry el;
     el.section = audit::kSecLedger;
     el.count = ld.count;
-    el.seq = plan_seq;
-    el.epoch = world_seq;
+    el.seq = wm_seq;
+    el.epoch = wm_epoch;
     el.digest = ld.digest();
     entries.push_back(el);
     std::sort(inflight.begin(), inflight.end());
     audit::Entry ev;
     ev.section = audit::kSecView;
     ev.count = static_cast<uint32_t>(inflight.size());
-    ev.seq = plan_seq;
-    ev.epoch = world_seq;
+    ev.seq = wm_seq;
+    ev.epoch = wm_epoch;
     ev.digest = audit::view_digest(inflight);
     entries.push_back(ev);
     Json caps;
@@ -1201,7 +1368,7 @@ int main(int argc, char** argv) {
     Json b;
     b.set("type", "audit_beacon")
         .set("peer_id", my_id)
-        .set("proc", "manager_centralized")
+        .set("proc", stby ? "manager_standby" : "manager_centralized")
         .set("ns", audit_ns)
         .set("ts_ms", unix_ms())
         .set("interval_s", audit_interval_ms / 1000.0)
@@ -1265,8 +1432,320 @@ int main(int argc, char** argv) {
     bus.publish(audit::kAuditTopic, resp, /*raw=*/true);
   };
 
+  // --solver=tpu liveness (declared before the HA lambdas: a
+  // promotion must reset the failover clock, or a standby's whole
+  // pre-takeover uptime reads as daemon silence)
   int64_t last_plan_response = mono_ms();
   bool failed_over = false;
+
+  // ---- control-plane HA lambdas (ISSUE 15) ----
+  auto ha_replicate = [&]() {
+    // the unacked cross-region handoff outbox rides every record
+    // wholesale: a promoted standby RESUMES the retransmit-until-ack
+    // loop instead of losing a mid-transfer task
+    std::vector<ha::HandoffOut> hovec;
+    hovec.reserve(handoff_unacked.size());
+    for (const auto& [hk, out] : handoff_unacked) {
+      (void)hk;
+      hovec.push_back(out.ho);
+    }
+    auto rec = ha_enc.encode_tick(plan_seq, world_seq,
+                                  static_cast<int64_t>(next_task_id),
+                                  ha_ledger_tasks(), world_state, hovec);
+    if (!rec) return;
+    const std::string blob = codec::b64_encode(ha::encode_ledger(*rec));
+    Json f;
+    f.set("type", "ledger1")
+        .set("ns", audit_ns)
+        .set("peer_id", my_id)
+        .set("incarnation", ha_incarnation)
+        .set("seq", rec->seq)
+        .set("data", blob);
+    bus.publish(ha::kHaTopic, f, /*raw=*/true);
+    metrics_count("manager.ha_repl_records");
+    metrics_count("manager.ha_repl_bytes",
+                  static_cast<double>(blob.size()));
+    metrics_gauge("manager.ha_repl_seq",
+                  static_cast<double>(ha_enc.last_seq()));
+  };
+
+  // the write-ahead flush: ship the record covering every deferred
+  // dispatch, THEN release the Task frames to the agents
+  auto ha_flush = [&]() {
+    if (!ha_on || ha_role_standby) return;
+    ha_replicate();
+    while (!ha_task_outbox.empty()) {
+      bus.publish("mapd", ha_task_outbox.front());
+      ha_task_outbox.pop_front();
+    }
+  };
+
+  auto ha_publish_lease = [&]() {
+    Json f;
+    f.set("type", "ha_lease")
+        .set("ns", audit_ns)
+        .set("peer_id", my_id)
+        .set("incarnation", ha_incarnation)
+        .set("interval_ms", ha_lease_ms)
+        .set("repl_seq", ha_enc.last_seq());
+    bus.publish(ha::kHaTopic, f, /*raw=*/true);
+  };
+
+  // takeover: become the region's system of record inside one claim
+  // window — seed the ledger from the replica, replay the accumulated
+  // world toggles at the replicated epoch, announce the bumped
+  // incarnation WITH the digest-equal watermark proof, and hold
+  // in-flight entries for their agents (the sweep-hold) so a task an
+  // agent already claims is never double-dispatched.
+  auto ha_promote = [&](const char* why) {
+    ha_role_standby = false;
+    ha_promoted = true;
+    ha_incarnation = std::max(unix_ms(), ha_active_inc + 1);
+    ha_enc = ha::LedgerEncoder(ha_incarnation);
+    metrics_count("manager.ha_takeovers");
+    ha_role_gauges();
+    subscribe_active();
+    // seed the ledger: pending entries go straight to the queue,
+    // in-flight ones wait in the restore set for their agent's beacon
+    for (const auto& [tid, t] : ha_rep.tasks) {
+      Json tj;
+      tj.set("pickup", point_json(static_cast<Cell>(t.pickup)))
+          .set("delivery", point_json(static_cast<Cell>(t.delivery)))
+          .set("peer_id", Json())
+          .set("task_id", tid);
+      if (t.state == audit::kTaskPending || t.peer.empty()) {
+        pending_tasks.push_back(std::move(tj));
+      } else {
+        tj.set("peer_id", t.peer);
+        ha_restore_task[t.peer] = std::move(tj);
+        ha_restore_phase[t.peer] = t.state == audit::kTaskToDelivery
+                                       ? Phase::ToDelivery
+                                       : Phase::ToPickup;
+      }
+    }
+    if (ha_rep.next_task_id > 0)
+      bump_task_id_past(static_cast<uint64_t>(ha_rep.next_task_id));
+    // resume the dead active's unacked cross-region handoffs (ISSUE
+    // 15): rebuild each original frame (same seq + ORIGINAL epoch, so
+    // the receiver's per-epoch dedup keeps working — already-applied
+    // records re-ack, lost ones apply) and let the retransmit loop
+    // drive them; their tasks are NOT in our ledger (they left with
+    // the record) and must not be re-queued locally — that would
+    // double-dispatch against a receiver that did apply.
+    if (fed_on) {
+      for (const auto& h : ha_rep.handoffs) {
+        codec::HandoffRec r;
+        r.seq = h.seq;
+        r.src_region = region_id;
+        r.peer = h.peer;
+        r.pos = h.pos;
+        r.goal = h.goal;
+        r.phase = h.phase;
+        r.has_task = h.has_task;
+        r.task_id = h.task_id;
+        r.pickup = h.pickup;
+        r.delivery = h.delivery;
+        Json f;
+        f.set("type", "handoff1")
+            .set("src", static_cast<int64_t>(region_id))
+            .set("dst", static_cast<int64_t>(h.dst))
+            .set("seq", h.seq)
+            .set("epoch", h.epoch)
+            .set("peer_id", h.peer)
+            .set("data", codec::encode_b64(codec::encode_handoff(r)));
+        handoff_unacked[{h.dst, h.seq}] =
+            OutHandoff{f, h.peer, h.dst, mono_ms(), 0, h};
+        handing_off.insert(h.peer);
+        auto& nxt = handoff_next_seq[h.dst];
+        nxt = std::max(nxt, h.seq);
+        metrics_count("manager.ha_restored_handoffs");
+      }
+      if (!handoff_unacked.empty())
+        metrics_gauge("manager.fed_pending_handoffs",
+                      static_cast<double>(handoff_unacked.size()));
+    }
+    // world replay: adopt the replicated toggle state at the
+    // replicated epoch, then re-broadcast it exactly like the
+    // snapshot-resync world replay — agents and solverd re-learn every
+    // wall from the NEW system of record
+    if (!ha_rep.world.empty() || ha_rep.world_seq > world_seq) {
+      const Cell cells_total = static_cast<Cell>(grid.free.size());
+      for (const auto& [c, bl] : ha_rep.world) {
+        if (c < 0 || c >= cells_total) continue;
+        grid.free[c] = bl ? 0 : 1;
+        world_state[c] = bl ? 1 : 0;
+      }
+      world_seq = std::max(world_seq, ha_rep.world_seq);
+      dc.clear();
+      free_cells = grid.free_cells();
+      rebuild_rect_free();
+      metrics_gauge("manager.world_seq", static_cast<double>(world_seq));
+      if (dynamic_world && !world_state.empty()) {
+        std::vector<int32_t> cells, blocked;
+        for (const auto& [c, b2] : world_state) {
+          cells.push_back(c);
+          blocked.push_back(b2);
+        }
+        publish_world_update(cells, blocked, /*to_mapd=*/true);
+      }
+    }
+    ha_hold_until = mono_ms() + ha_hold_ms;
+    // the solver-failover clock starts NOW: the standby's whole
+    // pre-takeover uptime must not read as solverd silence
+    last_plan_response = mono_ms();
+    failed_over = false;
+    // the takeover announcement: self-computed audit-canon digests
+    // over the seeded ledger MUST equal the failed active's last
+    // shipped digests — the acceptance equality, on the wire for any
+    // judge (ha_smoke, chaos_gate, fleet_top)
+    auto [ld, vd] = ha::ledger_view_digests(ha_ledger_tasks());
+    Json t;
+    t.set("type", "ha_takeover")
+        .set("ns", audit_ns)
+        .set("peer_id", my_id)
+        .set("incarnation", ha_incarnation)
+        .set("why", std::string(why))
+        .set("repl_seq", ha_rep.seq)
+        .set("plan_seq", ha_rep.plan_seq)
+        .set("world_seq", ha_rep.world_seq)
+        .set("ledger_digest", audit::digest_hex(ld))
+        .set("view_digest", audit::digest_hex(vd))
+        .set("active_ledger_digest",
+             ha_have_active_digests ? audit::digest_hex(ha_active_ld)
+                                    : std::string(""))
+        .set("active_view_digest",
+             ha_have_active_digests ? audit::digest_hex(ha_active_vd)
+                                    : std::string(""))
+        .set("active_peer", ha_active_peer)
+        .set("pending", static_cast<int64_t>(pending_tasks.size()))
+        .set("inflight", static_cast<int64_t>(ha_restore_task.size()));
+    bus.publish(ha::kHaTopic, t, /*raw=*/true);
+    last_ha_lease = 0;  // start leasing immediately
+    ha_drain_cmds = !ha_deferred_cmds.empty();
+    ha_replicate();  // a rival standby can tail US from this moment
+    log_info("👑 HA takeover (%s): incarnation %lld, %zu pending + %zu "
+             "in-flight restored @ repl seq %lld (ledger %s)\n",
+             why, static_cast<long long>(ha_incarnation),
+             pending_tasks.size(), ha_restore_task.size(),
+             static_cast<long long>(ha_rep.seq),
+             audit::digest_hex(ld).c_str());
+    try_assign_pending();
+  };
+
+  // the split-brain guard's losing side: surrender the ledger to the
+  // higher-incarnation claimant and become ITS warm standby — an
+  // old-incarnation active that resumes must never dual-dispatch
+  auto ha_demote = [&](int64_t inc, const std::string& peer) {
+    log_warn("⚠️  HA demote: %s claims incarnation %lld > mine %lld; "
+             "surrendering the active role\n", peer.c_str(),
+             static_cast<long long>(inc),
+             static_cast<long long>(ha_incarnation));
+    ha_role_standby = true;
+    metrics_count("manager.ha_demotions");
+    ha_role_gauges();
+    pending_tasks.clear();
+    agents.clear();
+    ha_restore_task.clear();
+    ha_restore_phase.clear();
+    ha_hold_until = 0;
+    handoff_unacked.clear();
+    handing_off.clear();
+    requeued_ids.clear();
+    ha_task_outbox.clear();
+    ha_rep = ha::LedgerReplica();
+    ha_have_active_digests = false;
+    ha_need_resync = true;
+    ha_active_peer = peer;
+    ha_active_inc = inc;
+    ha_lease_last = mono_ms();
+  };
+
+  // one entry point for every mapd.ha frame (both roles).  Returns
+  // true when the frame was an HA frame (handled or filtered).
+  auto ha_handle_frame = [&](const Json& d) -> bool {
+    const std::string& type = d["type"].as_str();
+    if (type != "ha_lease" && type != "ledger1" &&
+        type != "ha_takeover" && type != "ha_resync_request")
+      return false;
+    if (d["ns"].as_str() != audit_ns) return true;  // another pair's
+    const std::string peer = d["peer_id"].as_str();
+    if (peer == my_id) return true;  // own frame echoed back
+    const int64_t inc = d["incarnation"].as_int();
+    if (type == "ha_resync_request") {
+      if (!ha_role_standby) {
+        metrics_count("manager.ha_resync_requests");
+        ha_enc.request_snapshot();
+        ha_replicate();
+      }
+      return true;
+    }
+    // an active-claiming frame: while active ourselves, the lower
+    // (incarnation, peer) demotes — deterministic on both sides
+    if (!ha_role_standby) {
+      if (ha::should_demote(ha_incarnation, my_id, inc, peer))
+        ha_demote(inc, peer);
+      return true;
+    }
+    // standby: any claimant frame renews the lease (a zombie with a
+    // LOWER incarnation than the freshest seen never does)
+    if (inc >= ha_active_inc) {
+      if (inc > ha_active_inc) {
+        // a NEW active incarnation announced itself: our chain (if
+        // any) is from the old one — resync against the new stream
+        ha_active_inc = inc;
+        ha_need_resync = true;
+      }
+      ha_active_peer = peer;
+      ha_lease_last = mono_ms();
+      if (type == "ha_lease") {
+        const int64_t iv = d["interval_ms"].as_int();
+        if (iv > 0) ha_lease_interval = iv;
+        ha_active_repl_seq = d["repl_seq"].as_int();
+        metrics_gauge("manager.ha_replica_lag_entries",
+                      static_cast<double>(std::max<int64_t>(
+                          0, ha_active_repl_seq - ha_rep.seq)));
+      }
+    }
+    if (type == "ledger1") {
+      auto raw = codec::b64_decode(d["data"].as_str());
+      std::optional<ha::LedgerRec> rec;
+      if (raw) rec = ha::decode_ledger(*raw);
+      if (!rec) {
+        metrics_count("manager.ha_bad_records");
+        return true;
+      }
+      switch (ha_rep.apply(*rec)) {
+        case ha::ApplyResult::kApplied:
+          ha_active_ld = rec->ledger_digest;
+          ha_active_vd = rec->view_digest;
+          ha_have_active_digests = true;
+          ha_need_resync = false;
+          metrics_gauge("manager.ha_replica_lag_entries", 0.0);
+          break;
+        case ha::ApplyResult::kDivergent:
+          // applied but the recomputed digests disagree: this replica
+          // must RESYNC, never promote on bad state
+          metrics_count("manager.ha_replica_divergence");
+          ha_have_active_digests = false;
+          ha_need_resync = true;
+          break;
+        case ha::ApplyResult::kGap:
+          metrics_count("manager.ha_replica_gaps");
+          ha_need_resync = true;
+          // the last-known active digests describe a PRE-GAP ledger: a
+          // takeover forced before the resync lands must not claim
+          // equality against them — the proof is honestly unavailable
+          ha_have_active_digests = false;
+          break;
+        case ha::ApplyResult::kStale:
+          metrics_count("manager.ha_stale_records");
+          break;
+      }
+      metrics_gauge("manager.ha_repl_seq",
+                    static_cast<double>(ha_rep.seq));
+    }
+    return true;
+  };
 
   auto handle_plan_response = [&](const Json& d) {
     // one-way solverd->manager latency (trace ctx echoed by the daemon;
@@ -1373,6 +1852,22 @@ int main(int argc, char** argv) {
     std::string cmd;
     in >> cmd;
     if (cmd == "quit" || cmd == "exit") return false;
+    if (ha_on && ha_role_standby
+        && (cmd == "task" || cmd == "tasks" || cmd == "taskat")) {
+      // operator load arriving at a warm standby (a replay driver
+      // re-routing around a dead active): deferred, drained at
+      // promotion — a standby must never mint or queue tasks itself.
+      // Past the cap the line is DROPPED — loudly: the counter + log
+      // are the only way a judge's "missing task" traces back here.
+      if (ha_deferred_cmds.size() < 10000) {
+        ha_deferred_cmds.push_back(line);
+      } else {
+        metrics_count("manager.ha_deferred_dropped");
+        log_warn("⚠️  standby deferred-command queue full; dropping "
+                 "operator line: %s\n", line.c_str());
+      }
+      return true;
+    }
     if (cmd == "task") {
       queue_task();
       try_assign_pending();
@@ -1490,6 +1985,13 @@ int main(int argc, char** argv) {
         [&](const BusClient::Msg& m) {
           const Json& d = m.data;
           const std::string& type = d["type"].as_str();
+          // HA plane first (ISSUE 15): ha frames are handled in either
+          // role; everything else is IGNORED while standby — a warm
+          // replica must never ingest fleet traffic it cannot act on
+          // (its subscriptions are ha-only anyway; this also covers
+          // the demoted-active case, whose old subscriptions remain)
+          if (ha_on && ha_handle_frame(d)) return;
+          if (ha_on && ha_role_standby) return;
           if (type == "position_update" || type == "pos1") {
             // one heartbeat ingestion for both wires: flat JSON
             // position_update and the packed pos1 region beacon (which is
@@ -1528,6 +2030,36 @@ int main(int argc, char** argv) {
             if (!p) return;
             auto it = agents.find(peer);
             if (it == agents.end()) {
+              // post-takeover restore (ISSUE 15): this agent's
+              // in-flight task rode the replication stream — reattach
+              // it instead of adopting the agent idle, which would
+              // hand it a SECOND task while it works the first.  The
+              // normal idle-but-busy reconciliation then re-sends the
+              // task if the agent actually lost its copy.
+              auto rst = ha_restore_task.find(peer);
+              if (rst != ha_restore_task.end()) {
+                AgentInfo a;
+                a.pos = *p;
+                a.last_seen_ms = mono_ms();
+                a.dispatched_ms = mono_ms();
+                a.task = rst->second;
+                auto ph = ha_restore_phase.find(peer);
+                a.phase = (ph != ha_restore_phase.end())
+                              ? ph->second : Phase::ToPickup;
+                auto cell = parse_point((*a.task)[
+                    a.phase == Phase::ToDelivery ? "delivery"
+                                                 : "pickup"]);
+                a.goal = cell ? *cell : *p;
+                agents[peer] = a;
+                ha_restore_task.erase(rst);
+                ha_restore_phase.erase(peer);
+                metrics_count("manager.ha_restored_lanes");
+                log_info("🔗 HA restore: %s re-attached to task %lld\n",
+                         peer.c_str(),
+                         (*agents[peer].task)["task_id"].as_int());
+                try_assign_pending();
+                return;
+              }
               if (fed_on) {
                 // ownership (ISSUE 14): adopt only agents standing in
                 // OUR region; a foreign agent in the border strip
@@ -1640,23 +2172,7 @@ int main(int argc, char** argv) {
                 cells.push_back(c);
                 blocked.push_back(b);
               }
-              Json su;
-              su.set("type", "world_update").set("world_seq", world_seq);
-              if (use_packed) {
-                su.set("codec", codec::kCodecName)
-                    .set("data", codec::encode_b64(codec::encode_world(
-                             world_seq, cells, blocked)));
-              } else {
-                Json st;
-                for (size_t k = 0; k < cells.size(); ++k) {
-                  Json t;
-                  t.push_back(Json(static_cast<int64_t>(cells[k])));
-                  t.push_back(Json(static_cast<int64_t>(blocked[k])));
-                  st.push_back(t);
-                }
-                su.set("toggles", st);
-              }
-              bus.publish(solver_topic, su);
+              publish_world_update(cells, blocked, /*to_mapd=*/false);
               metrics_count("manager.world_replays");
               log_info("🌍 replayed %zu accumulated world toggle(s) at "
                        "epoch %lld with the snapshot\n",
@@ -1744,22 +2260,22 @@ int main(int argc, char** argv) {
                 .set("seq", hseq)
                 .set("epoch", hepoch)  // sender matches its own epoch
                 .set("peer_id", d["peer_id"]);
-            auto& src_state = handoff_applied[src];
-            if (hepoch > src_state.first) {
-              // the sender restarted (NEWER incarnation): its seq
-              // chain starts over — the old dedup set must not
-              // swallow it
-              src_state.first = hepoch;
-              src_state.second.clear();
-            } else if (hepoch < src_state.first) {
-              // a delayed frame from a DEAD incarnation: dropping it
-              // (no ack — nobody retransmits it) is the only safe
-              // move; resetting the dedup set for it would let the
-              // live epoch's already-applied records re-apply
-              metrics_count("manager.handoffs_stale_epoch");
-              return;
+            // per-epoch dedup sets (ISSUE 15): each sender incarnation
+            // owns its own seq chain.  A promoted standby retransmits
+            // its dead active's OLD-epoch records while minting new
+            // ones under its own epoch — both must dedup against their
+            // own chain (the old reset-on-newer-epoch rule would
+            // strand the restored retransmits: dropped as stale, never
+            // acked, agent in limbo forever).
+            auto& epochs_seen = handoff_applied[src];
+            auto& seen = epochs_seen[hepoch];
+            while (epochs_seen.size() > 4) {
+              // bound: keep the newest epochs, never evicting the one
+              // this frame just landed in
+              auto oldest = epochs_seen.begin();
+              if (oldest->first == hepoch) ++oldest;
+              epochs_seen.erase(oldest);
             }
-            auto& seen = src_state.second;
             if (seen.count(hseq)) {
               // replayed/retransmitted record: ack again (its ack was
               // lost), NEVER re-apply — a duplicate handoff must not
@@ -1848,13 +2364,19 @@ int main(int argc, char** argv) {
           } else if (type == "handoff_ack") {
             if (!fed_on || static_cast<int>(d["src"].as_int()) != region_id)
               return;
-            if (d["epoch"].as_int() != fed_epoch)
-              return;  // an ack for a PREVIOUS incarnation's record
-                       // must not cancel THIS incarnation's in-flight
-                       // handoff (same seq, different lane/task)
             auto key = std::make_pair(
                 static_cast<int>(d["dst"].as_int()), d["seq"].as_int());
             auto hit = handoff_unacked.find(key);
+            // the ack must echo the RECORD's own epoch — an ack for
+            // another incarnation's record (same seq, different
+            // lane/task) must not cancel this one.  Judged per record,
+            // not against the process-global fed_epoch: a promoted
+            // standby's restored outbox entries keep their ORIGINAL
+            // epoch (ISSUE 15) and their acks must still land.
+            if (hit != handoff_unacked.end()
+                && d["epoch"].as_int()
+                       != hit->second.frame["epoch"].as_int())
+              return;
             if (hit != handoff_unacked.end()) {
               handing_off.erase(hit->second.peer);
               handoff_unacked.erase(hit);
@@ -1868,6 +2390,22 @@ int main(int argc, char** argv) {
             const std::string peer =
                 d.has("peer_id") ? d["peer_id"].as_str() : m.from;
             const long long tid = d["task_id"].as_int();
+            // post-takeover hold entries (ISSUE 15): a done for a task
+            // still in the restore set completes it — the agent
+            // finished during the outage without re-beaconing first.
+            // The entry must leave the hold set (the hold expiry would
+            // otherwise re-queue a completed task) but still counts as
+            // ledger-known below.
+            bool ha_restore_known = false;
+            for (auto rit = ha_restore_task.begin();
+                 rit != ha_restore_task.end(); ++rit) {
+              if (rit->second["task_id"].as_int() == tid) {
+                ha_restore_known = true;
+                ha_restore_phase.erase(rit->first);
+                ha_restore_task.erase(rit);
+                break;
+              }
+            }
             if (fed_on) {
               // ownership (ISSUE 14): every region manager hears
               // "mapd", so only the region whose LEDGER knows the task
@@ -1885,9 +2423,9 @@ int main(int argc, char** argv) {
               // inflight-id index is the scaling follow-up if a
               // many-region profile ever shows them.
               auto rit = agents.find(peer);
-              bool task_known =
-                  (rit != agents.end() && rit->second.task
-                   && (*rit->second.task)["task_id"].as_int() == tid)
+              bool task_known = ha_restore_known
+                  || (rit != agents.end() && rit->second.task
+                      && (*rit->second.task)["task_id"].as_int() == tid)
                   || completed_ids.count(tid) || requeued_ids.count(tid);
               if (!task_known)
                 for (const auto& q : pending_tasks)
@@ -1908,6 +2446,43 @@ int main(int argc, char** argv) {
               // was dropped (per-subscriber slow-consumer eviction);
               // the owner hears a later retransmit and acks it itself.
               if (!task_known) return;
+            } else if (ha_promoted) {
+              // exact-once across a takeover (ISSUE 15): only
+              // ledger-known ids count.  An unknown id is a
+              // pre-takeover completion whose ack died with the old
+              // active — ACK it (quieting the agent's retransmit; we
+              // ARE the region of record now, nobody else will) but
+              // never count it, or the system-of-record completion
+              // counter would read a double completion.
+              bool known = ha_restore_known
+                  || completed_ids.count(tid)
+                  || requeued_ids.count(tid);
+              if (!known) {
+                auto kit = agents.find(peer);
+                known = kit != agents.end() && kit->second.task
+                    && (*kit->second.task)["task_id"].as_int() == tid;
+              }
+              if (!known)
+                for (const auto& q : pending_tasks)
+                  if (q["task_id"].as_int() == tid) {
+                    known = true;
+                    break;
+                  }
+              if (!known)
+                for (const auto& [ap, aa] : agents)
+                  if (aa.task
+                      && (*aa.task)["task_id"].as_int() == tid) {
+                    known = true;
+                    break;
+                  }
+              if (!known) {
+                Json ack;
+                ack.set("type", "done_ack").set("peer_id", peer)
+                    .set("task_id", Json(static_cast<int64_t>(tid)));
+                bus.publish("mapd", ack);
+                metrics_count("manager.ha_unknown_done_acked");
+                return;
+              }
             }
             auto done_tc = tc_parse(d);
             if (done_tc) {
@@ -2066,6 +2641,80 @@ int main(int argc, char** argv) {
           bus.publish(FedMap::fed_topic(out.dst), out.frame);
           out.last_send_ms = now;
           metrics_count("manager.handoff_retransmits");
+        }
+      }
+    }
+    if (ha_on) {
+      if (!ha_role_standby) {
+        // active: renew the lease and ship the replication stream
+        if (now - last_ha_lease >= ha_lease_ms) {
+          last_ha_lease = now;
+          ha_publish_lease();
+        }
+        // per-iteration write-ahead flush: a pending dispatch forces
+        // it immediately; otherwise the (cheap, diff-only) replication
+        // check runs on a short cadence
+        if (!ha_task_outbox.empty() || now - last_ha_repl >= 50) {
+          last_ha_repl = now;
+          ha_flush();  // record first, then the deferred Task frames
+        }
+        if (ha_hold_until && now >= ha_hold_until) {
+          // sweep-hold expiry: an in-flight entry whose agent never
+          // reported inside one claim window re-queues AT-LEAST-ONCE
+          // (its agent may still finish; the done path dedups by id)
+          for (auto& [peer, tj] : ha_restore_task) {
+            const long long tid = tj["task_id"].as_int();
+            Json t = tj;
+            t.set("peer_id", Json());
+            requeued_ids.insert(tid);
+            pending_tasks.push_front(std::move(t));
+            metrics_count("manager.ha_hold_requeues");
+            log_info("♻️  HA hold expired: task %lld of silent agent "
+                     "%s re-queued\n", tid, peer.c_str());
+          }
+          ha_restore_task.clear();
+          ha_restore_phase.clear();
+          ha_hold_until = 0;
+          try_assign_pending();
+        }
+        if (ha_drain_cmds) {
+          // operator lines deferred while standby (replay taskat) run
+          // now that we ARE the system of record
+          ha_drain_cmds = false;
+          std::deque<std::string> lines;
+          lines.swap(ha_deferred_cmds);
+          for (const auto& line : lines) handle_command(line);
+        }
+      } else {
+        // standby: judge the active's lease by the auditor's
+        // silent-peer rule; a cold start with NO active ever heard
+        // promotes after a longer grace (nobody owns the region)
+        if (ha_lease_last
+            && ha::lease_expired(now, ha_lease_last,
+                                 ha_lease_interval)) {
+          metrics_count("manager.ha_lease_expiries");
+          log_warn("⚠️  HA lease expired: active %s (incarnation %lld) "
+                   "silent %lld ms — taking over\n",
+                   ha_active_peer.c_str(),
+                   static_cast<long long>(ha_active_inc),
+                   static_cast<long long>(now - ha_lease_last));
+          ha_promote("lease_expired");
+        } else if (!ha_lease_last
+                   && now - ha_started > 6 * ha_lease_ms + 3000) {
+          log_warn("⚠️  HA cold start: no active ever announced — "
+                   "claiming the region\n");
+          ha_promote("cold_start");
+        }
+        if (ha_role_standby && ha_need_resync
+            && now - ha_last_resync_req > 1000) {
+          ha_last_resync_req = now;
+          Json f;
+          f.set("type", "ha_resync_request")
+              .set("ns", audit_ns)
+              .set("peer_id", my_id)
+              .set("incarnation", ha_incarnation)
+              .set("have_seq", ha_rep.seq);
+          bus.publish(ha::kHaTopic, f, /*raw=*/true);
         }
       }
     }

@@ -51,6 +51,19 @@
 //                   "phase":0|1|2,"task":T?,"pickup":PK?,"delivery":D?}
 //                  stdout: one base64 handoff1 packet per line
 //                  (--decode round-trips it like any packed1 kind)
+//   --ledger-encode stdin: one JSON per line (ISSUE 15)
+//                  {"plan":P,"world_seq":W,"next":N,
+//                   "tasks":[[id,state,pickup,delivery,"peer"],...],
+//                   "world":[[cell,blocked],...],
+//                   "handoffs":[[dst,seq,epoch,"peer",pos,goal,phase,
+//                                task|null,pickup,delivery],...]?,
+//                   "inc":I?,"snapshot_every":k?,"force_snapshot":bool?}
+//                  stdout: one base64 ledger1 record per line — state
+//                  carried across lines like a live replication stream
+//                  ("null" when nothing changed and no snapshot is due)
+//   --ledger-decode stdin: one base64 ledger1 record per line
+//                  stdout: canonical JSON of the decoded record per
+//                  line ("null" for undecodable input)
 
 #include <algorithm>
 #include <cstdio>
@@ -59,6 +72,7 @@
 
 #include "../common/audit.hpp"
 #include "../common/grid.hpp"
+#include "../common/ha.hpp"
 #include "../common/json.hpp"
 #include "../common/plan_codec.hpp"
 #include "../common/region.hpp"
@@ -99,16 +113,20 @@ int main(int argc, char** argv) {
       mode != "--pos1-decode" && mode != "--shardmap" &&
       mode != "--world-encode" && mode != "--audit-digest" &&
       mode != "--audit-encode" && mode != "--audit-decode" &&
-      mode != "--fedmap" && mode != "--handoff-encode") {
+      mode != "--fedmap" && mode != "--handoff-encode" &&
+      mode != "--ledger-encode" && mode != "--ledger-decode") {
     fprintf(stderr,
             "usage: codec_golden --encode|--decode|--pos1-encode|"
             "--pos1-decode|--shardmap|--world-encode|--audit-digest|"
-            "--audit-encode|--audit-decode|--fedmap|--handoff-encode"
+            "--audit-encode|--audit-decode|--fedmap|--handoff-encode|"
+            "--ledger-encode|--ledger-decode"
             " < lines\n");
     return 2;
   }
   codec::PackedFleetEncoder enc;
   bool enc_configured = false;
+  ha::LedgerEncoder ledger_enc(0);
+  bool ledger_configured = false;
   std::string line;
   while (std::getline(std::cin, line)) {
     if (line.empty()) continue;
@@ -366,6 +384,140 @@ int main(int argc, char** argv) {
         pkt.trace = tc;
       }
       printf("%s\n", codec::encode_b64(pkt).c_str());
+      continue;
+    }
+    if (mode == "--ledger-encode") {
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad ledger script line\n");
+        return 1;
+      }
+      const Json& j = *parsed;
+      if (!ledger_configured) {
+        ledger_enc = ha::LedgerEncoder(
+            j.has("inc") ? j["inc"].as_int() : 0,
+            j.has("snapshot_every")
+                ? static_cast<int>(j["snapshot_every"].as_int())
+                : ha::kSnapshotEvery);
+        ledger_configured = true;
+      }
+      if (j["force_snapshot"].as_bool()) ledger_enc.request_snapshot();
+      std::vector<ha::LedgerTask> tasks;
+      for (const auto& e : j["tasks"].as_array()) {
+        const auto& t = e.as_array();
+        if (t.size() != 5) {
+          fprintf(stderr, "codec_golden: ledger task needs "
+                          "[id,state,pickup,delivery,peer]\n");
+          return 1;
+        }
+        ha::LedgerTask lt;
+        lt.task_id = t[0].as_int();
+        lt.state = static_cast<uint8_t>(t[1].as_int());
+        lt.pickup = static_cast<int32_t>(t[2].as_int());
+        lt.delivery = static_cast<int32_t>(t[3].as_int());
+        lt.peer = t[4].as_str();
+        tasks.push_back(std::move(lt));
+      }
+      std::map<int32_t, int> world;
+      for (const auto& e : j["world"].as_array()) {
+        const auto& t = e.as_array();
+        world[static_cast<int32_t>(t[0].as_int())] =
+            t[1].as_int() ? 1 : 0;
+      }
+      std::vector<ha::HandoffOut> handoffs;
+      for (const auto& e : j["handoffs"].as_array()) {
+        const auto& t = e.as_array();
+        if (t.size() != 10) {
+          fprintf(stderr, "codec_golden: ledger handoff needs "
+                          "[dst,seq,epoch,peer,pos,goal,phase,task,"
+                          "pickup,delivery]\n");
+          return 1;
+        }
+        ha::HandoffOut h;
+        h.dst = static_cast<int32_t>(t[0].as_int());
+        h.seq = t[1].as_int();
+        h.epoch = t[2].as_int();
+        h.peer = t[3].as_str();
+        h.pos = static_cast<int32_t>(t[4].as_int());
+        h.goal = static_cast<int32_t>(t[5].as_int());
+        h.phase = static_cast<uint8_t>(t[6].as_int());
+        h.has_task = !t[7].is_null();
+        h.task_id = h.has_task ? t[7].as_int() : 0;
+        h.pickup = static_cast<int32_t>(t[8].as_int());
+        h.delivery = static_cast<int32_t>(t[9].as_int());
+        handoffs.push_back(std::move(h));
+      }
+      auto rec = ledger_enc.encode_tick(
+          j["plan"].as_int(), j["world_seq"].as_int(), j["next"].as_int(),
+          tasks, world, handoffs);
+      if (!rec) {
+        printf("null\n");
+        continue;
+      }
+      printf("%s\n", codec::b64_encode(ha::encode_ledger(*rec)).c_str());
+      continue;
+    }
+    if (mode == "--ledger-decode") {
+      auto raw = codec::b64_decode(line);
+      std::optional<ha::LedgerRec> rec;
+      if (raw) rec = ha::decode_ledger(*raw);
+      if (!rec) {
+        printf("null\n");
+        continue;
+      }
+      Json tasks;
+      for (const auto& t : rec->tasks) {
+        Json e;
+        e.push_back(Json(t.task_id));
+        e.push_back(Json(static_cast<int64_t>(t.state)));
+        e.push_back(Json(static_cast<int64_t>(t.pickup)));
+        e.push_back(Json(static_cast<int64_t>(t.delivery)));
+        e.push_back(Json(t.peer));
+        tasks.push_back(e);
+      }
+      if (tasks.is_null()) tasks = Json(JsonArray{});
+      Json removed;
+      for (int64_t tid : rec->removed) removed.push_back(Json(tid));
+      if (removed.is_null()) removed = Json(JsonArray{});
+      Json world;
+      for (const auto& [c, bl] : rec->world) {
+        Json e;
+        e.push_back(Json(static_cast<int64_t>(c)));
+        e.push_back(Json(static_cast<int64_t>(bl)));
+        world.push_back(e);
+      }
+      if (world.is_null()) world = Json(JsonArray{});
+      Json hoffs;
+      for (const auto& h : rec->handoffs) {
+        Json e;
+        e.push_back(Json(static_cast<int64_t>(h.dst)));
+        e.push_back(Json(h.seq));
+        e.push_back(Json(h.epoch));
+        e.push_back(Json(h.peer));
+        e.push_back(Json(static_cast<int64_t>(h.pos)));
+        e.push_back(Json(static_cast<int64_t>(h.goal)));
+        e.push_back(Json(static_cast<int64_t>(h.phase)));
+        e.push_back(h.has_task ? Json(h.task_id) : Json());
+        e.push_back(Json(static_cast<int64_t>(h.pickup)));
+        e.push_back(Json(static_cast<int64_t>(h.delivery)));
+        hoffs.push_back(e);
+      }
+      if (hoffs.is_null()) hoffs = Json(JsonArray{});
+      Json out;
+      out.set("seq", rec->seq)
+          .set("base_seq", rec->base_seq)
+          .set("inc", rec->incarnation)
+          .set("plan", rec->plan_seq)
+          .set("world_seq", rec->world_seq)
+          .set("next", rec->next_task_id)
+          .set("snapshot", rec->snapshot)
+          .set("tasks", tasks)
+          .set("removed", removed)
+          .set("world", world)
+          .set("handoffs", hoffs)
+          .set("ledger_digest", audit::digest_hex(rec->ledger_digest))
+          .set("view_digest", audit::digest_hex(rec->view_digest));
+      printf("%s\n", out.dump().c_str());
       continue;
     }
     if (mode == "--decode") {

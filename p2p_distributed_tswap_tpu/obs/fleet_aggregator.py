@@ -141,6 +141,10 @@ class FleetAggregator:
         # rollup's `replay` section and fleet_top's REPLAY line
         self._replay: Optional[dict] = None
         self._replay_seen_ms = 0
+        # control-plane HA (ISSUE 15): takeover announcements observed
+        # on mapd.ha — the digest-equal watermark proof, kept for the
+        # rollup's `ha` section and the chaos/smoke judges
+        self.ha_takeovers: list = []
 
     # cumulative counters watched for restarts (a shrink between two
     # consecutive beacons of one peer = the process restarted with a
@@ -158,6 +162,17 @@ class FleetAggregator:
             # the embedded auditor's feed (ISSUE 10): digest beacons
             # merge into the joiner, not the metrics peer table
             return self.audit.ingest(payload, now_ms=now_ms)
+        if isinstance(payload, dict) \
+                and payload.get("type") == "ha_takeover":
+            # a promoted standby's announcement (ISSUE 15): carries the
+            # takeover watermark and BOTH sides' ledger/view digests —
+            # the judge-facing record of the digest-equality acceptance
+            rec = dict(payload)
+            rec["seen_ms"] = _now_ms() if now_ms is None else now_ms
+            self.ha_takeovers.append(rec)
+            del self.ha_takeovers[:-16]
+            self.beacons_ingested += 1
+            return True
         if isinstance(payload, dict) \
                 and payload.get("type") == "replay_beacon":
             # the replay driver's progress frames (ISSUE 11): drift vs
@@ -399,6 +414,29 @@ class FleetAggregator:
         if admitted:
             out["lanes_admitted"] = {k: int(v)
                                      for k, v in sorted(admitted.items())}
+        # control-plane HA (ISSUE 15): role + replication surfaces —
+        # the ha_role labeled gauge carries 1 on the CURRENT role, and
+        # the replica-lag gauge is the standby's distance behind the
+        # active's shipped stream (entries)
+        roles = gauges_by_label(m, "manager.ha_role", "role")
+        if roles:
+            out["ha"] = {
+                "role": next((r for r, v in sorted(roles.items()) if v),
+                             None),
+                "replica_lag": int(
+                    gauges.get("manager.ha_replica_lag_entries") or 0),
+                "repl_seq": int(gauges.get("manager.ha_repl_seq") or 0),
+                "takeovers": int(
+                    counter_total(m, "manager.ha_takeovers")),
+                "lease_expiries": int(
+                    counter_total(m, "manager.ha_lease_expiries")),
+                "demotions": int(
+                    counter_total(m, "manager.ha_demotions")),
+                "restored_lanes": int(
+                    counter_total(m, "manager.ha_restored_lanes")),
+                "hold_requeues": int(
+                    counter_total(m, "manager.ha_hold_requeues")),
+            }
         # world-epoch tracking (ISSUE 10 satellite): any peer carrying a
         # world_seq gauge gains a `world` section — the seq AND the
         # dynamic-world flag, so a toggling fleet with an epoch-unaware
@@ -506,6 +544,32 @@ class FleetAggregator:
                 "pending": sum(p["federation"]["pending"]
                                for _, p in fed_peers),
             }
+        # control-plane HA (ISSUE 15): live-role census across manager
+        # peers + the newest observed takeover announcement.  Stale
+        # rows keep their last-beaconed role — a SIGKILLed active's row
+        # reads active+stale, which is exactly the operator's evidence.
+        ha_peers = [(peer, p) for peer, p in peers.items()
+                    if p.get("ha")]
+        ha = None
+        if ha_peers or self.ha_takeovers:
+            ha = {
+                "active": sorted(peer for peer, p in ha_peers
+                                 if p["ha"]["role"] == "active"
+                                 and not p["stale"]),
+                "standby": sorted(peer for peer, p in ha_peers
+                                  if p["ha"]["role"] == "standby"
+                                  and not p["stale"]),
+                "replica_lag": max((p["ha"]["replica_lag"]
+                                    for _, p in ha_peers), default=0),
+                "takeovers": sum(p["ha"]["takeovers"]
+                                 for _, p in ha_peers),
+                "lease_expiries": sum(p["ha"]["lease_expiries"]
+                                      for _, p in ha_peers),
+                "demotions": sum(p["ha"]["demotions"]
+                                 for _, p in ha_peers),
+                "last_takeover": (self.ha_takeovers[-1]
+                                  if self.ha_takeovers else None),
+            }
         return {
             "ts_ms": now_ms,
             "budget_ms": self.budget_ms,
@@ -515,6 +579,7 @@ class FleetAggregator:
             "audit": self.audit.status() if self.audit.beacons else None,
             "replay": self._replay_rollup(now_ms),
             "federation": federation,
+            "ha": ha,
             "peers": peers,
             "fleet": {
                 "peers": len(peers),

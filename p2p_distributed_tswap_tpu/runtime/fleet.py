@@ -99,7 +99,8 @@ class Fleet:
                  solverd_args: Optional[List[str]] = None,
                  bus_shards: Optional[int] = None,
                  bus_cpu_affinity: Optional[str] = None,
-                 regions: Optional[str] = None):
+                 regions: Optional[str] = None,
+                 ha: Optional[bool] = None):
         assert mode in ("centralized", "decentralized")
         # federated world regions (ISSUE 14): a "CxR" spec brings up one
         # (manager [, solverd]) pair PER REGION on the shared bus pool —
@@ -194,7 +195,18 @@ class Fleet:
                                  "solverd up", 240, proc=sd_proc)
                 else:
                     time.sleep(8)  # accelerator init headroom
+        # control-plane HA (ISSUE 15): ha=True (or JG_HA=1 in the
+        # fleet env) pairs every region's manager with a warm standby
+        # that tails its ledger1 replication stream and takes over on
+        # lease expiry.  centralized-mode only — the decentralized
+        # manager is not a replication source (yet).
+        if ha is None:
+            ha_env = str((env or {}).get("JG_HA")
+                         or os.environ.get("JG_HA", ""))
+            ha = ha_env not in ("", "0")
+        ha_on = bool(ha) and mode == "centralized"
         self.managers: List[subprocess.Popen] = []
+        self.standbys: List[Optional[subprocess.Popen]] = []
         for rid in range(fed_total):
             tag = f"_r{rid}" if fed_total > 1 else ""
             mgr_cmd = [str(build / f"mapd_manager_{mode}"),
@@ -203,8 +215,13 @@ class Fleet:
                 mgr_cmd += ["--solver", solver]
             mgr_cmd += regionlib.fed_cli_args(rid, fed_cols, fed_rows,
                                               "manager")
+            if ha_on:
+                mgr_cmd += ["--ha", "1"]
             self.managers.append(spawn(f"manager{tag}", mgr_cmd,
                                        stdin=subprocess.PIPE))
+            self.standbys.append(
+                spawn(f"standby{tag}", mgr_cmd + ["--standby"],
+                      stdin=subprocess.PIPE) if ha_on else None)
         self.manager = self.managers[0]
         time.sleep(0.3)
         for i in range(1, num_agents + 1):

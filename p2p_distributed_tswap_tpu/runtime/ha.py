@@ -1,0 +1,530 @@
+"""Control-plane HA (ISSUE 15): the ``ledger1`` replication canon,
+replica state machine, and lease/election rules.
+
+The manager is the system of record for the task ledger — SIGKILL it
+and every open task dies with it.  This module is the Python half of
+the fix (native mirror: ``cpp/common/ha.hpp``, byte-identical and
+golden-tested via ``codec_golden --ledger-encode/--ledger-decode`` like
+``handoff1``):
+
+- **the ``ledger1`` record** — a versioned binary blob (packed1-family
+  discipline: little-endian, base64-framed in a bus JSON envelope on
+  raw topic ``mapd.ha``) carrying the active manager's task ledger
+  (pending + in-flight entries with their assigned agents), its
+  dispatch watermarks (plan seq, world epoch, next task id), the
+  accumulated world-toggle state, and the active's own **audit-canon
+  ledger/view digests over the full post-apply state** — the integrity
+  check a replica verifies after every apply, and the equality the
+  takeover acceptance is judged on;
+- **:class:`LedgerEncoder`** — active-side delta tracking: full
+  snapshot first (and every ``snapshot_every``, and on demand via
+  ``ha_resync_request``), then deltas carrying only changed/added
+  tasks, removed ids, and changed world cells, seq-chained like the
+  packed plan wire;
+- **:class:`LedgerReplica`** — standby-side mirror: applies the chain,
+  raises :class:`HaSeqGapError` on a break (the owner publishes
+  ``ha_resync_request`` — the same snapshot-resync discipline as the
+  plan wire), resets on a NEWER active incarnation, ignores stale
+  incarnations, and verifies the record's digests against its own
+  recomputation (``divergences`` counts mismatches; a divergent replica
+  must resync, never promote on bad state);
+- **:class:`LeaseMonitor`** — the active's liveness lease, judged by
+  the auditor's silent-peer rule: quiet past 3 of its own advertised
+  intervals plus a 1 s absolute grace = expired;
+- **:func:`should_demote`** — the split-brain guard: orderings are
+  judged on ``(incarnation, peer_id)``; both sides apply the same rule
+  to the same announcements, so exactly ONE of two claimants yields.
+  An old-incarnation active that resumes (SIGSTOP/SIGCONT through a
+  takeover) hears the promoted standby's higher incarnation and
+  demotes instead of dual-dispatching.
+
+``JG_HA`` unset/0 is the default-off kill switch: no process publishes
+or subscribes anything on ``mapd.ha`` and the single-manager wire is
+byte-identical (raw-socket pin test in tests/test_ha.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from p2p_distributed_tswap_tpu.obs import audit as _audit
+
+HA_TOPIC = "mapd.ha"
+KILL_ENV = "JG_HA"
+LEASE_MS_ENV = "JG_HA_LEASE_MS"
+DEFAULT_LEASE_MS = 500
+# the takeover sweep-hold (one claim window): a promoted standby waits
+# this long for in-flight tasks' agents to report before re-queueing —
+# the task_resend_ms analog of PR 4's post-outage hold
+DEFAULT_HOLD_MS = 5000
+SNAPSHOT_EVERY = 64
+
+LEDGER_MAGIC = 0x3147444C  # b"LDG1" little-endian
+LEDGER_VERSION = 1
+FLAG_SNAPSHOT = 1
+
+# task states (the audit ledger canon's state byte — never renumber)
+TASK_PENDING = _audit.TASK_PENDING
+TASK_TO_PICKUP = _audit.TASK_TO_PICKUP
+TASK_TO_DELIVERY = _audit.TASK_TO_DELIVERY
+
+_HEAD = struct.Struct("<IBBHIIII")       # magic, ver, flags, reserved,
+                                         # n_tasks, n_removed, n_world,
+                                         # n_handoffs (u32 counts: a
+                                         # production-scale ledger must
+                                         # never truncate silently)
+_WATERMARKS = struct.Struct("<qqqqqqQQ")  # seq, base_seq, incarnation,
+                                          # plan_seq, world_seq,
+                                          # next_task_id, ledger_digest,
+                                          # view_digest
+_TASK_FIXED = struct.Struct("<qBiiH")     # id, state, pickup, delivery,
+                                          # peer_len
+_REMOVED = struct.Struct("<q")
+_WORLD = struct.Struct("<iB")
+_HANDOFF_FIXED = struct.Struct("<iqqiiBBqiiH")  # dst, seq, epoch, pos,
+                                                # goal, phase, has_task,
+                                                # task_id, pickup,
+                                                # delivery, peer_len
+
+
+def enabled() -> bool:
+    """HA is OFF unless JG_HA is set truthy — the default keeps the
+    single-manager wire byte-identical (no mapd.ha frames at all)."""
+    return os.environ.get(KILL_ENV, "") not in ("", "0")
+
+
+def lease_ms() -> int:
+    try:
+        return int(os.environ.get(LEASE_MS_ENV, "") or DEFAULT_LEASE_MS)
+    except ValueError:
+        return DEFAULT_LEASE_MS
+
+
+class HaCodecError(ValueError):
+    """Malformed ledger1 blob (bad magic/version/lengths)."""
+
+
+class HaSeqGapError(RuntimeError):
+    """A delta arrived whose base_seq is not the replica's last applied
+    seq: a record was lost.  Owner must publish ``ha_resync_request``."""
+
+    def __init__(self, have_seq: int, base_seq: int):
+        super().__init__(f"ledger delta base_seq {base_seq} != last "
+                         f"applied {have_seq}")
+        self.have_seq = have_seq
+        self.base_seq = base_seq
+
+
+@dataclass(frozen=True)
+class LedgerTask:
+    """One replicated ledger entry.  ``peer`` is the assigned agent for
+    in-flight entries (state 1/2), empty for pending ones."""
+    task_id: int
+    state: int
+    pickup: int
+    delivery: int
+    peer: str = ""
+
+
+@dataclass(frozen=True)
+class HandoffOut:
+    """One UNACKED outbound cross-region handoff (the sender's outbox
+    entry, ISSUE 14's retransmit-until-ack record).  Replicated so a
+    promoted standby RESUMES the retransmit instead of losing a task
+    that was mid-transfer when the active died: the entry carries
+    everything needed to rebuild the exact original ``handoff1`` frame
+    (same seq + sender epoch, so the receiver's dedup guard keeps
+    working — an already-applied record re-acks, a lost one applies)."""
+    dst: int
+    seq: int
+    epoch: int
+    peer: str
+    pos: int
+    goal: int
+    phase: int = 0
+    task_id: Optional[int] = None
+    pickup: int = 0
+    delivery: int = 0
+
+
+@dataclass
+class LedgerRec:
+    """One replication record.  ``seq`` chains per active incarnation;
+    ``base_seq`` is 0 for snapshots, else the previous record's seq.
+    ``ledger_digest``/``view_digest`` are the ACTIVE's audit-canon
+    digests over its FULL post-record ledger (not just the delta) — the
+    replica recomputes and compares after every apply."""
+    seq: int
+    base_seq: int
+    incarnation: int
+    plan_seq: int
+    world_seq: int
+    next_task_id: int
+    snapshot: bool
+    tasks: List[LedgerTask] = field(default_factory=list)
+    removed: List[int] = field(default_factory=list)
+    world: List[Tuple[int, int]] = field(default_factory=list)
+    # the sender's FULL unacked handoff outbox (not a diff: it is tiny
+    # and short-lived, so every record that ships replaces the
+    # replica's view wholesale)
+    handoffs: List[HandoffOut] = field(default_factory=list)
+    ledger_digest: int = 0
+    view_digest: int = 0
+
+
+def ledger_view_digests(tasks: Iterable[LedgerTask]) -> Tuple[int, int,
+                                                              int, int]:
+    """``(ledger_digest, ledger_count, view_digest, view_count)`` over a
+    full ledger, using the audit canon (obs/audit.py) — the standby's
+    replica hashes equal to the active's beaconed digests iff they hold
+    the same ledger."""
+    tup = [(t.task_id, t.state, t.pickup, t.delivery) for t in tasks]
+    ld, lc = _audit.ledger_digest(tup)
+    vd, vc = _audit.view_digest(
+        [tid for tid, st, _, _ in tup if st != TASK_PENDING])
+    return ld, lc, vd, vc
+
+
+def encode_ledger(rec: LedgerRec) -> bytes:
+    if not (0 <= len(rec.tasks) < 1 << 32
+            and 0 <= len(rec.removed) < 1 << 32
+            and 0 <= len(rec.world) < 1 << 32
+            and 0 <= len(rec.handoffs) < 1 << 32):
+        raise HaCodecError("ledger1 section too large")
+    out = bytearray(_HEAD.pack(
+        LEDGER_MAGIC, LEDGER_VERSION,
+        FLAG_SNAPSHOT if rec.snapshot else 0, 0,
+        len(rec.tasks), len(rec.removed), len(rec.world),
+        len(rec.handoffs)))
+    out += _WATERMARKS.pack(rec.seq, rec.base_seq, rec.incarnation,
+                            rec.plan_seq, rec.world_seq,
+                            rec.next_task_id,
+                            rec.ledger_digest & ((1 << 64) - 1),
+                            rec.view_digest & ((1 << 64) - 1))
+    for t in rec.tasks:
+        peer = t.peer.encode()
+        if len(peer) >= 65536:
+            raise HaCodecError("ledger1 peer id too long")
+        out += _TASK_FIXED.pack(int(t.task_id), int(t.state) & 0xFF,
+                                int(t.pickup), int(t.delivery), len(peer))
+        out += peer
+    for tid in rec.removed:
+        out += _REMOVED.pack(int(tid))
+    for cell, blocked in rec.world:
+        out += _WORLD.pack(int(cell), 1 if blocked else 0)
+    for h in rec.handoffs:
+        peer = h.peer.encode()
+        if len(peer) >= 65536:
+            raise HaCodecError("ledger1 peer id too long")
+        out += _HANDOFF_FIXED.pack(
+            int(h.dst), int(h.seq), int(h.epoch), int(h.pos),
+            int(h.goal), int(h.phase) & 0xFF,
+            1 if h.task_id is not None else 0,
+            int(h.task_id or 0), int(h.pickup), int(h.delivery),
+            len(peer))
+        out += peer
+    return bytes(out)
+
+
+def decode_ledger(buf: bytes) -> LedgerRec:
+    if len(buf) < _HEAD.size + _WATERMARKS.size:
+        raise HaCodecError("short ledger1 blob")
+    magic, version, flags, _, n_tasks, n_removed, n_world, n_handoffs = \
+        _HEAD.unpack_from(buf, 0)
+    if magic != LEDGER_MAGIC:
+        raise HaCodecError(f"bad ledger1 magic 0x{magic:08x}")
+    if version != LEDGER_VERSION:
+        raise HaCodecError(f"unsupported ledger1 version {version}")
+    (seq, base_seq, incarnation, plan_seq, world_seq, next_task_id,
+     ledger_digest, view_digest) = _WATERMARKS.unpack_from(buf, _HEAD.size)
+    off = _HEAD.size + _WATERMARKS.size
+    tasks: List[LedgerTask] = []
+    for _ in range(n_tasks):
+        if off + _TASK_FIXED.size > len(buf):
+            raise HaCodecError("truncated ledger1 task section")
+        tid, state, pickup, delivery, peer_len = \
+            _TASK_FIXED.unpack_from(buf, off)
+        off += _TASK_FIXED.size
+        if off + peer_len > len(buf):
+            raise HaCodecError("truncated ledger1 peer id")
+        peer = buf[off:off + peer_len].decode()
+        off += peer_len
+        if state not in (TASK_PENDING, TASK_TO_PICKUP, TASK_TO_DELIVERY):
+            raise HaCodecError(f"bad ledger1 task state {state}")
+        tasks.append(LedgerTask(tid, state, pickup, delivery, peer))
+    if off + n_removed * _REMOVED.size + n_world * _WORLD.size > len(buf):
+        raise HaCodecError("truncated ledger1 removed/world sections")
+    removed = [_REMOVED.unpack_from(buf, off + k * _REMOVED.size)[0]
+               for k in range(n_removed)]
+    off += n_removed * _REMOVED.size
+    world = []
+    for k in range(n_world):
+        cell, blocked = _WORLD.unpack_from(buf, off + k * _WORLD.size)
+        world.append((cell, int(blocked)))
+    off += n_world * _WORLD.size
+    handoffs: List[HandoffOut] = []
+    for _ in range(n_handoffs):
+        if off + _HANDOFF_FIXED.size > len(buf):
+            raise HaCodecError("truncated ledger1 handoff section")
+        (dst, hseq, epoch, pos, goal, phase, has_task, task_id, pickup,
+         delivery, peer_len) = _HANDOFF_FIXED.unpack_from(buf, off)
+        off += _HANDOFF_FIXED.size
+        if off + peer_len > len(buf):
+            raise HaCodecError("truncated ledger1 handoff peer id")
+        peer = buf[off:off + peer_len].decode()
+        off += peer_len
+        handoffs.append(HandoffOut(
+            dst, hseq, epoch, peer, pos, goal, phase,
+            task_id if has_task else None, pickup, delivery))
+    if len(buf) != off:
+        raise HaCodecError(f"ledger1 length {len(buf)} != expected {off}")
+    return LedgerRec(seq=seq, base_seq=base_seq, incarnation=incarnation,
+                     plan_seq=plan_seq, world_seq=world_seq,
+                     next_task_id=next_task_id,
+                     snapshot=bool(flags & FLAG_SNAPSHOT), tasks=tasks,
+                     removed=removed, world=world, handoffs=handoffs,
+                     ledger_digest=ledger_digest, view_digest=view_digest)
+
+
+def encode_ledger_b64(rec: LedgerRec) -> str:
+    return base64.b64encode(encode_ledger(rec)).decode()
+
+
+def decode_ledger_b64(data: str) -> LedgerRec:
+    try:
+        raw = base64.b64decode(data, validate=True)
+    except Exception as e:
+        raise HaCodecError(f"bad ledger1 base64: {e}") from None
+    return decode_ledger(raw)
+
+
+class LedgerEncoder:
+    """Active-side delta tracking, mirrored natively in
+    cpp/common/ha.hpp LedgerEncoder.  Determinism contract (golden-
+    tested like PackedFleetEncoder): removed ids scan the shadow in
+    ascending task-id order; changed/added tasks follow the CALLER's
+    ledger order; world diffs are emitted sorted by cell ascending; a
+    snapshot ships the full ledger in caller order plus the full world
+    state sorted by cell, and resets the chain."""
+
+    def __init__(self, incarnation: int,
+                 snapshot_every: int = SNAPSHOT_EVERY):
+        self.incarnation = incarnation
+        self.snapshot_every = snapshot_every
+        self.shadow: Dict[int, LedgerTask] = {}
+        self.world_shadow: Dict[int, int] = {}
+        self.handoff_shadow: List[HandoffOut] = []
+        self.last_seq = 0
+        self.since_snapshot = 0
+        self.force_snapshot = True
+
+    def request_snapshot(self) -> None:
+        self.force_snapshot = True
+
+    def encode_tick(self, plan_seq: int, world_seq: int,
+                    next_task_id: int, tasks: Iterable[LedgerTask],
+                    world: Optional[Dict[int, int]] = None,
+                    handoffs: Optional[Iterable[HandoffOut]] = None
+                    ) -> Optional[LedgerRec]:
+        """One replication beat.  Returns None when nothing changed (and
+        no snapshot is due) — liveness rides the separate ``ha_lease``
+        frame, not empty records.  ``handoffs`` is the sender's FULL
+        unacked outbox, shipped wholesale in every emitted record (and
+        its change alone also triggers one)."""
+        tasks = list(tasks)
+        world = dict(world or {})
+        handoffs = sorted(handoffs or [], key=lambda h: (h.dst, h.seq))
+        ld, _, vd, _ = ledger_view_digests(tasks)
+        snapshot = (self.force_snapshot
+                    or self.since_snapshot + 1 >= self.snapshot_every)
+        if snapshot:
+            rec = LedgerRec(
+                seq=self.last_seq + 1, base_seq=0,
+                incarnation=self.incarnation, plan_seq=plan_seq,
+                world_seq=world_seq, next_task_id=next_task_id,
+                snapshot=True, tasks=tasks, removed=[],
+                world=sorted(world.items()), handoffs=handoffs,
+                ledger_digest=ld, view_digest=vd)
+            self.shadow = {t.task_id: t for t in tasks}
+            self.world_shadow = world
+            self.handoff_shadow = handoffs
+            self.last_seq = rec.seq
+            self.since_snapshot = 0
+            self.force_snapshot = False
+            return rec
+        current = {t.task_id for t in tasks}
+        removed = sorted(tid for tid in self.shadow if tid not in current)
+        changed = [t for t in tasks if self.shadow.get(t.task_id) != t]
+        world_diff = sorted((c, b) for c, b in world.items()
+                            if self.world_shadow.get(c) != b)
+        if not removed and not changed and not world_diff \
+                and handoffs == self.handoff_shadow:
+            return None
+        rec = LedgerRec(
+            seq=self.last_seq + 1, base_seq=self.last_seq,
+            incarnation=self.incarnation, plan_seq=plan_seq,
+            world_seq=world_seq, next_task_id=next_task_id,
+            snapshot=False, tasks=changed, removed=removed,
+            world=world_diff, handoffs=handoffs,
+            ledger_digest=ld, view_digest=vd)
+        for tid in removed:
+            del self.shadow[tid]
+        for t in changed:
+            self.shadow[t.task_id] = t
+        for c, b in world_diff:
+            self.world_shadow[c] = b
+        self.handoff_shadow = handoffs
+        self.last_seq = rec.seq
+        self.since_snapshot += 1
+        return rec
+
+
+class LedgerReplica:
+    """Standby-side mirror of the active's ledger.  ``apply`` enforces
+    the chain (gap -> :class:`HaSeqGapError`; the owner publishes
+    ``ha_resync_request`` and the active answers with a snapshot — the
+    plan wire's snapshot-resync path, reused), handles incarnation
+    moves (newer active: reset and demand a snapshot; older: drop), and
+    verifies the record's full-ledger digests against its own
+    recomputation."""
+
+    def __init__(self):
+        self.tasks: Dict[int, LedgerTask] = {}
+        self.world: Dict[int, int] = {}
+        # the active's unacked handoff outbox as last shipped — a
+        # promoted standby resumes retransmitting exactly these
+        self.handoffs: List[HandoffOut] = []
+        self.seq = 0
+        self.incarnation = 0
+        self.plan_seq = 0
+        self.world_seq = 0
+        self.next_task_id = 0
+        self.applied = 0
+        self.divergences = 0
+        self.stale_dropped = 0
+
+    def apply(self, rec: LedgerRec) -> bool:
+        """Apply one record.  True = applied and digest-verified; False
+        = applied but the recomputed digests disagreed with the record's
+        (the replica must resync, never promote on this state).  Raises
+        :class:`HaSeqGapError` on a chain break (including a NEW
+        incarnation opening with a delta)."""
+        if self.incarnation and rec.incarnation < self.incarnation:
+            # a delayed frame from a dead incarnation: never apply
+            self.stale_dropped += 1
+            return True
+        if rec.incarnation > self.incarnation:
+            # the active restarted (or a standby promoted): its chain
+            # starts over — a delta against the OLD chain is a gap
+            self.tasks.clear()
+            self.world.clear()
+            self.handoffs = []
+            self.seq = 0
+            self.incarnation = rec.incarnation
+            if not rec.snapshot:
+                raise HaSeqGapError(0, rec.base_seq)
+        if rec.snapshot:
+            self.tasks = {t.task_id: t for t in rec.tasks}
+            self.world = dict(rec.world)
+        else:
+            if rec.base_seq != self.seq:
+                raise HaSeqGapError(self.seq, rec.base_seq)
+            for tid in rec.removed:
+                self.tasks.pop(tid, None)
+            for t in rec.tasks:
+                self.tasks[t.task_id] = t
+            for cell, blocked in rec.world:
+                self.world[cell] = blocked
+        self.handoffs = list(rec.handoffs)  # wholesale, every record
+        self.seq = rec.seq
+        self.plan_seq = rec.plan_seq
+        self.world_seq = rec.world_seq
+        self.next_task_id = rec.next_task_id
+        self.applied += 1
+        ld, _, vd, _ = ledger_view_digests(self.tasks.values())
+        ok = (ld == rec.ledger_digest and vd == rec.view_digest)
+        if not ok:
+            self.divergences += 1
+        return ok
+
+    def digests(self) -> dict:
+        """The replica's audit-canon digests — what the promoted
+        standby announces at the takeover watermark."""
+        ld, lc, vd, vc = ledger_view_digests(self.tasks.values())
+        return {"ledger": _audit.digest_hex(ld), "ledger_count": lc,
+                "view": _audit.digest_hex(vd), "view_count": vc,
+                "seq": self.seq, "plan_seq": self.plan_seq,
+                "world_seq": self.world_seq}
+
+    def inflight(self) -> List[LedgerTask]:
+        return [t for t in self.tasks.values()
+                if t.state != TASK_PENDING]
+
+    def pending(self) -> List[LedgerTask]:
+        return [t for t in self.tasks.values()
+                if t.state == TASK_PENDING]
+
+
+class LeaseMonitor:
+    """The standby's view of the active's liveness lease — the
+    auditor's silent-peer rule (obs/audit.py): quiet past 3 of the
+    active's own advertised intervals plus a 1 s absolute grace."""
+
+    def __init__(self):
+        self.peer = ""
+        self.incarnation = 0
+        self.interval_ms = DEFAULT_LEASE_MS
+        self.last_ms = 0
+        self.repl_seq = 0
+
+    def note(self, peer: str, incarnation: int, now_ms: int,
+             interval_ms: Optional[int] = None,
+             repl_seq: Optional[int] = None) -> None:
+        """Any authenticated-enough sign of life from the active (a
+        lease frame or a ledger1 record) renews the lease.  A LOWER
+        incarnation than the freshest seen never renews — a zombie's
+        heartbeats must not keep a standby from promoting past it."""
+        if self.incarnation and incarnation < self.incarnation:
+            return
+        self.peer = peer
+        self.incarnation = incarnation
+        self.last_ms = now_ms
+        if interval_ms:
+            self.interval_ms = int(interval_ms)
+        if repl_seq is not None:
+            self.repl_seq = int(repl_seq)
+
+    def expired(self, now_ms: int) -> bool:
+        """True once the active has been silent past the rule.  Never
+        expires before the first sign of life — promotion from cold
+        start is the caller's (longer) grace, not a lease expiry."""
+        if not self.last_ms:
+            return False
+        return now_ms - self.last_ms > 3 * self.interval_ms + 1000
+
+
+def takeover_digests_equal(rec: dict) -> Optional[bool]:
+    """The one rule every judge of an ``ha_takeover`` frame applies:
+    True iff the promoted standby's self-computed ledger/view digests
+    equal the failed active's last shipped ones.  None when the frame
+    carries NO active digests at all (a cold-start takeover — nothing
+    was ever shipped, so there is nothing to compare; rendering that as
+    'differ' would invent a replica divergence)."""
+    if not rec.get("active_ledger_digest"):
+        return None
+    return (rec.get("ledger_digest") == rec.get("active_ledger_digest")
+            and rec.get("view_digest") == rec.get("active_view_digest"))
+
+
+def should_demote(my_incarnation: int, my_peer: str,
+                  other_incarnation: int, other_peer: str) -> bool:
+    """The split-brain guard: between two claimants of one active role,
+    the LOWER ``(incarnation, peer_id)`` demotes.  Both sides apply the
+    same rule to the same announcements, so exactly one yields — an
+    old-incarnation active resuming after a takeover always loses to
+    the promoted standby's bumped incarnation."""
+    return (other_incarnation, other_peer) > (my_incarnation, my_peer)

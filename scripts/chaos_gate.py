@@ -48,6 +48,7 @@ import signal
 import sys
 import time
 from pathlib import Path
+from typing import Optional
 
 ROOT = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(ROOT))
@@ -169,13 +170,26 @@ class ManagerHandoffKill(Fault):
     the silent manager, the surviving neighbor must NOT double-dispatch
     (no uncaptured completion, no ledger overcount — the handoff dedup
     guard under fire), and the handoff protocol must actually have been
-    exercised (handoffs_sent >= 1).  Tasks whose region of record died
-    MAY strand — reviving a manager's ledger is control-plane HA
-    (ROADMAP item 1), not federation."""
+    exercised (handoffs_sent >= 1).
+
+    Verdict modes (ISSUE 15): without a standby (``ha=False``, the
+    JG_HA=0 legacy row) tasks whose region of record died MAY strand —
+    the row demands detection only.  With a standby configured the row
+    is RECOVERY-REQUIRED: the dead region's warm standby must promote
+    (digest-equal takeover watermark) and every captured task must
+    complete exactly once — zero lost, zero duplicated."""
 
     kind = "manager_handoff_kill"
     needs_regions = "2x1"
     extra_drain_s = 25.0
+
+    def __init__(self, at_s: float, ha: bool = False):
+        super().__init__(at_s)
+        self.ha = ha
+        if ha:
+            # the promoted standby needs the lease-expiry window plus a
+            # sweep-hold before re-queued tasks can finish
+            self.extra_drain_s = 45.0
 
     def fire(self, ctx) -> None:
         victim = ctx.managers[1]
@@ -185,6 +199,30 @@ class ManagerHandoffKill(Fault):
         except Exception:
             pass
         ctx.note(f"SIGKILLed region-1 manager at t={self.fired_at}s")
+
+
+class ManagerKillFailover(Fault):
+    """ISSUE 15: SIGKILL the (flat fleet's) active manager mid-window
+    with a warm standby configured.  The contract is full recovery:
+    the auditor must confirm the silent active (detection), the standby
+    must promote inside one claim window announcing ledger/view digests
+    EQUAL to the active's last shipped ones (the takeover watermark
+    proof), and every captured task must complete exactly once — zero
+    lost, zero duplicated (the promoted manager's restore-hold +
+    unknown-done dedup under fire)."""
+
+    kind = "manager_kill_failover"
+    ha = True
+    extra_drain_s = 45.0
+
+    def fire(self, ctx) -> None:
+        ctx.manager.send_signal(signal.SIGKILL)
+        try:
+            ctx.manager.wait(timeout=10)
+        except Exception:
+            pass
+        ctx.note(f"SIGKILLed the active manager at t={self.fired_at}s "
+                 "(warm standby must take over)")
 
 
 class PeerPartition(Fault):
@@ -212,12 +250,16 @@ class PeerPartition(Fault):
 
 FAULT_KINDS = ("clean", "bus_shard_kill", "solverd_sigkill",
                "manager_sigstop", "peer_partition",
-               "manager_handoff_kill")
+               "manager_handoff_kill", "manager_kill_failover")
 
 
-def build_fault(kind: str, capture: dict) -> Fault:
+def build_fault(kind: str, capture: dict,
+                ha: Optional[bool] = None) -> Fault:
     """Instantiate a fault scheduled relative to the capture's own
-    duration (mid-window: the fleet is busiest there)."""
+    duration (mid-window: the fleet is busiest there).  ``ha`` arms the
+    warm-standby rows; for ``manager_handoff_kill`` it defaults to the
+    JG_HA env so the same row name upgrades from detection-only to
+    recovery-required when a standby is configured (ISSUE 15)."""
     dur_s = capture["duration_ms"] / 1000.0
     mid = max(1.0, 0.4 * dur_s)
     if kind == "clean":
@@ -231,7 +273,11 @@ def build_fault(kind: str, capture: dict) -> Fault:
     if kind == "peer_partition":
         return PeerPartition(at_s=mid)
     if kind == "manager_handoff_kill":
-        return ManagerHandoffKill(at_s=mid)
+        if ha is None:
+            ha = os.environ.get("JG_HA", "") not in ("", "0")
+        return ManagerHandoffKill(at_s=mid, ha=ha)
+    if kind == "manager_kill_failover":
+        return ManagerKillFailover(at_s=mid)
     raise SystemExit(f"unknown fault {kind!r} (one of {FAULT_KINDS})")
 
 
@@ -271,6 +317,66 @@ def _proc_of(res: dict, peer: str) -> str:
         "proc") or ""
 
 
+def _ha_takeover_checks(res: dict, reasons: list) -> dict:
+    """Shared failover evidence (ISSUE 15): exactly >= 1 takeover must
+    have been announced, and the promoted standby's ledger/view digests
+    must equal the failed active's last shipped ones at the takeover
+    watermark.  Appends failures to ``reasons``; returns the evidence."""
+    takeovers = (res.get("ha") or {}).get("takeovers") or []
+    if not takeovers:
+        reasons.append("no ha_takeover was ever announced — the "
+                       "standby never promoted")
+        return {"takeovers": 0, "digests_equal": None,
+                "takeover_latency_s": None}
+    bad = [t for t in takeovers if not t["digests_equal"]]
+    if bad:
+        reasons.append("takeover watermark digests DIFFER from the "
+                       f"failed active's last shipped ones: {bad}")
+    fired = (res.get("chaos") or {}).get("fired_at_s")
+    latency = None
+    if fired is not None:
+        latency = round(min(t["t_rel_s"] for t in takeovers) - fired, 2)
+    return {"takeovers": len(takeovers),
+            "digests_equal": not bad,
+            "takeover_latency_s": latency}
+
+
+def classify_kill_failover(res: dict) -> dict:
+    """The warm-standby failover verdict (ISSUE 15): full recovery —
+    detection (silent manager confirmed), digest-equal takeover, and
+    the exact-once outcome ledger (zero lost, zero duplicated)."""
+    reasons = []
+    confirmed = res["audit"]["confirmed"]
+    overcount = max(0, res.get("mgr_completed", 0) - res["expected"])
+    if res["missing"]:
+        reasons.append(f"{len(res['missing'])} task(s) lost across the "
+                       f"failover: {res['missing'][:8]}")
+    if res["extra_done"]:
+        reasons.append(f"uncaptured task id(s) completed: "
+                       f"{res['extra_done'][:8]}")
+    if overcount:
+        reasons.append(f"manager ledger double-counted {overcount} "
+                       "completion(s) across the takeover")
+    silent_mgr = [d for d in confirmed if d["class"] == "silent"
+                  and _proc_of(res, d.get("peer_a") or "").startswith(
+                      "manager")]
+    detected = bool(silent_mgr)
+    if not detected:
+        reasons.append("auditor never confirmed the silent active — "
+                       "the kill went undetected")
+    ha_ev = _ha_takeover_checks(res, reasons)
+    return {"fault": "manager_kill_failover",
+            "verdict": "green" if not reasons else "red",
+            "outcome_ok": not res["missing"] and not res["extra_done"]
+            and not overcount,
+            "healed": bool(ha_ev["takeovers"]),
+            "detected": detected, "localized": detected,
+            "ha": ha_ev,
+            "confirmed_divergences": confirmed,
+            "slo": {"ok": not reasons, "failed": []},
+            "reasons": reasons}
+
+
 def classify(kind: str, res: dict) -> dict:
     """The chaos verdict for one replayed fault: green iff the outcome
     ledger is intact, required detection fired and NAMED the faulted
@@ -278,6 +384,8 @@ def classify(kind: str, res: dict) -> dict:
     watermark (reconvergence), and the SLO engine passes."""
     if kind == "manager_handoff_kill":
         return classify_handoff_kill(res)
+    if kind == "manager_kill_failover":
+        return classify_kill_failover(res)
     reasons = []
     confirmed = res["audit"]["confirmed"]
     red_confirmed = [d for d in confirmed
@@ -331,10 +439,7 @@ def classify(kind: str, res: dict) -> dict:
 
 
 def classify_handoff_kill(res: dict) -> dict:
-    """The federated-kill verdict (ISSUE 14): a dead region manager may
-    strand ITS OPEN tasks (reviving a ledger is ROADMAP item 1's HA, not
-    federation) — so the red lines here are DUPLICATION and blindness,
-    not completeness:
+    """The federated-kill verdict (ISSUE 14 + ISSUE 15):
 
     - the auditor must confirm a silent MANAGER episode (detection +
       localization; the dead peer never heals, so that record staying
@@ -343,11 +448,19 @@ def classify_handoff_kill(res: dict) -> dict:
       completes, the dedup-guarded ledger never overcounts;
     - the handoff protocol must actually have been exercised
       (handoffs_sent >= 1 — a kill before any border crossing tests
-      nothing) and the surviving region must still complete tasks."""
+      nothing) and the surviving region must still complete tasks.
+
+    Without a standby (JG_HA=0) a dead region manager may strand ITS
+    OPEN tasks and the row stays detection-only.  With a standby
+    configured the row is RECOVERY-REQUIRED: the dead region's warm
+    standby must promote with a digest-equal takeover watermark and
+    every captured task must complete exactly once."""
     reasons = []
     confirmed = res["audit"]["confirmed"]
     overcount = max(0, res.get("mgr_completed", 0) - res["expected"])
     fed = res.get("federation") or {}
+    ha_on = bool((res.get("ha") or {}).get("enabled"))
+    ha_ev = None
     if res["extra_done"]:
         reasons.append(f"uncaptured task id(s) completed: "
                        f"{res['extra_done'][:8]}")
@@ -358,6 +471,13 @@ def classify_handoff_kill(res: dict) -> dict:
         reasons.append("no handoff ever fired — the kill tested nothing")
     if res["completed"] < 1:
         reasons.append("the surviving region completed no task at all")
+    if ha_on:
+        # recovery-required (ISSUE 15): the dead region's open tasks
+        # must complete via the promoted manager — zero lost
+        if res["missing"]:
+            reasons.append(f"{len(res['missing'])} task(s) lost despite "
+                           f"a standby: {res['missing'][:8]}")
+        ha_ev = _ha_takeover_checks(res, reasons)
     silent_mgr = [d for d in confirmed if d["class"] == "silent"
                   and _proc_of(res, d.get("peer_a") or "").startswith(
                       "manager")]
@@ -377,9 +497,12 @@ def classify_handoff_kill(res: dict) -> dict:
                        f"active at the final watermark: {other_red}")
     return {"fault": "manager_handoff_kill",
             "verdict": "green" if not reasons else "red",
-            "outcome_ok": not res["extra_done"] and not overcount,
+            "outcome_ok": not res["extra_done"] and not overcount
+            and (not ha_on or not res["missing"]),
             "healed": not other_red,
             "detected": detected, "localized": detected,
+            "ha": ha_ev,
+            "recovery_required": ha_on,
             "handoffs_sent": fed.get("handoffs_sent"),
             "handoffs_dup_dropped": fed.get("handoffs_dup_dropped"),
             "confirmed_divergences": confirmed,
@@ -425,12 +548,14 @@ def determinism_verdict(a: dict, b: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 def run_matrix(capture: dict, faults, log_dir, no_trace: bool,
-               drain_s=None, solver_override=None) -> dict:
+               drain_s=None, solver_override=None,
+               ha_kinds=()) -> dict:
     from analysis import fleetsim
 
     rows = []
     for i, kind in enumerate(faults):
-        fault = build_fault(kind, capture)
+        fault = build_fault(kind, capture,
+                            ha=True if kind in ha_kinds else None)
         solver = (solver_override or capture["fleet"].get("solver")
                   or "native")
         if fault.needs_solverd:
@@ -438,15 +563,18 @@ def run_matrix(capture: dict, faults, log_dir, no_trace: bool,
         shards = max(int(capture["fleet"].get("shards") or 1),
                      fault.needs_shards)
         regions = getattr(fault, "needs_regions", None)
+        # the warm-standby rows (ISSUE 15) replay with JG_HA=1 pairs
+        ha = bool(getattr(fault, "ha", False))
         print(f"chaos_gate: [{i + 1}/{len(faults)}] fault={kind} "
               f"solver={solver} shards={shards}"
-              + (f" regions={regions}" if regions else ""), flush=True)
+              + (f" regions={regions}" if regions else "")
+              + (" ha" if ha else ""), flush=True)
         t0 = time.monotonic()
         res = fleetsim.run_replay(
             capture, log_dir, solver=solver, shards=shards,
             no_trace=no_trace, drain_s=drain_s,
             chaos=None if kind == "clean" else fault,
-            label=f"{i}_{kind}", regions=regions)
+            label=f"{i}_{kind}", regions=regions, ha=ha)
         verdict = classify(kind, res)
         verdict["fault_detail"] = fault.summary()
         verdict["elapsed_s"] = round(time.monotonic() - t0, 1)
@@ -455,7 +583,7 @@ def run_matrix(capture: dict, faults, log_dir, no_trace: bool,
                               "extra_done", "done_dups",
                               "mgr_completed", "window_tasks_per_s",
                               "drift", "wall_s", "digests",
-                              "federation", "chaos_notes")}
+                              "federation", "ha", "chaos_notes")}
         rows.append((verdict, res))
         print(f"chaos_gate: {kind} -> {verdict['verdict'].upper()}"
               + (f" ({'; '.join(verdict['reasons'])})"
@@ -543,18 +671,23 @@ def main(argv=None) -> int:
         return 2
 
     faults = [f.strip() for f in args.faults.split(",") if f.strip()]
+    ha_kinds = set()
     if args.ci:
-        # the CI matrix (ISSUE 11 + ISSUE 14): determinism pair, the
-        # solverd kill that MUST be detected, and the federated
-        # manager kill that must neither go blind nor double-dispatch
+        # the CI matrix (ISSUE 11 + 14 + 15): determinism pair, the
+        # solverd kill that MUST be detected, the flat SIGKILL-the-
+        # active failover that MUST recover (warm standby, digest-equal
+        # takeover, exact-once), and the federated manager kill —
+        # recovery-required too now that every region pair has a
+        # standby
         faults = ["clean", "clean", "solverd_sigkill",
-                  "manager_handoff_kill"]
+                  "manager_kill_failover", "manager_handoff_kill"]
+        ha_kinds = {"manager_kill_failover", "manager_handoff_kill"}
     elif args.determinism:
         faults = ["clean"] + faults
 
     rows = run_matrix(capture, faults, args.log_dir,
                       no_trace=not args.trace, drain_s=args.drain_s,
-                      solver_override=args.solver)
+                      solver_override=args.solver, ha_kinds=ha_kinds)
 
     determinism = None
     clean_results = [res for v, res in rows if v["fault"] == "clean"]
@@ -593,6 +726,12 @@ def main(argv=None) -> int:
         hk = next(v for v, _ in rows
                   if v["fault"] == "manager_handoff_kill")
         ok = ok and hk["detected"] and bool(hk.get("handoffs_sent"))
+        # the failover acceptance (ISSUE 15): takeover announced with
+        # digest-equal watermark AND nothing lost or duplicated
+        fo = next(v for v, _ in rows
+                  if v["fault"] == "manager_kill_failover")
+        ok = ok and fo["detected"] and fo["outcome_ok"] \
+            and bool((fo.get("ha") or {}).get("digests_equal"))
     print(json.dumps({"faults": faults,
                       "verdicts": {v["fault"]: v["verdict"]
                                    for v, _ in rows},

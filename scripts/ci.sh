@@ -40,6 +40,8 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/obs/audit.py \
     scripts/audit_smoke.py \
     scripts/chaos_gate.py \
+    p2p_distributed_tswap_tpu/runtime/ha.py \
+    scripts/ha_smoke.py \
     p2p_distributed_tswap_tpu/obs/capture.py \
     analysis/fleetsim.py \
     analysis/tenant_scaling.py \
@@ -218,6 +220,23 @@ PY
          "detected + localized, unknown version rejected)"
 else
     echo "replay + chaos gate SKIPPED (no C++ toolchain / binaries)"
+fi
+
+echo "== HA failover smoke =="
+# ISSUE 15: a live fleet with a warm standby — SIGKILL the active
+# mid-flight; the standby must promote inside one claim window with
+# ledger/view digests EQUAL to the active's last shipped ones, the
+# auditor must confirm the silent active, and every injected task must
+# complete exactly once (zero lost, zero duplicated).  The federated
+# variant (2x1: kill one region's active) rides the chaos gate above
+# as the recovery-required manager_handoff_kill row.
+if [[ -x cpp/build/mapd_bus && -x cpp/build/mapd_manager_centralized ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    JAX_PLATFORMS=cpu python scripts/ha_smoke.py \
+        --log-dir /tmp/jg_ha_ci_logs
+else
+    echo "HA failover smoke SKIPPED (no C++ toolchain / binaries)"
 fi
 
 echo "== federation smoke =="

@@ -301,6 +301,142 @@ def audit_fuzz(seed: int, count: int = 80) -> bool:
     return True
 
 
+def ledger_fuzz(seed: int, ticks: int = 24) -> bool:
+    """Random replication streams (ISSUE 15 "ledger1"): py round-trip,
+    the replica applies + digest-verifies every record, malformed blobs
+    are rejected on both sides, and the native encoder emits
+    byte-identical records for the same ledger evolution.  Returns
+    False when the golden binary is unavailable (pure-python checks
+    still ran)."""
+    import json as _json
+
+    from p2p_distributed_tswap_tpu.runtime import ha
+
+    rng = np.random.default_rng(seed)
+    enc = ha.LedgerEncoder(incarnation=int(rng.integers(1, 1 << 44)),
+                           snapshot_every=3 + seed % 5)
+    rep = ha.LedgerReplica()
+    tasks = {}
+    world = {}
+    outbox = {}  # (dst, seq) -> HandoffOut: the unacked handoff view
+    hseq = 0
+    nid = 1
+    script, py_out = [], []
+    for tick in range(1, ticks + 1):
+        # evolve the handoff outbox: sends and acks (ISSUE 15 — the
+        # replicated retransmit state a promoted standby resumes)
+        if rng.random() < 0.25:
+            hseq += 1
+            dst = int(rng.integers(0, 4))
+            outbox[(dst, hseq)] = ha.HandoffOut(
+                dst, hseq, int(rng.integers(1, 1 << 44)),
+                f"hpeer{int(rng.integers(1, 9))}",
+                int(rng.integers(0, 1 << 16)),
+                int(rng.integers(0, 1 << 16)),
+                int(rng.integers(0, 3)),
+                int(rng.integers(1, 1 << 40))
+                if rng.random() < 0.8 else None,
+                int(rng.integers(0, 1 << 16)),
+                int(rng.integers(0, 1 << 16)))
+        if outbox and rng.random() < 0.3:
+            outbox.pop(sorted(outbox)[int(rng.integers(len(outbox)))])
+        # evolve the ledger: births, state moves, completions, toggles
+        for _ in range(int(rng.integers(0, 3))):
+            tasks[nid] = ha.LedgerTask(
+                nid, int(rng.integers(0, 3)),
+                int(rng.integers(0, 1 << 17)),
+                int(rng.integers(0, 1 << 17)),
+                f"peer{int(rng.integers(1, 9))}"
+                if rng.random() < 0.7 else "")
+            nid += 1
+        for tid in list(tasks):
+            r = rng.random()
+            if r < 0.15:
+                del tasks[tid]
+            elif r < 0.4:
+                t = tasks[tid]
+                tasks[tid] = ha.LedgerTask(
+                    tid, int(rng.integers(0, 3)), t.pickup, t.delivery,
+                    t.peer)
+        if rng.random() < 0.3:
+            world[int(rng.integers(0, 1 << 16))] = int(rng.integers(0, 2))
+        force = rng.random() < 0.1
+        if force:
+            enc.request_snapshot()
+        # pending entries carry no peer on the real wire
+        cur = [ha.LedgerTask(t.task_id, t.state, t.pickup, t.delivery,
+                             "" if t.state == ha.TASK_PENDING else t.peer)
+               for t in tasks.values()]
+        script.append({"plan": tick, "world_seq": len(world),
+                       "next": nid, "force_snapshot": force,
+                       "tasks": [[t.task_id, t.state, t.pickup,
+                                  t.delivery, t.peer] for t in cur],
+                       "world": sorted([c, b] for c, b in world.items()),
+                       "handoffs": [[h.dst, h.seq, h.epoch, h.peer,
+                                     h.pos, h.goal, h.phase, h.task_id,
+                                     h.pickup, h.delivery]
+                                    for h in outbox.values()]})
+        rec = enc.encode_tick(tick, len(world), nid, cur, world,
+                              outbox.values())
+        if rec is None:
+            py_out.append("null")
+            continue
+        b64 = ha.encode_ledger_b64(rec)
+        py_out.append(b64)
+        back = ha.decode_ledger_b64(b64)
+        assert ha.encode_ledger_b64(back) == b64, \
+            f"ledger seed {seed} tick {tick}: py round-trip diverged"
+        # the replica applies the stream and must digest-verify: the
+        # record's full-ledger digests equal its own recomputation
+        assert rep.apply(back) is True, \
+            f"ledger seed {seed} tick {tick}: replica digest diverged"
+        assert sorted(rep.tasks) == sorted(t.task_id for t in cur), \
+            f"ledger seed {seed} tick {tick}: replica ledger diverged"
+        raw = ha.encode_ledger(rec)
+        for bad in (raw[:13], b"\xff" + raw[1:], raw + b"\x00",
+                    raw[:-1], b""):
+            try:
+                ha.decode_ledger(bad)
+            except ha.HaCodecError:
+                continue
+            raise AssertionError(
+                f"ledger seed {seed} tick {tick}: bad blob accepted")
+    binary = _golden_binary()
+    if binary is None:
+        return False
+    script[0]["inc"] = enc.incarnation
+    script[0]["snapshot_every"] = enc.snapshot_every
+    feed = "\n".join(_json.dumps(line) for line in script) + "\n"
+    out = subprocess.run([str(binary), "--ledger-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert out.stdout.split() == py_out, \
+        f"ledger seed {seed}: cpp encoder bytes diverged"
+    # native decode round-trips py bytes; malformed b64 reads null
+    real = [b for b in py_out if b != "null"]
+    out = subprocess.run([str(binary), "--ledger-decode"],
+                         input="\n".join(real + ["AAAA"]) + "\n",
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    lines = out.stdout.splitlines()
+    assert lines[-1] == "null", \
+        f"ledger seed {seed}: cpp accepted a malformed blob"
+    for b64, got in zip(real, lines):
+        g = _json.loads(got)
+        back = ha.decode_ledger_b64(b64)
+        assert g["seq"] == back.seq and g["snapshot"] == back.snapshot \
+            and g["tasks"] == [[t.task_id, t.state, t.pickup, t.delivery,
+                                t.peer] for t in back.tasks] \
+            and g["removed"] == back.removed \
+            and g["world"] == [list(w) for w in back.world] \
+            and g["handoffs"] == [[h.dst, h.seq, h.epoch, h.peer, h.pos,
+                                   h.goal, h.phase, h.task_id, h.pickup,
+                                   h.delivery]
+                                  for h in back.handoffs], \
+            f"ledger seed {seed}: cpp decoder diverged"
+    return True
+
+
 def golden_fuzz(lines_by_seed: dict) -> bool:
     binary = _golden_binary()
     if binary is None:
@@ -409,6 +545,13 @@ def main() -> int:
     else:
         print("audit1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
               file=sys.stderr)
+    ledger_native = all([ledger_fuzz(seed) for seed in range(args.seeds)])
+    if ledger_native:
+        print(f"ledger1 fuzz: {args.seeds} seeds replica-verified, cpp "
+              "byte-identical, malformed rejected")
+    else:
+        print("ledger1 fuzz: py round-trip OK; cpp SKIPPED "
+              "(no g++/binary)", file=sys.stderr)
     if not args.skip_plans:
         for seed in range(2):
             plan_fuzz(seed, ticks=6)

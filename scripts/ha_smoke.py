@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""HA failover smoke (ISSUE 15, scripts/ci.sh): the live takeover proof.
+
+Brings up a fleet with a warm standby per region (active manager(s)
+shipping the ledger1 replication stream under JG_HA=1), drives explicit
+open-loop tasks through it, SIGKILLs an active MID-FLIGHT, and judges
+the takeover:
+
+- **exact-once**: every injected task completes (zero lost — tasks in
+  flight at the kill survive through the promoted standby's restore
+  hold), no uncaptured id completes, and the managers' dedup-guarded
+  completion counters never exceed the injected count (zero
+  duplicated);
+- **digest-equal takeover watermark**: the promoted standby's
+  ``ha_takeover`` announcement must carry ledger/view digests EQUAL to
+  the failed active's last shipped ones (the audit-canon equality the
+  acceptance is judged on);
+- **inside one claim window**: kill -> takeover announcement must land
+  within ``--claim-window-s`` (default 5 s, the task-resend grace);
+- **detection**: the auditor must confirm the silent active.
+
+``--regions 2x1`` runs the federated variant: two (manager, standby)
+pairs, world-spanning tasks, region 1's active killed — the dead
+region's open tasks must complete via its promoted standby.
+
+``--out FILE`` writes a JSON artifact (takeover latency, replication
+stream overhead bytes/s, outcome ledger) — bench.py's ``ha`` axis and
+``results/ha_failover_r16.json`` consume it.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/ha_smoke.py
+  JAX_PLATFORMS=cpu python scripts/ha_smoke.py --regions 2x1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from p2p_distributed_tswap_tpu.obs import audit as _audit  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import registry as _reg  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import buspool  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import ha as _ha  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import region as regionlib  # noqa: E402,E501
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402,E501
+from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
+    BUILD_DIR, ensure_built)
+from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool  # noqa: E402,E501
+
+from analysis.fleetsim import MetricsWindow  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--regions", default="1",
+                    help="'1' = flat (kill THE active); 'CxR' = "
+                         "federated (kill the last region's active)")
+    ap.add_argument("--agents", type=int, default=6)
+    ap.add_argument("--side", type=int, default=16)
+    ap.add_argument("--tasks", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--claim-window-s", type=float, default=5.0,
+                    help="the takeover budget: kill -> ha_takeover "
+                         "(one task-resend claim window)")
+    ap.add_argument("--drain-s", type=float, default=90.0)
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the artifact JSON here")
+    ap.add_argument("--log-dir", default="/tmp/jg_ha_smoke")
+    args = ap.parse_args(argv)
+
+    ensure_built()
+    cols, rows = regionlib.fed_parse_spec(args.regions)
+    total = cols * rows
+    side = args.side
+    map_file = f"/tmp/ha_smoke_{side}.map.txt"
+    Path(map_file).write_text("\n".join(["." * side] * side) + "\n")
+    log_dir = Path(args.log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    port = buspool.free_port()
+    saved_env = dict(os.environ)
+    procs, logs = [], []
+
+    def spawn(name, cmd, stdin=None):
+        log = open(log_dir / f"{name}.log", "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, stdin=stdin, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             env=dict(os.environ))
+        procs.append(p)
+        return p
+
+    pool = watch = sim = tap = None
+    _reg.get_registry().clear()
+    try:
+        pool = buspool.BusPool(BUILD_DIR / "mapd_bus", num_shards=1,
+                               home_port=port, spawn=spawn)
+        time.sleep(0.3)
+        os.environ.update(pool.env())
+        os.environ["JG_HA"] = "1"
+        # fast audit cadence: the silent-active detection must land
+        # inside the smoke budget
+        os.environ.setdefault("JG_AUDIT_INTERVAL_MS", "500")
+        os.environ.setdefault("JG_AUDIT_INTERVAL_S", "0.5")
+        mgrs, stbys = [], []
+        for rid in range(total):
+            tag = f"_r{rid}" if total > 1 else ""
+            cmd = [str(BUILD_DIR / "mapd_manager_centralized"),
+                   "--port", str(port), "--map", map_file,
+                   "--solver", "cpu", "--planning-interval-ms", "150",
+                   *regionlib.fed_cli_args(rid, cols, rows, "manager"),
+                   "--seed", str(args.seed + rid),
+                   "--open-loop", "--ha", "1"]
+            mgrs.append(spawn(f"manager{tag}", cmd,
+                              stdin=subprocess.PIPE))
+            stbys.append(spawn(f"standby{tag}", cmd + ["--standby"],
+                               stdin=subprocess.PIPE))
+        time.sleep(0.8)
+        sim = SimAgentPool(args.agents, side, port=port, seed=args.seed,
+                           heartbeat_s=1.0)
+        watch = MetricsWindow(port, audit=True)
+        # the smoke's own HA tap: takeover announcements + replication
+        # stream accounting (frame sizes -> bytes/s overhead)
+        tap = BusClient(port=port, peer_id="ha-smoke-tap")
+        tap.subscribe(_ha.HA_TOPIC, raw=True)
+        sim.heartbeat_all()
+        sim.pump(2.0)
+        watch.pump(0.5)
+
+        # explicit task set: ids + endpoints, spread over the world (in
+        # a federated run: tasks whose pickup the victim region owns
+        # MUST survive its death)
+        tasks = []
+        for k in range(args.tasks):
+            px = 1 + (k * 3) % (side - 2)
+            py = 1 + (k * 5) % (side - 2)
+            dx = side - 2 - (k * 3) % (side - 3)
+            dy = side - 2 - (k * 7) % (side - 3)
+            rid = regionlib.fed_region_of(px, py, cols, rows, side, side)
+            tasks.append((1000 + k, rid, px, py, dx, dy))
+        expected = {t[0] for t in tasks}
+
+        takeovers = []
+        repl = {"records": 0, "bytes": 0, "first_ms": None,
+                "last_ms": None}
+
+        def pump_tap():
+            while True:
+                f = tap.recv(timeout=0.01)
+                if not f:
+                    return
+                if f.get("op") != "msg":
+                    continue
+                d = f.get("data") or {}
+                if d.get("type") == "ha_takeover":
+                    d["_seen_s"] = time.monotonic()
+                    takeovers.append(d)
+                elif d.get("type") == "ledger1":
+                    now_ms = time.monotonic() * 1000.0
+                    repl["records"] += 1
+                    repl["bytes"] += len(d.get("data") or "")
+                    if repl["first_ms"] is None:
+                        repl["first_ms"] = now_ms
+                    repl["last_ms"] = now_ms
+
+        def pump(seconds):
+            end = time.monotonic() + seconds
+            last_eval = 0.0
+            while time.monotonic() < end:
+                sim.pump(0.2)
+                watch.pump(0.05)
+                pump_tap()
+                if time.monotonic() - last_eval >= 0.5:
+                    last_eval = time.monotonic()
+                    watch.agg.audit.evaluate()
+
+        for tid, rid, px, py, dx, dy in tasks:
+            mgrs[rid].stdin.write(
+                f"taskat {px} {py} {dx} {dy} {tid}\n".encode())
+            mgrs[rid].stdin.flush()
+            pump(0.25)
+
+        # mid-flight kill: the LAST region's active (flat: the only
+        # one) — its standby must take over inside one claim window
+        victim = total - 1
+        pump(1.0)
+        kill_t = time.monotonic()
+        mgrs[victim].send_signal(signal.SIGKILL)
+        try:
+            mgrs[victim].wait(timeout=10)
+        except Exception:
+            pass
+        print(f"ha_smoke: SIGKILLed region-{victim} active", flush=True)
+
+        deadline = time.monotonic() + args.drain_s
+        while time.monotonic() < deadline \
+                and not expected <= sim.done_ids:
+            pump(0.3)
+        pump(2.5)  # final watermark: drained beacons + auditor rounds
+        watch.pump(1.0)
+        watch.agg.audit.evaluate()
+
+        mgr_proc = "manager_centralized"
+        mgr_completed = int(watch.delta(mgr_proc,
+                                        "manager.tasks_completed"))
+        missing = sorted(expected - sim.done_ids)
+        extra = sorted(sim.done_ids - expected)
+        takeover = takeovers[0] if takeovers else None
+        latency_s = (round(takeover["_seen_s"] - kill_t, 2)
+                     if takeover else None)
+        digests_equal = bool(takeover
+                             and _ha.takeover_digests_equal(takeover))
+        silent_mgr = [
+            d for d in watch.agg.audit.divergences
+            if d["class"] == "silent"
+            and ((watch.agg.audit._peers.get(d.get("peer_a") or "")
+                  or type("x", (), {"proc": ""})).proc
+                 ).startswith("manager")]
+        repl_span_s = (max(1e-9, (repl["last_ms"] - repl["first_ms"])
+                           / 1000.0)
+                       if repl["first_ms"] is not None else None)
+        ok = (not missing and not extra
+              and mgr_completed <= len(expected)
+              and takeover is not None and digests_equal
+              and latency_s is not None
+              and latency_s <= args.claim_window_s
+              and bool(silent_mgr))
+        doc = {
+            "experiment": "HA failover smoke (ISSUE 15)",
+            "regions": f"{cols}x{rows}",
+            "agents": args.agents,
+            "injected": len(expected),
+            "completed": len(sim.done_ids & expected),
+            "missing": missing,
+            "extra_done": extra,
+            "done_dups": sim.done_dups,
+            "mgr_completed": mgr_completed,
+            "claim_window_s": args.claim_window_s,
+            "takeover_latency_s": latency_s,
+            "takeover": None if takeover is None else {
+                k: takeover.get(k) for k in
+                ("peer_id", "ns", "why", "repl_seq", "pending",
+                 "inflight", "ledger_digest", "active_ledger_digest",
+                 "view_digest", "active_view_digest")},
+            "digests_equal": digests_equal,
+            "silent_active_detected": bool(silent_mgr),
+            "replication": {
+                "records": repl["records"],
+                "b64_bytes": repl["bytes"],
+                "bytes_per_s": (round(repl["bytes"] / repl_span_s, 1)
+                                if repl_span_s else None),
+            },
+            "ok": ok,
+        }
+        print("ha_smoke: " + json.dumps(doc), flush=True)
+        if args.out:
+            out = Path(args.out)
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_text(json.dumps(doc, indent=2) + "\n")
+        if not ok:
+            print("ha_smoke FAILED", file=sys.stderr)
+        return 0 if ok else 1
+    finally:
+        for obj in (sim, watch):
+            if obj is not None:
+                obj.close()
+        if tap is not None:
+            tap.close()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if pool is not None:
+            pool.close()
+        for log in logs:
+            log.close()
+        os.environ.clear()
+        os.environ.update(saved_env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

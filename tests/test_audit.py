@@ -848,9 +848,13 @@ def test_sigkill_solverd_flags_divergence_then_reconverges(built, tmp_path):
         while pool.adopted < n and time.monotonic() < deadline:
             pool.pump(0.5)
         assert pool.adopted >= n, pool.stats()
-        # mid-run world toggle: the manager's epoch moves to >= 1
+        # mid-run world toggle: the manager's epoch moves to >= 1.
+        # Several candidate cells — the manager (unseeded here) mints
+        # random task endpoints, and a single candidate is rejected
+        # "occupied" whenever an endpoint lands on it (~3% flake)
         pool.bus.publish("mapd", {"type": "world_update_request",
-                                  "toggles": [[15, 15, 1]]})
+                                  "toggles": [[15, 15, 1], [14, 15, 1],
+                                              [15, 14, 1]]})
         deadline = time.monotonic() + 20
         while pool.world_accepted < 1 and time.monotonic() < deadline:
             pool.pump(0.5)

@@ -1,0 +1,513 @@
+"""Control-plane HA tests (ISSUE 15): the ledger1 replication canon
+(py round-trip, malformed rejection, py<->cpp goldens), the replica
+state machine (catch-up, seq gaps, incarnation moves, digest
+verification), the lease/election rules (split-brain demote), the
+aggregator/fleet_top HA surfaces, the chaos failover judges, the
+JG_HA-unset raw-socket wire pin, and a live flat failover e2e (slow).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.obs import audit as au
+from p2p_distributed_tswap_tpu.obs.fleet_aggregator import FleetAggregator
+from p2p_distributed_tswap_tpu.runtime import ha
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# ledger1 codec
+# ---------------------------------------------------------------------------
+
+def _rec(**kw):
+    base = dict(seq=1, base_seq=0, incarnation=777, plan_seq=10,
+                world_seq=2, next_task_id=9, snapshot=True,
+                tasks=[ha.LedgerTask(1, 1, 7, 99, "peer-α"),
+                       ha.LedgerTask(2, 0, 3, 4, "")],
+                removed=[], world=[(5, 1), (6, 0)],
+                handoffs=[ha.HandoffOut(1, 3, 444, "hpeer", 12, 13, 2,
+                                        77, 12, 90),
+                          ha.HandoffOut(2, 1, 444, "hpeer2", 5, 5, 0,
+                                        None, 0, 0)])
+    base.update(kw)
+    rec = ha.LedgerRec(**base)
+    ld, _, vd, _ = ha.ledger_view_digests(rec.tasks)
+    rec.ledger_digest, rec.view_digest = ld, vd
+    return rec
+
+
+def test_ledger_codec_roundtrip():
+    for rec in (_rec(),
+                _rec(snapshot=False, base_seq=4, seq=5,
+                     removed=[2, 17], world=[], handoffs=[]),
+                _rec(tasks=[], world=[], removed=[], handoffs=[])):
+        b64 = ha.encode_ledger_b64(rec)
+        back = ha.decode_ledger_b64(b64)
+        assert back == rec
+        # a second encode of the decode is byte-stable
+        assert ha.encode_ledger_b64(back) == b64
+
+
+def test_ledger_codec_rejects_malformed():
+    raw = ha.encode_ledger(_rec())
+    bad_cases = [
+        b"",                       # empty
+        raw[:13],                  # short header
+        b"\xff" + raw[1:],         # bad magic
+        raw[:4] + b"\x09" + raw[5:],  # unknown version
+        raw[:-1],                  # truncated tail
+        raw + b"\x00",             # overlong
+    ]
+    for bad in bad_cases:
+        with pytest.raises(ha.HaCodecError):
+            ha.decode_ledger(bad)
+    # a task state outside the canon is rejected, not mis-applied
+    doctored = _rec()
+    doctored.tasks = [ha.LedgerTask(1, 1, 7, 99, "p")]
+    raw2 = bytearray(ha.encode_ledger(doctored))
+    raw2[24 + 64 + 8] = 7  # the first task's state byte
+    with pytest.raises(ha.HaCodecError):
+        ha.decode_ledger(bytes(raw2))
+    with pytest.raises(ha.HaCodecError):
+        ha.decode_ledger_b64("!!!not-base64!!!")
+
+
+def test_ledger_encoder_delta_rules():
+    enc = ha.LedgerEncoder(incarnation=42, snapshot_every=64)
+    t1 = ha.LedgerTask(1, 0, 5, 9, "")
+    t2 = ha.LedgerTask(2, 1, 6, 8, "pA")
+    first = enc.encode_tick(1, 0, 3, [t1, t2], {})
+    assert first.snapshot and first.base_seq == 0 and first.seq == 1
+    # nothing changed (watermark churn alone never emits a record)
+    assert enc.encode_tick(2, 0, 3, [t1, t2], {}) is None
+    # a state move + a removal + a world toggle ride one delta
+    t2b = ha.LedgerTask(2, 2, 6, 8, "pA")
+    rec = enc.encode_tick(3, 1, 4, [t2b], {17: 1})
+    assert not rec.snapshot and rec.base_seq == 1 and rec.seq == 2
+    assert rec.removed == [1]
+    assert rec.tasks == [t2b]
+    assert rec.world == [(17, 1)]
+    # the record's digests cover the FULL post-apply ledger
+    ld, _, vd, _ = ha.ledger_view_digests([t2b])
+    assert (rec.ledger_digest, rec.view_digest) == (ld, vd)
+    # a forced snapshot resets the chain (base_seq 0) and ships the
+    # full world state sorted by cell
+    enc.request_snapshot()
+    snap = enc.encode_tick(4, 1, 4, [t2b], {17: 1, 3: 0})
+    assert snap.snapshot and snap.base_seq == 0
+    assert snap.world == [(3, 0), (17, 1)]
+    # an outbox change ALONE emits a record (a mid-transfer task's
+    # retransmit state must reach the standby), shipped wholesale
+    # sorted by (dst, seq); its removal emits again
+    h = ha.HandoffOut(1, 5, 999, "hp", 2, 3, 1, 42, 2, 3)
+    rec2 = enc.encode_tick(5, 1, 4, [t2b], {17: 1, 3: 0}, [h])
+    assert rec2 is not None and rec2.handoffs == [h]
+    assert enc.encode_tick(6, 1, 4, [t2b], {17: 1, 3: 0}, [h]) is None
+    rec3 = enc.encode_tick(7, 1, 4, [t2b], {17: 1, 3: 0}, [])
+    assert rec3 is not None and rec3.handoffs == []
+
+
+def test_replica_carries_handoff_outbox():
+    """The replica's outbox view replaces wholesale with every record —
+    a promoted standby resumes retransmitting exactly the unacked set."""
+    enc = ha.LedgerEncoder(incarnation=5)
+    rep = ha.LedgerReplica()
+    t = ha.LedgerTask(1, 0, 2, 3, "")
+    h1 = ha.HandoffOut(1, 1, 777, "hp", 4, 5, 2, 9, 4, 5)
+    rep.apply(enc.encode_tick(1, 0, 2, [t], {}, [h1]))
+    assert rep.handoffs == [h1]
+    rep.apply(enc.encode_tick(2, 0, 2, [t], {}, []))  # acked
+    assert rep.handoffs == []
+
+
+def test_replica_catchup_gap_and_digest_verification():
+    enc = ha.LedgerEncoder(incarnation=100)
+    rep = ha.LedgerReplica()
+    t1 = ha.LedgerTask(1, 1, 5, 9, "pA")
+    recs = [enc.encode_tick(1, 0, 2, [t1], {})]
+    recs.append(enc.encode_tick(2, 0, 3,
+                                [t1, ha.LedgerTask(2, 0, 1, 2, "")], {}))
+    recs.append(enc.encode_tick(3, 0, 3,
+                                [ha.LedgerTask(2, 1, 1, 2, "pB")], {}))
+    assert rep.apply(recs[0]) is True
+    # a SKIPPED delta is a chain gap -> HaSeqGapError (resync trigger)
+    with pytest.raises(ha.HaSeqGapError):
+        rep.apply(recs[2])
+    # mid-stream catch-up: the active answers the resync request with a
+    # snapshot — applying it recovers the replica completely
+    enc.request_snapshot()
+    snap = enc.encode_tick(4, 0, 3, [ha.LedgerTask(2, 1, 1, 2, "pB")],
+                           {})
+    assert rep.apply(snap) is True
+    assert sorted(rep.tasks) == [2]
+    assert rep.digests()["ledger"] == au.digest_hex(snap.ledger_digest)
+    # doctored digests: applied but flagged divergent (never promote)
+    nxt = enc.encode_tick(5, 0, 4,
+                          [ha.LedgerTask(2, 2, 1, 2, "pB")], {})
+    nxt.ledger_digest ^= 0xDEAD
+    assert rep.apply(nxt) is False
+    assert rep.divergences == 1
+
+
+def test_replica_incarnation_rules():
+    rep = ha.LedgerReplica()
+    old = ha.LedgerEncoder(incarnation=100)
+    new = ha.LedgerEncoder(incarnation=200)
+    assert rep.apply(old.encode_tick(1, 0, 2,
+                                     [ha.LedgerTask(1, 0, 1, 2, "")],
+                                     {})) is True
+    # a NEWER incarnation opening with a delta is a gap (its chain
+    # starts over) ...
+    new_delta = new.encode_tick(1, 0, 2, [], {})  # force a snapshot...
+    assert new_delta.snapshot  # first record IS a snapshot
+    # ... so synthesize the bad case: a delta claiming the new epoch
+    bad = ha.LedgerRec(seq=9, base_seq=8, incarnation=200, plan_seq=0,
+                       world_seq=0, next_task_id=2, snapshot=False)
+    with pytest.raises(ha.HaSeqGapError):
+        rep.apply(bad)
+    assert rep.incarnation == 200 and not rep.tasks  # reset happened
+    # the new incarnation's snapshot lands cleanly
+    assert rep.apply(new_delta) is True
+    # a STALE incarnation's frame is dropped, never applied
+    stale = old.encode_tick(2, 0, 3,
+                            [ha.LedgerTask(7, 0, 1, 2, "")], {})
+    assert rep.apply(stale) is True
+    assert rep.stale_dropped == 1 and 7 not in rep.tasks
+
+
+def test_lease_monitor_and_election():
+    mon = ha.LeaseMonitor()
+    # never expires before first contact (cold start is a longer grace)
+    assert not mon.expired(10_000_000)
+    mon.note("mgr-a", 100, now_ms=1000, interval_ms=300, repl_seq=5)
+    assert not mon.expired(1000 + 3 * 300 + 1000)      # exactly at edge
+    assert mon.expired(1000 + 3 * 300 + 1001)          # past the rule
+    # a zombie with a LOWER incarnation never renews the lease
+    mon.note("mgr-b", 200, now_ms=2000)
+    mon.note("mgr-a", 100, now_ms=9000)
+    assert mon.last_ms == 2000 and mon.peer == "mgr-b"
+    # split-brain: exactly ONE of two claimants yields, higher
+    # (incarnation, peer) wins; an old-incarnation active that resumes
+    # always demotes to the promoted standby
+    assert ha.should_demote(100, "a", 200, "b")
+    assert not ha.should_demote(200, "b", 100, "a")
+    assert ha.should_demote(100, "a", 100, "b") \
+        != ha.should_demote(100, "b", 100, "a")
+
+
+# ---------------------------------------------------------------------------
+# py <-> cpp goldens (codec_golden --ledger-encode/--ledger-decode)
+# ---------------------------------------------------------------------------
+
+def _golden_binary():
+    from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu
+
+    return build_single_tu("mapd_codec_golden",
+                           "cpp/probes/codec_golden.cpp")
+
+
+def test_ledger_golden_cpp_byte_identical():
+    binary = _golden_binary()
+    if binary is None:
+        pytest.skip("no C++ toolchain for codec_golden")
+    script = [
+        {"inc": 987654, "snapshot_every": 3, "plan": 1, "world_seq": 0,
+         "next": 3,
+         "tasks": [[1, 1, 7, 99, "peerA"], [2, 0, 3, 4, ""]],
+         "world": []},
+        {"plan": 2, "world_seq": 0, "next": 3,  # unchanged -> null
+         "tasks": [[1, 1, 7, 99, "peerA"], [2, 0, 3, 4, ""]],
+         "world": []},
+        {"plan": 3, "world_seq": 1, "next": 5,  # churn + toggle +
+         "tasks": [[1, 2, 7, 99, "peerA"], [4, 0, 8, 9, ""]],
+         "world": [[42, 1]],  # an unacked handoff in the outbox
+         "handoffs": [[1, 7, 555666, "hpeerX", 3, 4, 2, 91, 3, 4]]},
+        {"plan": 4, "world_seq": 1, "next": 5,  # snapshot_every=3 due
+         "tasks": [[4, 1, 8, 9, "peerB"]],
+         "world": [[42, 1]]},
+    ]
+    enc = ha.LedgerEncoder(incarnation=987654, snapshot_every=3)
+    py = []
+    for line in script:
+        rec = enc.encode_tick(line["plan"], line["world_seq"],
+                              line["next"],
+                              [ha.LedgerTask(*t) for t in line["tasks"]],
+                              {c: b for c, b in line["world"]},
+                              [ha.HandoffOut(*h) for h in
+                               line.get("handoffs", [])])
+        py.append("null" if rec is None else ha.encode_ledger_b64(rec))
+    feed = "\n".join(json.dumps(line) for line in script) + "\n"
+    out = subprocess.run([str(binary), "--ledger-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert out.stdout.split() == py
+    # the native decoder round-trips py bytes; garbage reads null
+    real = [b for b in py if b != "null"]
+    out = subprocess.run([str(binary), "--ledger-decode"],
+                         input="\n".join(real + ["AAAA"]) + "\n",
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    lines = out.stdout.splitlines()
+    assert lines[-1] == "null"
+    first = json.loads(lines[0])
+    assert first["snapshot"] is True
+    assert first["tasks"] == [[1, 1, 7, 99, "peerA"], [2, 0, 3, 4, ""]]
+    back = ha.decode_ledger_b64(real[0])
+    assert first["ledger_digest"] == au.digest_hex(back.ledger_digest)
+
+
+# ---------------------------------------------------------------------------
+# aggregator + fleet_top surfaces
+# ---------------------------------------------------------------------------
+
+def _ha_beacon(peer, role, takeovers=0, lag=0):
+    return {
+        "type": "metrics_beacon", "peer_id": peer,
+        "proc": "manager_centralized", "pid": 1,
+        "metrics": {
+            "uptime_s": 5.0,
+            "counters": {"manager.ha_takeovers": takeovers,
+                         "manager.ha_lease_expiries": takeovers,
+                         "manager.ha_demotions": 0},
+            "gauges": {
+                'manager.ha_role{role="active"}':
+                    1.0 if role == "active" else 0.0,
+                'manager.ha_role{role="standby"}':
+                    1.0 if role == "standby" else 0.0,
+                "manager.ha_replica_lag_entries": lag,
+                "manager.ha_repl_seq": 12,
+            },
+            "hists": {},
+        },
+    }
+
+
+def test_aggregator_ha_section_and_fleet_top_line():
+    from analysis.fleet_top import render
+
+    agg = FleetAggregator()
+    assert agg.ingest(_ha_beacon("mgr-a", "active"), now_ms=1000)
+    assert agg.ingest(_ha_beacon("stb-a", "standby", lag=2),
+                      now_ms=1000)
+    takeover = {
+        "type": "ha_takeover", "peer_id": "stb-a", "ns": "",
+        "incarnation": 999, "repl_seq": 12, "plan_seq": 40,
+        "world_seq": 0,
+        "ledger_digest": "aa" * 8, "active_ledger_digest": "aa" * 8,
+        "view_digest": "bb" * 8, "active_view_digest": "bb" * 8,
+        "pending": 1, "inflight": 3,
+    }
+    assert agg.ingest(takeover, now_ms=1500)
+    roll = agg.rollup(now_ms=2000)
+    assert roll["peers"]["mgr-a"]["ha"]["role"] == "active"
+    assert roll["peers"]["stb-a"]["ha"]["role"] == "standby"
+    assert roll["peers"]["stb-a"]["ha"]["replica_lag"] == 2
+    assert roll["ha"]["active"] == ["mgr-a"]
+    assert roll["ha"]["standby"] == ["stb-a"]
+    assert roll["ha"]["replica_lag"] == 2
+    assert roll["ha"]["last_takeover"]["repl_seq"] == 12
+    text = render(roll)
+    ha_line = next(ln for ln in text.splitlines()
+                   if ln.startswith("HA "))
+    assert "active=mgr-a" in ha_line and "standby=stb-a" in ha_line
+    assert "digests=EQUAL" in ha_line
+    # an unequal takeover renders the alarm tag
+    takeover2 = dict(takeover, active_ledger_digest="cc" * 8)
+    agg.ingest(takeover2, now_ms=2500)
+    text = render(agg.rollup(now_ms=3000))
+    assert "digests=DIFFER!" in text
+
+
+def test_aggregator_ha_stale_active_keeps_role():
+    """A SIGKILLed active's beacons go stale — its peer row keeps the
+    last-beaconed role but leaves the live `active` census, which is
+    exactly the operator's takeover evidence."""
+    agg = FleetAggregator()
+    agg.ingest(_ha_beacon("mgr-a", "active"), now_ms=1000)
+    agg.ingest(_ha_beacon("stb-a", "standby"), now_ms=1000)
+    # ~a minute later only the (promoted) standby still beacons
+    agg.ingest(_ha_beacon("stb-a", "active", takeovers=1),
+               now_ms=61_000)
+    roll = agg.rollup(now_ms=62_000)
+    assert roll["peers"]["mgr-a"]["stale"] is True
+    assert roll["ha"]["active"] == ["stb-a"]
+    assert roll["ha"]["takeovers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos failover judges (synthetic results — no live fleet)
+# ---------------------------------------------------------------------------
+
+def _failover_res(missing=(), extra=(), takeovers=None, silent=True,
+                  mgr_completed=4, ha_enabled=True):
+    peers = {"m1": {"proc": "manager_centralized", "ns": "",
+                    "epoch": 0, "dynamic": None}}
+    return {
+        "expected": 4, "completed": 4 - len(missing),
+        "missing": list(missing), "extra_done": list(extra),
+        "mgr_completed": mgr_completed,
+        "completion_ratio": 1.0 - len(missing) / 4.0,
+        "federation": {"handoffs_sent": 2, "handoffs_dup_dropped": 0},
+        "ha": {"enabled": ha_enabled,
+               "takeovers": takeovers if takeovers is not None else [
+                   {"digests_equal": True, "t_rel_s": 9.0}]},
+        "chaos": {"fired_at_s": 7.0},
+        "audit": {
+            "confirmed": ([{"class": "silent", "peer_a": "m1",
+                            "peer_b": "", "ns": "", "detail": "quiet"}]
+                          if silent else []),
+            "active": [],
+            "epochs": peers,
+        },
+    }
+
+
+def test_classify_kill_failover_green_and_reds():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_gate", ROOT / "scripts" / "chaos_gate.py")
+    cg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cg)
+
+    good = cg.classify_kill_failover(_failover_res())
+    assert good["verdict"] == "green"
+    assert good["ha"]["takeover_latency_s"] == 2.0
+    # a lost task is red even though the takeover happened
+    assert cg.classify_kill_failover(
+        _failover_res(missing=[3]))["verdict"] == "red"
+    # no takeover at all is red
+    assert cg.classify_kill_failover(
+        _failover_res(takeovers=[]))["verdict"] == "red"
+    # digest-unequal takeover is red
+    assert cg.classify_kill_failover(_failover_res(
+        takeovers=[{"digests_equal": False,
+                    "t_rel_s": 9.0}]))["verdict"] == "red"
+    # an undetected kill is red
+    assert cg.classify_kill_failover(
+        _failover_res(silent=False))["verdict"] == "red"
+    # a double-counted ledger is red
+    assert cg.classify_kill_failover(
+        _failover_res(mgr_completed=5))["verdict"] == "red"
+
+    # the handoff row: detection-only without HA (missing tolerated),
+    # recovery-required with HA (missing is red)
+    res = _failover_res(missing=[3], ha_enabled=False)
+    res["ha"] = None
+    res["completed"] = 3
+    assert cg.classify_handoff_kill(res)["verdict"] == "green"
+    res2 = _failover_res(missing=[3])
+    assert cg.classify_handoff_kill(res2)["verdict"] == "red"
+
+
+# ---------------------------------------------------------------------------
+# live: JG_HA-unset raw-socket wire pin
+# ---------------------------------------------------------------------------
+
+TINY16 = "\n".join(["." * 16] * 16) + "\n"
+
+
+@pytest.fixture(scope="module")
+def built():
+    from p2p_distributed_tswap_tpu.runtime.fleet import ensure_built
+
+    ensure_built()
+
+
+def _capture_manager_bytes(tmp_path, env_extra, seconds=2.5):
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    mapf = tmp_path / "t16.map.txt"
+    mapf.write_text(TINY16)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    received = []
+
+    def server():
+        conn, _ = srv.accept()
+        conn.sendall(b'{"op":"welcome","peer_id":"x",'
+                     b'"caps":["relay1"]}\n')
+        end = time.monotonic() + seconds
+        buf = b""
+        conn.settimeout(0.25)
+        while time.monotonic() < end:
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            if not chunk:
+                break
+            buf += chunk
+        received.append(buf)
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    mgr = subprocess.Popen(
+        [str(Path(BUILD_DIR) / "mapd_manager_centralized"),
+         "--port", str(port), "--map", str(mapf)],
+        stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        env={**os.environ, "JG_TRACE_CTX": "0", "JG_AUDIT": "0",
+             **env_extra})
+    try:
+        t.join(timeout=seconds + 15)
+    finally:
+        mgr.terminate()
+        mgr.wait(timeout=10)
+        srv.close()
+    assert received, "manager never connected to the pin socket"
+    return received[0]
+
+
+def test_ha_kill_switch_pins_wire(built, tmp_path):
+    """JG_HA unset keeps the manager's byte stream free of ANY HA
+    traffic (no mapd.ha subscription, no lease, no ledger1 record);
+    JG_HA=1 publishes the replication stream — token-pinned."""
+    env = dict(os.environ)
+    env.pop("JG_HA", None)
+    quiet = _capture_manager_bytes(tmp_path, {})
+    for token in (b"mapd.ha", b"ha_lease", b"ledger1", b"ha_takeover"):
+        assert token not in quiet, token
+    loud = _capture_manager_bytes(
+        tmp_path, {"JG_HA": "1", "JG_HA_LEASE_MS": "200"})
+    assert b"mapd.ha" in loud     # the subscription
+    assert b"ha_lease" in loud    # the liveness lease
+    assert b"ledger1" in loud     # the replication stream
+
+
+# ---------------------------------------------------------------------------
+# live flat failover e2e (the smoke, compact) — slow
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_live_failover_exact_once(built, tmp_path):
+    """SIGKILL the active manager mid-flight: the warm standby must
+    promote inside one claim window with a digest-equal takeover
+    watermark, and every injected task must complete exactly once."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ha_smoke", ROOT / "scripts" / "ha_smoke.py")
+    hs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hs)
+    out = tmp_path / "ha_e2e.json"
+    rc = hs.main(["--tasks", "6", "--agents", "5",
+                  "--out", str(out),
+                  "--log-dir", str(tmp_path / "logs")])
+    doc = json.loads(out.read_text())
+    assert rc == 0, doc
+    assert doc["missing"] == [] and doc["extra_done"] == []
+    assert doc["digests_equal"] is True
+    assert doc["takeover_latency_s"] <= doc["claim_window_s"]
