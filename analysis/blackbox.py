@@ -15,6 +15,12 @@ divergence records (``*.audit.jsonl``, written by the standalone auditor
 distributed state forked relative to the last seconds of lifecycle
 events, not just that it did.
 
+``--alerts`` (ISSUE 16) merges healthd's alert records
+(``healthd.alerts.jsonl``, written by ``obs/health.py --record``) into
+the same timeline as 🔴 ``health.alert`` events — burning SLO,
+severity, forecast lead, attribution, and the auto-captured replay
+artifact, in wall-clock order against the fleet's last seconds.
+
 ``--capture OUT`` (ISSUE 11) rebuilds a replayable ``capture1``
 artifact from the same flight rings: the sim pool's ``capture.meta`` /
 ``task.spec`` / ``world.update`` evidence events become the fleet
@@ -26,6 +32,7 @@ Usage:
   python analysis/blackbox.py --dir <fleet log dir> [--last 30] [--json]
   python analysis/blackbox.py --dir results/trace --grep task.dispatch
   python analysis/blackbox.py --dir <fleet log dir> --audit
+  python analysis/blackbox.py --dir <fleet log dir> --alerts
   python analysis/blackbox.py --dir <fleet log dir> --capture out.json
 """
 
@@ -99,6 +106,50 @@ def load_audit(directory: Path) -> list:
     return out
 
 
+def load_alerts(directory: Path) -> list:
+    """healthd alert records (``*.alerts.jsonl``, ISSUE 16) as
+    flight-style events: ``health.alert`` carrying the burning SLO,
+    severity, forecast lead, attribution, and — for page-severity
+    breaches — the auto-captured replay artifact, time-ordered."""
+    out = []
+    for path in sorted(directory.glob("*.alerts.jsonl")):
+        for line in path.read_text(errors="ignore").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or "ts_ms" not in rec:
+                continue
+            att = rec.get("attribution") or {}
+            reco = rec.get("recommendation") or {}
+            fc = rec.get("forecast") or {}
+            ev = {
+                "ts_ms": rec["ts_ms"],
+                "proc": "healthd",
+                "pid": path.stem.split(".")[0],
+                "event": "health.alert",
+                "class": (f"{rec.get('severity')}."
+                          f"{rec.get('kind')}.{rec.get('state')}"),
+                "seq": rec.get("seq"),
+                "error": (f"[{rec.get('name')}] {rec.get('signal')}"
+                          f"={rec.get('observed')}"),
+            }
+            if fc.get("eta_s") is not None:
+                ev["error"] += f" eta={fc['eta_s']}s"
+            if att:
+                ev["peer"] = f"{att.get('kind')}:{att.get('id')}"
+            if reco:
+                ev["error"] += (f" -> {reco.get('actuator')}"
+                                f"({reco.get('target')})")
+            if rec.get("capture"):
+                ev["capture"] = rec["capture"]
+            out.append(ev)
+    return out
+
+
 def render_event(ev: dict, t_end_ms: int) -> str:
     rel = (ev.get("ts_ms", 0) - t_end_ms) / 1000.0
     who = f"{ev.get('proc', '?')}/{ev.get('pid', '?')}"
@@ -107,7 +158,8 @@ def render_event(ev: dict, t_end_ms: int) -> str:
                                  "wire_ms", "seq", "epoch", "class",
                                  "error", "capture")
         if k in ev)
-    mark = "🔴 " if ev.get("event") == "audit.divergence" else "  "
+    mark = ("🔴 " if ev.get("event") in ("audit.divergence",
+                                         "health.alert") else "  ")
     return (f"{mark}{rel:+9.3f}s  {who:<28} "
             f"{ev.get('event', '?'):<22} {detail}")
 
@@ -124,6 +176,9 @@ def main(argv=None) -> int:
     ap.add_argument("--audit", action="store_true",
                     help="merge auditor divergence records "
                          "(*.audit.jsonl) into the timeline (ISSUE 10)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="merge healthd alert records (*.alerts.jsonl) "
+                         "into the timeline (ISSUE 16)")
     ap.add_argument("--capture", default=None, metavar="OUT",
                     help="rebuild a replayable capture1 artifact from "
                          "the flight rings' evidence events (ISSUE 11) "
@@ -160,8 +215,9 @@ def main(argv=None) -> int:
         return 0
     metas, events = load_dumps(directory)
     audit_events = load_audit(directory) if args.audit else []
-    if audit_events:
-        events = sorted(events + audit_events,
+    alert_events = load_alerts(directory) if args.alerts else []
+    if audit_events or alert_events:
+        events = sorted(events + audit_events + alert_events,
                         key=lambda e: e.get("ts_ms", 0))
     if args.grep:
         events = [e for e in events if args.grep in str(e.get("event", ""))]
@@ -172,15 +228,18 @@ def main(argv=None) -> int:
         print(json.dumps({"dir": str(directory), "dumps": metas,
                           "t_end_ms": t_end, "window_s": args.last,
                           "audit_divergences": len(audit_events),
+                          "health_alerts": len(alert_events),
                           "events": window}))
-        return 0 if metas or audit_events else 1
-    if not metas and not audit_events:
+        return 0 if metas or audit_events or alert_events else 1
+    if not metas and not audit_events and not alert_events:
         print(f"no *.flight.jsonl dumps in {directory} — trigger one with "
               f"SIGUSR2, a bus flight_dump message, or a process exit")
         return 1
     print(f"black box: {len(metas)} ring dump(s) in {directory}"
           + (f", {len(audit_events)} audit divergence(s)"
-             if args.audit else ""))
+             if args.audit else "")
+          + (f", {len(alert_events)} health alert(s)"
+             if args.alerts else ""))
     for m in metas:
         print(f"  {m['file']}: {m.get('proc')}/{m.get('pid')} "
               f"reason={m.get('reason')} events={m.get('events')}")

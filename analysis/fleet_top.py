@@ -28,6 +28,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from p2p_distributed_tswap_tpu.obs import audit as _audit  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import health as _health  # noqa: E402
 from p2p_distributed_tswap_tpu.obs import slo as _slo  # noqa: E402
 from p2p_distributed_tswap_tpu.obs.beacon import METRICS_TOPIC  # noqa: E402
 from p2p_distributed_tswap_tpu.obs.fleet_aggregator import (  # noqa: E402
@@ -171,6 +172,47 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
                      f"@{last.get('repl_seq')}"
                      f" digests={tag}")
         lines.append(line)
+    # health plane (ISSUE 16): healthd's heartbeat + one ALERT line per
+    # active confirmed breach — severity, burning signal, forecast
+    # lead, attribution, and the recommended actuator
+    health = rollup.get("health")
+    if health:
+        hb = health.get("beacon")
+        line = "HEALTH"
+        if hb:
+            line += (f" spec={hb.get('spec')}"
+                     f" seq={hb.get('seq')}"
+                     f" active={hb.get('active')}"
+                     f" alerts={hb.get('alerts')}")
+            if health.get("stale"):
+                line += " STALE!"
+        else:
+            line += f" alerts={health.get('alerts')}"
+        if color and health.get("active"):
+            line = f"\x1b[31m{line}\x1b[0m"
+        lines.append(line)
+        for a in health.get("active") or []:
+            al = (f"ALERT {str(a.get('severity')).upper()}"
+                  f" [{a.get('name')}] {a.get('signal')}"
+                  f"={_fmt(a.get('observed'))}")
+            burn = a.get("burn") or {}
+            if burn:
+                al += (f" burn={_fmt(burn.get('fast'))}"
+                       f"/{_fmt(burn.get('slow'))}")
+            fc = a.get("forecast")
+            if fc:
+                al += (f" eta={_fmt(fc.get('eta_s'))}s"
+                       f" ({_fmt(fc.get('eta_intervals'))} ivl)")
+            att = a.get("attribution")
+            if att:
+                al += f" ← {att.get('kind')} {att.get('id')}"
+            reco = a.get("recommendation")
+            if reco:
+                al += (f" ⇒ {reco.get('actuator')}"
+                       f"({reco.get('target')})")
+            if a.get("capture"):
+                al += " 📼"
+            lines.append(al)
     # world-epoch tracking (ISSUE 10 satellite): every peer carrying a
     # world_seq gauge, plus the audit beacons' per-tenant epochs — a
     # dynamic-world-OFF peer in a toggling fleet renders "OFF!", the
@@ -276,7 +318,8 @@ def collect(agg: FleetAggregator, bus: BusClient, duration: float) -> int:
         if not frame or frame.get("op") != "msg":
             continue
         if frame.get("topic") not in (METRICS_TOPIC, _audit.AUDIT_TOPIC,
-                                      _ha.HA_TOPIC):
+                                      _ha.HA_TOPIC,
+                                      _health.ALERT_TOPIC):
             continue
         if agg.ingest(frame.get("data") or {}):
             n += 1
@@ -329,6 +372,11 @@ def main(argv=None) -> int:
         # takeover announcements (ISSUE 15) feed the HA line's
         # digest-equality tag; subscribed only when the HA plane is on
         bus.subscribe(_ha.HA_TOPIC, raw=True)
+    if _health.enabled():
+        # healthd's alert1 records + heartbeat (ISSUE 16) feed the
+        # HEALTH/ALERT lines; JG_HEALTH unset keeps the wire
+        # byte-identical (the pin test in tests/test_health.py)
+        bus.subscribe(_health.ALERT_TOPIC, raw=True)
 
     if args.once:
         collect(agg, bus, args.wait)

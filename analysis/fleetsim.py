@@ -240,6 +240,38 @@ def _federation_counters(watch, mgr_proc: str) -> dict:
     }
 
 
+def shape_rate(shape: str, t_s: float, base: float, peak: float,
+               period_s: float) -> float:
+    """Open-loop injection rate (tasks/s) at elapsed time ``t_s`` for a
+    traffic shape (ISSUE 16) — the rehearsal generators healthd's
+    forecast is validated against:
+
+    - ``ramp``  — diurnal climb: linear base→peak across the period,
+      held at peak after (the smooth monotone trend a slope forecaster
+      must catch BEFORE the breach);
+    - ``flash`` — flash crowd: base load with a peak burst through the
+      last 20% of each period (a step, which must NOT forecast — it
+      confirms the fast way, via the burn window);
+    - ``storm`` — tenant arrival storm: a 4-step staircase base→peak
+      per period (each arriving tenant adds a load quantum).
+
+    ``none`` (or an unknown shape) is constant ``base`` — the legacy
+    open-loop wire, byte-identical.
+    """
+    if shape in (None, "", "none") or period_s <= 0:
+        return base
+    if shape == "ramp":
+        frac = min(1.0, max(0.0, t_s / period_s))
+        return base + (peak - base) * frac
+    phase = (t_s % period_s) / period_s
+    if shape == "flash":
+        return peak if phase >= 0.8 else base
+    if shape == "storm":
+        step = min(3, int(phase * 4))
+        return base + (peak - base) * step / 3.0
+    return base
+
+
 def _fed_spec(args):
     """``(cols, rows, total)`` from the rung's --regions spec (None/1 =
     the single-pair fleet)."""
@@ -379,6 +411,17 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
         open_loop = args.mode == "open"
         inject_every = 1.0
         per_inject = max(1, int(round(args.rate * inject_every)))
+        # traffic shapes (ISSUE 16): the open-loop rate becomes a
+        # function of elapsed time; `none` keeps the legacy constant
+        # wire exactly (per_inject path untouched)
+        shape = getattr(args, "shape", "none") or "none"
+        shape_peak = getattr(args, "shape_peak", None)
+        if shape_peak is None:
+            shape_peak = 4.0 * args.rate
+        shape_period = getattr(args, "shape_period_s", None)
+        if shape_period is None:
+            shape_period = args.settle + args.window
+        shape_t0 = time.monotonic()
         if not open_loop:
             # ramped closed-loop fill (manager refills on every done):
             # the fleet's standing load goes out in chunks, so
@@ -403,12 +446,21 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             while time.monotonic() < end:
                 if open_loop and time.monotonic() >= next_inject:
                     next_inject = time.monotonic() + inject_every
-                    inject(per_inject)
+                    if shape != "none":
+                        rate = shape_rate(shape,
+                                          time.monotonic() - shape_t0,
+                                          args.rate, shape_peak,
+                                          shape_period)
+                        k = int(round(rate * inject_every))
+                        if k > 0:
+                            inject(k)
+                    else:
+                        inject(per_inject)
                 toggler.maybe()
                 sim.pump(0.3)
                 watch.pump(0.05)
 
-        next_inject = time.monotonic()
+        next_inject = shape_t0 = time.monotonic()
         drive(args.settle)
         # measurement window starts fresh: counters re-baseline, the sim
         # pool's own done count snapshots
@@ -471,6 +523,14 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
                 hist_quantile(claim, 0.99), 3)
             signals["sim.claim_wire_p50_ms"] = round(
                 hist_quantile(claim, 0.5), 3)
+        if open_loop and shape != "none":
+            # shape evidence rides the signals (ISSUE 16): the health
+            # artifact (and item 1's rehearsals) record exactly which
+            # traffic curve the verdict was judged under
+            signals["shape.kind"] = shape
+            signals["shape.base_rate"] = args.rate
+            signals["shape.peak_rate"] = round(shape_peak, 3)
+            signals["shape.period_s"] = round(shape_period, 1)
         if toggler.sent:
             # dynamic-world evidence rides the signals so a spec can
             # demand toggles actually landed (unknown = exit 2 otherwise)
@@ -516,6 +576,10 @@ def run_rung(args, agents: int, tick_ms: int, spec) -> dict:
             "signals": signals,
             "slo": result,
         }
+        if open_loop and shape != "none":
+            rung["shape"] = {"kind": shape, "base_rate": args.rate,
+                             "peak_rate": round(shape_peak, 3),
+                             "period_s": round(shape_period, 1)}
         if federation is not None:
             rung["federation"] = federation
         if toggler.sent:
@@ -1301,6 +1365,15 @@ def main(argv=None) -> int:
                          "tasks/s regardless of completion")
     ap.add_argument("--rate", type=float, default=10.0,
                     help="open-loop injection rate (tasks/s)")
+    ap.add_argument("--shape", default="none",
+                    choices=["none", "ramp", "flash", "storm"],
+                    help="open-loop traffic shape (ISSUE 16): diurnal "
+                         "ramp / flash crowd / tenant arrival storm; "
+                         "'none' = constant --rate (legacy wire)")
+    ap.add_argument("--shape-peak", type=float, default=None,
+                    help="shape peak rate tasks/s (default 4x --rate)")
+    ap.add_argument("--shape-period-s", type=float, default=None,
+                    help="shape period seconds (default settle+window)")
     ap.add_argument("--window", type=float, default=30.0)
     ap.add_argument("--settle", type=float, default=45.0,
                     help="warmup before the window (first completions "

@@ -104,6 +104,14 @@ record — ledger1 replication cost in-process (record bytes + µs for a
 in claim windows, replication stream bytes/s, digest-equal takeover +
 exact-once verdicts.
 
+Health axis (ISSUE 16): unless BENCH_HEALTH=0, the headline carries a
+``health`` record — the watcher's evaluation cost in-process (full
+engine beat over a synthetic 16-peer rollup: SLO judging, burn windows,
+forecasters, ring append — µs/beat) and the live rehearsal
+(scripts/health_smoke.py): zero false alerts on a clean run, the
+diurnal-ramp forecast lead in evaluation intervals before the confirmed
+breach, and the alert1 frames observed on the raw wire.
+
 Replay axis (ISSUE 11): unless BENCH_REPLAY=0, the headline carries a
 ``replay`` record — replay FIDELITY of the committed CI capture
 (results/captures/ci_small.capture.json re-driven open-loop through
@@ -1046,6 +1054,97 @@ def run_audit_axis() -> dict:
     return out
 
 
+def run_health_axis() -> dict:
+    """Health-plane rung (ISSUE 16): evaluation µs per watcher beat —
+    the full engine pass (SLO judging + burn windows + forecasters +
+    ring append) over a synthetic 16-peer rollup, measured in-process —
+    plus the live forecast-lead / false-alert numbers from a
+    scripts/health_smoke.py run.  Failures are recorded, never fatal."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from p2p_distributed_tswap_tpu.obs import health as _health
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    out: dict = {}
+    root = os.path.dirname(os.path.abspath(__file__))
+    reps = 2000
+    try:
+        spec = {"name": "bench", "slos": [
+            {"name": "backlog", "signal": "fleet.tasks_pending",
+             "max": 40.0},
+            {"name": "completion", "signal": "fleet.completion_ratio",
+             "min": 0.3},
+            {"name": "tick", "signal": "tick.p95_ms", "max": 400.0},
+        ]}
+        peers = {f"mgr-{k}": {"proc": "manager_centralized",
+                              "mgr_tasks": {"dispatched": 40 + k,
+                                            "completed": 30 + k,
+                                            "pending": k},
+                              "tick": {"p95_ms": 10.0 + k,
+                                       "over_budget": 0}}
+                 for k in range(16)}
+        eng = _health.HealthEngine(spec=spec, interval=2.0)
+        def beat(i):
+            eng.observe({"beacons_ingested": i + 1, "peers": peers,
+                         "fleet": {"tasks_pending": 5 + i % 7,
+                                   "tasks_dispatched": 100 + i,
+                                   "tasks_completed": 90 + i}},
+                        now_ms=1000 + i * 2000,
+                        signals={"fleet.tasks_pending": 5.0 + i % 7,
+                                 "fleet.completion_ratio": 0.9,
+                                 "tick.p95_ms": 12.0})
+        beat(0)  # warm
+        t0 = time.perf_counter()
+        for i in range(1, reps + 1):
+            beat(i)
+        out["eval_us_per_beat"] = round(
+            1e6 * (time.perf_counter() - t0) / reps, 1)
+        out["slos"] = len(spec["slos"])
+        out["rollup_peers"] = len(peers)
+    except Exception as e:  # noqa: BLE001 — axis must never kill BENCH
+        out["microbench_error"] = f"{type(e).__name__}: {e}"
+
+    if not (BUILD_DIR / "mapd_bus").exists() \
+            and (shutil.which("cmake") is None
+                 or shutil.which("ninja") is None):
+        out["live"] = {"skipped": "C++ runtime unavailable"}
+        return out
+    art = Path(tempfile.mkdtemp(prefix="jg-bench-health-")) / "health.json"
+    cmd = [sys.executable,
+           os.path.join(root, "scripts", "health_smoke.py"),
+           "--out", str(art)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=420,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        out["live"] = {"error": "health_smoke timeout"}
+        return out
+    if not art.exists():
+        out["live"] = {"error": (proc.stderr or proc.stdout
+                                 or "no output")[-300:]}
+        return out
+    try:
+        doc = json.loads(art.read_text())
+    except json.JSONDecodeError as e:
+        out["live"] = {"error": f"artifact parse: {e}"}
+        return out
+    ramp = doc.get("ramp") or {}
+    out["live"] = {
+        "ok": doc.get("ok"),
+        "clean_beats": (doc.get("clean") or {}).get("beats"),
+        "clean_false_alerts": (doc.get("clean") or {}).get("alerts"),
+        "forecast_lead_intervals": ramp.get("lead_intervals"),
+        "forecast_eta_s": ((ramp.get("forecast") or {})
+                           .get("forecast") or {}).get("eta_s"),
+        "alerts_on_wire": ramp.get("alerts_on_wire"),
+    }
+    return out
+
+
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
         trace.configure(proc=f"bench-{sys.argv[2]}")
@@ -1112,6 +1211,9 @@ def main():
         # HA axis (ISSUE 15): ledger1 replication cost + live takeover
         # latency in claim windows
         head["ha"] = run_ha_axis()
+    if os.environ.get("BENCH_HEALTH", "1") != "0":
+        # health axis (ISSUE 16): evaluation µs/beat + forecast lead
+        head["health"] = run_health_axis()
     print(json.dumps(head), flush=True)
 
 

@@ -2627,6 +2627,13 @@ int main(int argc, char** argv) {
       if (tick_ms_taken > static_cast<double>(planning_ms))
         metrics_count("tick.over_budget");
       metrics_gauge("tick.agents", static_cast<double>(agents.size()));
+      // queue-depth gauge (ISSUE 16): dispatch is capacity-gated (a
+      // task leaves pending_tasks only when an agent frees up), so the
+      // dispatched/completed counter pair can never show an overload —
+      // the backlog here is the fleet's actual pressure signal, the
+      // one the health plane forecasts over
+      metrics_gauge("manager.tasks_pending",
+                    static_cast<double>(pending_tasks.size()));
     }
     if (audit_on && now - last_audit >= audit_interval_ms) {
       last_audit = now;

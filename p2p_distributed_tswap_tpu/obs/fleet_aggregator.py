@@ -145,6 +145,13 @@ class FleetAggregator:
         # on mapd.ha — the digest-equal watermark proof, kept for the
         # rollup's `ha` section and the chaos/smoke judges
         self.ha_takeovers: list = []
+        # health plane (ISSUE 16): healthd's alert1 records + heartbeat
+        # observed on mapd.alert — the rollup's `health` section and
+        # fleet_top's HEALTH/ALERT lines
+        self.health_alerts: list = []
+        self._health_active: Dict[str, dict] = {}
+        self._health_beacon: Optional[dict] = None
+        self._health_seen_ms = 0
 
     # cumulative counters watched for restarts (a shrink between two
     # consecutive beacons of one peer = the process restarted with a
@@ -179,6 +186,30 @@ class FleetAggregator:
             # the captured original, rendered by fleet_top's REPLAY line
             self._replay = payload
             self._replay_seen_ms = _now_ms() if now_ms is None else now_ms
+            self.beacons_ingested += 1
+            return True
+        if isinstance(payload, dict) \
+                and payload.get("type") == "alert1":
+            # healthd's alert records (ISSUE 16): confirmed breach
+            # episodes accumulate as active until their heal lands
+            rec = dict(payload)
+            rec["seen_ms"] = _now_ms() if now_ms is None else now_ms
+            self.health_alerts.append(rec)
+            del self.health_alerts[:-32]
+            name = str(rec.get("name"))
+            if rec.get("kind") == "breach":
+                if rec.get("state") == "confirmed":
+                    self._health_active[name] = rec
+                else:
+                    self._health_active.pop(name, None)
+            self.beacons_ingested += 1
+            return True
+        if isinstance(payload, dict) \
+                and payload.get("type") == "health_beacon":
+            # healthd's per-beat heartbeat (ISSUE 16): watcher liveness
+            # for the HEALTH line even on a quiet fleet
+            self._health_beacon = payload
+            self._health_seen_ms = _now_ms() if now_ms is None else now_ms
             self.beacons_ingested += 1
             return True
         if not isinstance(payload, dict) \
@@ -247,7 +278,11 @@ class FleetAggregator:
         cur = st.payload.get("metrics") or {}
         dispatched = counter_total(cur, "manager.tasks_dispatched")
         completed = counter_total(cur, "manager.tasks_completed")
-        if not dispatched and not completed:
+        # queue depth (ISSUE 16): tasks accepted but not yet assigned —
+        # dispatch is capacity-gated, so THIS gauge (not the counter
+        # pair) is where an overload becomes visible
+        pending = (cur.get("gauges") or {}).get("manager.tasks_pending")
+        if not dispatched and not completed and pending is None:
             return None
         if st.prev_metrics is not None and st.last_seen_ms > st.prev_ts_ms:
             dt = (st.last_seen_ms - st.prev_ts_ms) / 1000.0
@@ -265,6 +300,7 @@ class FleetAggregator:
         return {
             "dispatched": int(dispatched),
             "completed": int(completed),
+            "pending": None if pending is None else int(pending),
             "tasks_per_s": round(max(0.0, d_done) / dt, 3),
             "completion_ratio": (round(completed / dispatched, 4)
                                  if dispatched else None),
@@ -481,6 +517,29 @@ class FleetAggregator:
                 out[k] = p[k]
         return out
 
+    def _health_rollup(self, now_ms: int) -> Optional[dict]:
+        """The health plane section (ISSUE 16): healthd's heartbeat +
+        the still-active confirmed breach episodes.  None until the
+        first health frame — "no watcher" must read unknown, never a
+        silent green."""
+        if self._health_beacon is None and not self.health_alerts:
+            return None
+        beacon = self._health_beacon
+        stale = None
+        if beacon is not None:
+            age_s = max(0.0, (now_ms - self._health_seen_ms) / 1000.0)
+            interval = beacon.get("interval_s") or 2.0
+            stale = age_s > 3 * float(interval) + 2.0
+        return {
+            "beacon": beacon,
+            "stale": stale,
+            "active": [self._health_active[k]
+                       for k in sorted(self._health_active)],
+            "alerts": len(self.health_alerts),
+            "last": (self.health_alerts[-1]
+                     if self.health_alerts else None),
+        }
+
     def rollup(self, now_ms: Optional[int] = None) -> dict:
         """The fleet-wide snapshot fleet_top renders / dumps as JSON."""
         now_ms = _now_ms() if now_ms is None else now_ms
@@ -497,6 +556,8 @@ class FleetAggregator:
         mgr = [p["mgr_tasks"] for p in peers.values() if p["mgr_tasks"]]
         dispatched = sum(t["dispatched"] for t in mgr)
         completed = sum(t["completed"] for t in mgr)
+        pending = [t["pending"] for t in mgr
+                   if t.get("pending") is not None]
         # federated regions (ISSUE 14): one row per region manager —
         # per-region tasks/s + the handoff ledger the REGIONS line shows
         fed_peers = [(peer, p) for peer, p in peers.items()
@@ -580,6 +641,7 @@ class FleetAggregator:
             "replay": self._replay_rollup(now_ms),
             "federation": federation,
             "ha": ha,
+            "health": self._health_rollup(now_ms),
             "peers": peers,
             "fleet": {
                 "peers": len(peers),
@@ -593,6 +655,9 @@ class FleetAggregator:
                 "ticks_over_budget": sum(t["over_budget"] for t in ticks),
                 "tasks_dispatched": dispatched if mgr else None,
                 "tasks_completed": completed if mgr else None,
+                # None when no manager exports the gauge: queue-depth
+                # absence must read unknown, never an empty queue
+                "tasks_pending": sum(pending) if pending else None,
                 "tasks_per_s": (round(sum(t["tasks_per_s"] for t in mgr), 3)
                                 if mgr else None),
                 "completion_ratio": (round(completed / dispatched, 4)

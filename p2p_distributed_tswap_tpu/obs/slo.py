@@ -184,6 +184,38 @@ def _breaching_phase(signals: dict, phases_path: str) -> Optional[str]:
     return best
 
 
+def evaluate_one(slo: dict, signals: dict) -> dict:
+    """Judge ONE normalized SLO entry against ``signals`` — the
+    single-evaluation core shared by the offline gate (:func:`evaluate`)
+    and the continuous watcher (obs/health.py), so a live verdict and a
+    re-judged saved dump can never drift apart.  A missing or
+    non-numeric signal is an explicit ``unknown`` status — never a
+    silent pass — in BOTH paths."""
+    observed = lookup(signals, slo["signal"])
+    threshold = {k: slo[k] for k in ("min", "max") if slo.get(k)
+                 is not None}
+    v = {"name": slo["name"], "signal": slo["signal"],
+         "observed": observed, "threshold": threshold}
+    if not isinstance(observed, (int, float)) \
+            or isinstance(observed, bool):
+        v["observed"] = None if not isinstance(
+            observed, (int, float, str)) else observed
+        v["status"] = "unknown"
+    else:
+        breached = ((slo.get("min") is not None
+                     and observed < slo["min"])
+                    or (slo.get("max") is not None
+                        and observed > slo["max"]))
+        v["status"] = "fail" if breached else "pass"
+    if slo.get("phases"):
+        # attribution rides the verdict pass OR fail — a passing
+        # latency SLO's dominant phase is the headroom map
+        bp = _breaching_phase(signals, slo["phases"])
+        if bp is not None:
+            v["breaching_phase"] = bp
+    return v
+
+
 def evaluate(spec: Union[dict, str, None], signals: dict) -> dict:
     """Judge every SLO in ``spec`` against ``signals``.
 
@@ -192,31 +224,8 @@ def evaluate(spec: Union[dict, str, None], signals: dict) -> dict:
     breaching_phase?}]}`` with verdicts in spec order.  ``ok`` is True
     only when EVERY SLO passed — unknown is not a pass."""
     spec = load_spec(spec)
-    verdicts: List[dict] = []
-    for slo in spec["slos"]:
-        observed = lookup(signals, slo["signal"])
-        threshold = {k: slo[k] for k in ("min", "max") if slo.get(k)
-                     is not None}
-        v = {"name": slo["name"], "signal": slo["signal"],
-             "observed": observed, "threshold": threshold}
-        if not isinstance(observed, (int, float)) \
-                or isinstance(observed, bool):
-            v["observed"] = None if not isinstance(
-                observed, (int, float, str)) else observed
-            v["status"] = "unknown"
-        else:
-            breached = ((slo.get("min") is not None
-                         and observed < slo["min"])
-                        or (slo.get("max") is not None
-                            and observed > slo["max"]))
-            v["status"] = "fail" if breached else "pass"
-        if slo.get("phases"):
-            # attribution rides the verdict pass OR fail — a passing
-            # latency SLO's dominant phase is the headroom map
-            bp = _breaching_phase(signals, slo["phases"])
-            if bp is not None:
-                v["breaching_phase"] = bp
-        verdicts.append(v)
+    verdicts: List[dict] = [evaluate_one(slo, signals)
+                            for slo in spec["slos"]]
     failed = [v["name"] for v in verdicts if v["status"] == "fail"]
     unknown = [v["name"] for v in verdicts if v["status"] == "unknown"]
     return {"spec": spec.get("name", "unnamed"),
@@ -242,8 +251,8 @@ def signals_from_rollup(rollup: dict) -> dict:
     out: Dict[str, object] = {}
     fleet = rollup.get("fleet") or {}
     for k in ("tasks_per_s", "completion_ratio", "tasks_dispatched",
-              "tasks_completed", "peers", "stale_peers", "counter_resets",
-              "ticks", "ticks_over_budget"):
+              "tasks_completed", "tasks_pending", "peers", "stale_peers",
+              "counter_resets", "ticks", "ticks_over_budget"):
         if fleet.get(k) is not None:
             out[f"fleet.{k}"] = fleet[k]
     evictions = drops = 0

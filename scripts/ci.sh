@@ -42,6 +42,8 @@ python -m compileall -q -f \
     scripts/chaos_gate.py \
     p2p_distributed_tswap_tpu/runtime/ha.py \
     scripts/ha_smoke.py \
+    p2p_distributed_tswap_tpu/obs/health.py \
+    scripts/health_smoke.py \
     p2p_distributed_tswap_tpu/obs/capture.py \
     analysis/fleetsim.py \
     analysis/tenant_scaling.py \
@@ -237,6 +239,23 @@ then
         --log-dir /tmp/jg_ha_ci_logs
 else
     echo "HA failover smoke SKIPPED (no C++ toolchain / binaries)"
+fi
+
+echo "== health plane smoke =="
+# ISSUE 16: the continuous watcher over a live fleet — a steady clean
+# run must record ZERO alerts (false-alert gate), then a diurnal-ramp
+# overload must be FORECAST >= 2 evaluation intervals before the
+# confirmed hard breach, attributed to the overloaded manager with an
+# actuator recommendation, and the page must ship an auto-captured
+# replayable capture1 artifact.  An alerting plane that cries wolf, or
+# one that only confirms after the outage, both fail CI.
+if [[ -x cpp/build/mapd_bus && -x cpp/build/mapd_manager_centralized ]] \
+        || { command -v cmake >/dev/null && command -v ninja >/dev/null; }
+then
+    JAX_PLATFORMS=cpu python scripts/health_smoke.py \
+        --log-dir /tmp/jg_health_ci_logs
+else
+    echo "health plane smoke SKIPPED (no C++ toolchain / binaries)"
 fi
 
 echo "== federation smoke =="

@@ -1,0 +1,395 @@
+#!/usr/bin/env python
+"""Health-plane smoke (ISSUE 16, scripts/ci.sh): the live alerting proof.
+
+Brings up a real fleet (busd + open-loop C++ manager + sim agents) with
+an in-process :class:`HealthWatcher` (obs/health.py — the healthd body)
+under JG_HEALTH=1 and judges BOTH acceptance halves:
+
+- **clean** — steady achievable load for a full evaluation window must
+  record ZERO alerts (no confirmed breach, no forecast: a flat fleet
+  has no trend to extrapolate);
+- **ramp** — a diurnal-ramp overload (analysis/fleetsim.py
+  ``shape_rate``, the ``--shape ramp`` generator) drives the fleet's
+  completion ratio into a smooth monotone decline; the watcher must
+  emit a **forecast alert ≥ 2 evaluation intervals BEFORE the breach
+  confirms**, the confirmed page must **attribute** the breach to the
+  overloaded manager peer (backlog growth) with a ``shed_load``
+  recommendation, the page must carry an **auto-captured** replayable
+  ``capture1`` artifact, and the ``alert1`` frames must actually land
+  on the raw ``mapd.alert`` wire (a tap subscriber counts them).
+
+``--out FILE`` writes a JSON artifact (+ a ``.md`` sibling) — bench.py's
+``health`` axis and ``results/health_r17.json(.md)`` consume it.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/health_smoke.py
+  JAX_PLATFORMS=cpu python scripts/health_smoke.py --out /tmp/h.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+from p2p_distributed_tswap_tpu.obs import events as _events  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import flightrec as _flightrec  # noqa: E402,E501
+from p2p_distributed_tswap_tpu.obs import health as _health  # noqa: E402
+from p2p_distributed_tswap_tpu.obs import registry as _reg  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime import buspool  # noqa: E402
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient  # noqa: E402,E501
+from p2p_distributed_tswap_tpu.runtime.fleet import (  # noqa: E402
+    BUILD_DIR, ensure_built)
+from p2p_distributed_tswap_tpu.runtime.simagent import SimAgentPool  # noqa: E402,E501
+
+from analysis.fleetsim import shape_rate  # noqa: E402
+
+CLEAN_SPEC = {
+    "name": "health-smoke-clean",
+    "slos": [
+        # min well below the steady fleet's ratio: a clean run that
+        # still alerts is exactly the false-positive the judge rejects
+        {"name": "completion", "signal": "fleet.completion_ratio",
+         "min": 0.3},
+    ],
+}
+
+RAMP_SPEC = {
+    "name": "health-smoke-ramp",
+    "slos": [
+        # dispatch is capacity-gated, so an overload surfaces as queue
+        # depth (manager.tasks_pending, ISSUE 16) — under the ramp the
+        # backlog climbs smoothly, which is exactly the monotone trend
+        # the slope forecaster must catch BEFORE this bound breaks
+        {"name": "backlog", "signal": "fleet.tasks_pending",
+         "max": 40.0},
+    ],
+}
+
+
+def write_md(path: Path, doc: dict) -> None:
+    r = doc["ramp"]
+    c = doc["clean"]
+    fc = (r.get("forecast") or {}).get("forecast") or {}
+    att = (r.get("breach") or {}).get("attribution") or {}
+    reco = (r.get("breach") or {}).get("recommendation") or {}
+    lines = [
+        "# Health-plane smoke (ISSUE 16): forecast-before-breach "
+        "on a diurnal ramp",
+        "",
+        f"- verdict: **{'PASS' if doc['ok'] else 'FAIL'}**",
+        f"- fleet: {doc['agents']} sim agents, "
+        f"{doc['side']}x{doc['side']} map, 1 busd shard, "
+        f"open-loop C++ manager",
+        f"- evaluation interval: {doc['interval_s']} s "
+        f"(the beacon cadence)",
+        "",
+        "## Clean phase (steady achievable load)",
+        "",
+        f"- beats: {c['beats']}, alerts: **{c['alerts']}** "
+        f"(must be 0 — no confirmed breach, no forecast)",
+        "",
+        "## Ramp phase (diurnal overload via `--shape ramp`)",
+        "",
+        f"- injection: {r['base_rate']} → {r['peak_rate']} tasks/s "
+        f"over {r['period_s']} s (`shape_rate('ramp', ...)`)",
+        f"- forecast: `{(r.get('forecast') or {}).get('signal')}` crosses "
+        f"its SLO in ~{fc.get('eta_s')} s "
+        f"({fc.get('eta_intervals')} intervals, "
+        f"confidence {fc.get('confidence')})",
+        f"- forecast → confirmed breach lead: "
+        f"**{r.get('lead_intervals')} evaluation interval(s)** "
+        f"(acceptance: ≥ 2)",
+        f"- attribution: {att.get('kind')} `{att.get('id')}` "
+        f"(proc {att.get('proc')}) — {att.get('detail')}",
+        f"- recommendation: `{reco.get('actuator')}"
+        f"({reco.get('target')})` direction={reco.get('direction')}",
+        f"- auto-capture: `{(r.get('breach') or {}).get('capture')}` "
+        f"(replayable capture1)",
+        f"- alert1 frames observed on the raw `mapd.alert` wire: "
+        f"{r['alerts_on_wire']}",
+        "",
+    ]
+    path.write_text("\n".join(lines))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--agents", type=int, default=6)
+    ap.add_argument("--side", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--clean-s", type=float, default=24.0,
+                    help="steady-phase watch window")
+    ap.add_argument("--ramp-peak", type=float, default=8.0,
+                    help="ramp peak injection rate tasks/s")
+    ap.add_argument("--ramp-period-s", type=float, default=40.0)
+    ap.add_argument("--ramp-max-s", type=float, default=90.0,
+                    help="ramp-phase budget (forecast + confirm must "
+                         "land inside it)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the artifact JSON here (+ .md sibling)")
+    ap.add_argument("--log-dir", default="/tmp/jg_health_smoke")
+    args = ap.parse_args(argv)
+
+    ensure_built()
+    side = args.side
+    map_file = f"/tmp/health_smoke_{side}.map.txt"
+    Path(map_file).write_text("\n".join(["." * side] * side) + "\n")
+    log_dir = Path(args.log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    port = buspool.free_port()
+    saved_env = dict(os.environ)
+    procs, logs = [], []
+    import subprocess
+
+    def spawn(name, cmd, stdin=None):
+        log = open(log_dir / f"{name}.log", "w")
+        logs.append(log)
+        p = subprocess.Popen(cmd, stdin=stdin, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             env=dict(os.environ))
+        procs.append(p)
+        return p
+
+    pool = sim = tap = None
+    watcher = None
+    _reg.get_registry().clear()
+    try:
+        pool = buspool.BusPool(BUILD_DIR / "mapd_bus", num_shards=1,
+                               home_port=port, spawn=spawn)
+        time.sleep(0.3)
+        os.environ.update(pool.env())
+        os.environ["JG_HEALTH"] = "1"
+        # capture evidence (ISSUE 11): the sim pool's capture.meta /
+        # task.spec events ride THIS process's flight ring — bind it
+        # before the pool exists so the page's auto-capture can rebuild
+        # a replayable window from our own dump
+        os.environ["JG_FLIGHT_DIR"] = str(log_dir)
+        _events.configure("health_smoke")
+        mgr = spawn("manager", [
+            str(BUILD_DIR / "mapd_manager_centralized"),
+            "--port", str(port), "--map", map_file,
+            "--solver", "cpu", "--planning-interval-ms", "150",
+            "--seed", str(args.seed), "--open-loop",
+        ], stdin=subprocess.PIPE)
+        time.sleep(0.8)
+        sim = SimAgentPool(args.agents, side, port=port, seed=args.seed,
+                           heartbeat_s=1.0)
+        # the wire proof: alert1 frames must actually land on the raw
+        # mapd.alert topic, not just in the watcher's own lists
+        tap = BusClient(port=port, peer_id="health-smoke-tap")
+        tap.subscribe(_health.ALERT_TOPIC, raw=True)
+        sim.heartbeat_all()
+        sim.pump(2.0)
+
+        wire = {"alert1": 0, "health_beacon": 0}
+
+        def pump_tap():
+            while True:
+                f = tap.recv(timeout=0.01)
+                if not f:
+                    return
+                if f.get("op") != "msg":
+                    continue
+                t = (f.get("data") or {}).get("type")
+                if t in wire:
+                    wire[t] += 1
+
+        def inject(k):
+            mgr.stdin.write(f"tasks {k}\n".encode())
+            mgr.stdin.flush()
+
+        def drive(watcher, seconds, rate_fn=None):
+            """Pump sim + watcher + tap for ``seconds``, injecting
+            ``rate_fn(t)`` tasks once per second (None = no injection).
+            Returns alerts emitted during the drive."""
+            out = []
+            t0 = time.monotonic()
+            next_inject = t0
+            end = t0 + seconds
+            while time.monotonic() < end:
+                now = time.monotonic()
+                if rate_fn is not None and now >= next_inject:
+                    next_inject = now + 1.0
+                    k = int(round(rate_fn(now - t0)))
+                    if k > 0:
+                        inject(k)
+                sim.pump(0.25)
+                pump_tap()
+                out.extend(watcher.pump(0.25))
+            return out
+
+        def capture_dump():
+            # in-process evidence: the sim pool (and its capture.meta /
+            # task.spec events) live in THIS process, so the auditor's
+            # bus-wide flight_dump request would miss them — dump our
+            # own ring straight into the record dir instead
+            rec = _flightrec.get_recorder()
+            _flightrec.dump(str(log_dir / f"{rec.proc}-{rec.pid}"
+                                          ".flight.jsonl"),
+                            reason="health_alert")
+
+        # --- phase 1: settle, then a steady clean window -----------------
+        # let the first injected tasks complete BEFORE the engine starts
+        # sampling: the cold-start ratio (dispatched>0, completed=0) is
+        # startup, not an SLO story
+        settle_watch = _health.HealthWatcher(
+            BusClient(port=port, peer_id="healthd-settle"),
+            _health.HealthEngine(spec=CLEAN_SPEC),
+            publish=False)
+        drive(settle_watch, 10.0, rate_fn=lambda t: 1.0)
+        settle_watch.bus.close()
+
+        clean_watch = _health.HealthWatcher(
+            BusClient(port=port, peer_id="healthd-clean"),
+            _health.HealthEngine(spec=CLEAN_SPEC),
+            record_dir=str(log_dir), capture_dump=capture_dump)
+        clean_alerts = drive(clean_watch, args.clean_s,
+                             rate_fn=lambda t: 1.0)
+        clean_beats = clean_watch.engine.seq
+        clean_ratio = (clean_watch.agg.rollup()["fleet"]
+                       ["completion_ratio"])
+        clean_watch.bus.close()
+        print(f"health_smoke: clean phase — {clean_beats} beat(s), "
+              f"{len(clean_alerts)} alert(s), "
+              f"completion_ratio={clean_ratio}", flush=True)
+
+        # --- phase 2: diurnal ramp overload ------------------------------
+        ramp_base = 1.0
+        ramp_watch = _health.HealthWatcher(
+            BusClient(port=port, peer_id="healthd-ramp"),
+            _health.HealthEngine(spec=RAMP_SPEC),
+            record_dir=str(log_dir), capture_dump=capture_dump)
+        ramp_alerts = []
+        deadline = time.monotonic() + args.ramp_max_s
+        t_ramp0 = time.monotonic()
+
+        def ramp_rate(_t):
+            return shape_rate("ramp", time.monotonic() - t_ramp0,
+                              ramp_base, args.ramp_peak,
+                              args.ramp_period_s)
+
+        while time.monotonic() < deadline:
+            ramp_alerts.extend(drive(ramp_watch, 2.0,
+                                     rate_fn=ramp_rate))
+            if os.environ.get("JG_HEALTH_SMOKE_DEBUG"):
+                ru = ramp_watch.agg.rollup()["fleet"]
+                st = ramp_watch.engine._states.get("backlog")
+                fcst = st.forecaster if st else None
+                print(f"  t={time.monotonic() - t_ramp0:5.1f}s "
+                      f"pending={ru['tasks_pending']} "
+                      f"disp={ru['tasks_dispatched']} "
+                      f"done={ru['tasks_completed']} "
+                      f"slope={getattr(fcst, 'slope', None)} "
+                      f"conf={fcst.confidence() if fcst else None}",
+                      flush=True)
+            if any(a["kind"] == "breach" and a["state"] == "confirmed"
+                   for a in ramp_alerts):
+                break
+        pump_tap()
+        for a in ramp_alerts:
+            print("health_smoke: " + _health.render_alert(a),
+                  flush=True)
+        ramp_watch.bus.close()
+
+        forecast = next((a for a in ramp_alerts
+                         if a["kind"] == "forecast"), None)
+        breach = next((a for a in ramp_alerts
+                       if a["kind"] == "breach"
+                       and a["state"] == "confirmed"), None)
+        interval = ramp_watch.engine.interval_s
+        lead_intervals = None
+        if forecast and breach:
+            lead_intervals = round(
+                (breach["ts_ms"] - forecast["ts_ms"]) / 1000.0
+                / interval, 1)
+        att = (breach or {}).get("attribution") or {}
+        reco = (breach or {}).get("recommendation") or {}
+        capture_path = (breach or {}).get("capture")
+        capture_ok = bool(capture_path
+                          and Path(capture_path).exists())
+        attribution_ok = (att.get("kind") == "peer"
+                          and str(att.get("proc") or ""
+                                  ).startswith("manager"))
+        alerts_jsonl = log_dir / "healthd.alerts.jsonl"
+
+        ok = (len(clean_alerts) == 0
+              and clean_beats >= 8
+              and forecast is not None and breach is not None
+              and lead_intervals is not None and lead_intervals >= 2
+              and attribution_ok
+              and reco.get("actuator") in _health.ACTUATORS
+              and capture_ok
+              and wire["alert1"] >= 2
+              and alerts_jsonl.exists())
+
+        doc = {
+            "experiment": "health-plane smoke (ISSUE 16)",
+            "agents": args.agents,
+            "side": side,
+            "interval_s": interval,
+            "clean": {
+                "beats": clean_beats,
+                "alerts": len(clean_alerts),
+                "completion_ratio": clean_ratio,
+                "spec": CLEAN_SPEC,
+            },
+            "ramp": {
+                "base_rate": ramp_base,
+                "peak_rate": args.ramp_peak,
+                "period_s": args.ramp_period_s,
+                "spec": RAMP_SPEC,
+                "forecast": forecast,
+                "breach": breach,
+                "lead_intervals": lead_intervals,
+                "alerts_on_wire": wire["alert1"],
+                "health_beacons_on_wire": wire["health_beacon"],
+            },
+            "attribution_ok": attribution_ok,
+            "capture_ok": capture_ok,
+            "alerts_jsonl": str(alerts_jsonl),
+            "ok": ok,
+        }
+        print("health_smoke: " + json.dumps(
+            {k: doc[k] for k in ("ok", "attribution_ok", "capture_ok")}
+            | {"clean_alerts": len(clean_alerts),
+               "lead_intervals": lead_intervals,
+               "alerts_on_wire": wire["alert1"]}), flush=True)
+        if args.out:
+            out = Path(args.out)
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_text(json.dumps(doc, indent=2) + "\n")
+            write_md(out.with_suffix(".md"), doc)
+        if not ok:
+            print("health_smoke FAILED", file=sys.stderr)
+        return 0 if ok else 1
+    finally:
+        if sim is not None:
+            sim.close()
+        if tap is not None:
+            tap.close()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if pool is not None:
+            pool.close()
+        for log in logs:
+            log.close()
+        os.environ.clear()
+        os.environ.update(saved_env)
+        _events.configure("health_smoke")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

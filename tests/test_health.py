@@ -1,0 +1,645 @@
+"""Fleet health plane (ISSUE 16 tentpole, obs/health.py).
+
+Covers the contracts the alerting path leans on: strict ``health1`` /
+``alert1`` codecs (round-trip + malformed-version rejection, the
+capture1 discipline), the bounded/compacting history ring, EWMA-slope
+forecasting (flat/noisy/step inputs must NEVER forecast; a monotone
+ramp must), multi-window burn-rate episode lifecycle
+(fast-confirm → slow-deflap heal → re-arm, one transient sample never
+alerts), attribution picks on synthetic rollups, the aggregator
+``health`` section + fleet_top HEALTH/ALERT lines, blackbox
+``--alerts`` merging, fleetsim ``shape_rate`` generators, the shared
+``evaluate_one`` judging core, the JG_HEALTH-unset raw-socket wire pin,
+and — slow — the live e2e (ramp shape ⇒ forecast precedes breach) via
+scripts/health_smoke.py.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.obs import health
+from p2p_distributed_tswap_tpu.obs import slo as _slo
+from p2p_distributed_tswap_tpu.obs.fleet_aggregator import FleetAggregator
+
+ROOT = Path(__file__).resolve().parents[1]
+
+SPEC_MAX = {"name": "t", "slos": [
+    {"name": "lat", "signal": "x.p99", "max": 100.0}]}
+SPEC_MIN = {"name": "t", "slos": [
+    {"name": "ratio", "signal": "x.ratio", "min": 0.5}]}
+
+
+def _alert(**over):
+    base = {
+        "type": "alert1", "version": "alert1", "ts_ms": 1000, "seq": 3,
+        "name": "lat", "signal": "x.p99", "kind": "breach",
+        "state": "confirmed", "severity": "page", "observed": 140.0,
+        "threshold": {"max": 100.0},
+        "burn": {"fast": 1.0, "slow": 0.5},
+        "recommendation": {"direction": "up", "actuator": "shed_load",
+                           "target": "fleet"},
+    }
+    base.update(over)
+    return base
+
+
+def _health_rec(**over):
+    base = {"version": "health1", "ts_ms": 1000, "seq": 1,
+            "interval_s": 2.0, "signals": {"x.p99": 10.0},
+            "failed": [], "unknown": []}
+    base.update(over)
+    return base
+
+
+# -- health1 / alert1 codecs ------------------------------------------------
+
+def test_health_record_round_trip():
+    rec = _health_rec()
+    assert health.validate_health(
+        json.loads(json.dumps(rec))) == rec
+
+
+def test_health_rejects_wrong_version():
+    for bad in ("health2", "capture1", "", None, 7):
+        with pytest.raises(health.HealthError, match="version"):
+            health.validate_health(_health_rec(version=bad))
+
+
+def test_health_rejects_malformed_fields():
+    with pytest.raises(health.HealthError):
+        health.validate_health(_health_rec(ts_ms="soon"))
+    with pytest.raises(health.HealthError):
+        health.validate_health(_health_rec(signals=[1, 2]))
+    with pytest.raises(health.HealthError):
+        health.validate_health([])
+
+
+def test_alert_round_trip_and_version_rejection():
+    rec = _alert()
+    assert health.validate_alert(json.loads(json.dumps(rec))) == rec
+    for bad in ("alert2", "ledger1", None):
+        with pytest.raises(health.HealthError, match="version"):
+            health.validate_alert(_alert(version=bad))
+
+
+def test_alert_rejects_bad_enums():
+    with pytest.raises(health.HealthError):
+        health.validate_alert(_alert(kind="guess"))
+    with pytest.raises(health.HealthError):
+        health.validate_alert(_alert(state="maybe"))
+    with pytest.raises(health.HealthError):
+        health.validate_alert(_alert(severity="meh"))
+    # the recommendation IS the actuation wire contract: an unknown
+    # actuator must be rejected before a daemon ever routes on it
+    with pytest.raises(health.HealthError):
+        health.validate_alert(_alert(recommendation={
+            "direction": "up", "actuator": "reboot_planet",
+            "target": "x"}))
+    with pytest.raises(health.HealthError):
+        health.validate_alert(_alert(forecast={"eta_s": "soon"}))
+
+
+# -- the history ring -------------------------------------------------------
+
+def test_ring_bounded_in_memory():
+    ring = health.HealthRing(cap=4)
+    for i in range(10):
+        ring.append(_health_rec(seq=i))
+    assert [r["seq"] for r in ring.records] == [6, 7, 8, 9]
+
+
+def test_ring_persists_and_compacts(tmp_path):
+    p = tmp_path / "ring.jsonl"
+    ring = health.HealthRing(str(p), cap=4)
+    for i in range(20):
+        ring.append(_health_rec(seq=i))
+    # compaction keeps the file within 2x the cap
+    lines = [ln for ln in p.read_text().splitlines() if ln.strip()]
+    assert len(lines) <= 8
+    # reload sees exactly the retained tail, validated
+    ring2 = health.HealthRing(str(p), cap=4)
+    assert [r["seq"] for r in ring2.records] == [16, 17, 18, 19]
+
+
+def test_ring_load_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text(json.dumps(_health_rec()) + "\n"
+                 + json.dumps(_health_rec(version="health9")) + "\n")
+    with pytest.raises(health.HealthError, match="version"):
+        health.HealthRing.load(str(p))
+    p2 = tmp_path / "garbage.jsonl"
+    p2.write_text("{not json\n")
+    with pytest.raises(health.HealthError, match="not JSON"):
+        health.HealthRing.load(str(p2))
+
+
+# -- EWMA slope forecasting -------------------------------------------------
+
+def _feed(fc, values, dt=2.0):
+    for i, v in enumerate(values):
+        fc.observe(i * dt, v)
+
+
+def test_forecast_flat_never_fires():
+    fc = health.SlopeForecaster()
+    _feed(fc, [50.0] * 30)
+    assert fc.forecast(100.0, "max") is None
+
+
+def test_forecast_noisy_never_fires():
+    fc = health.SlopeForecaster()
+    _feed(fc, [40.0, 80.0, 30.0, 90.0, 50.0, 70.0] * 5)
+    assert fc.forecast(100.0, "max") is None
+
+
+def test_forecast_step_never_fires():
+    # a step is not a trend: the residual spikes exactly when the
+    # slope does, so confidence collapses
+    fc = health.SlopeForecaster()
+    _feed(fc, [50.0] * 10 + [95.0] * 3)
+    assert fc.forecast(100.0, "max") is None
+
+
+def test_forecast_monotone_ramp_fires_with_lead():
+    fc = health.SlopeForecaster()
+    _feed(fc, [50.0 + 2.0 * i for i in range(12)])  # +1/s toward 100
+    out = fc.forecast(100.0, "max")
+    assert out is not None
+    assert out["confidence"] >= health.FORECAST_CONFIDENCE
+    # value 72, slope ~1/s -> eta ~28 s
+    assert 10.0 < out["eta_s"] < 60.0
+    assert out["slope_per_s"] > 0
+
+
+def test_forecast_min_bound_falling_fires():
+    fc = health.SlopeForecaster()
+    _feed(fc, [0.9 - 0.02 * i for i in range(12)])
+    out = fc.forecast(0.5, "min")
+    assert out is not None and out["eta_s"] > 0
+
+
+def test_forecast_needs_min_samples_and_direction():
+    fc = health.SlopeForecaster()
+    _feed(fc, [50.0, 60.0, 70.0])  # only 3 samples
+    assert fc.forecast(100.0, "max") is None
+    fc2 = health.SlopeForecaster()
+    _feed(fc2, [50.0 - 2.0 * i for i in range(12)])  # heading AWAY
+    assert fc2.forecast(100.0, "max") is None
+
+
+def test_forecast_beyond_horizon_suppressed():
+    fc = health.SlopeForecaster(horizon_s=10.0)
+    _feed(fc, [50.0 + 2.0 * i for i in range(12)])  # eta ~28 s
+    assert fc.forecast(100.0, "max") is None
+
+
+# -- burn windows + episode lifecycle ---------------------------------------
+
+def _obs(eng, value, i, sig="x.p99"):
+    """One evaluation beat with fresh beacon evidence."""
+    return eng.observe({"beacons_ingested": i + 1},
+                       now_ms=1000 + i * 2000, signals={sig: value})
+
+
+def test_one_transient_sample_never_alerts():
+    eng = health.HealthEngine(spec=SPEC_MAX, interval=2.0)
+    seq = [50.0] * 5 + [500.0] + [50.0] * 10
+    out = []
+    for i, v in enumerate(seq):
+        out += _obs(eng, v, i)
+    assert [a for a in out if a["kind"] == "breach"] == []
+
+
+def test_confirm_requires_full_fast_window_and_streak():
+    eng = health.HealthEngine(spec=SPEC_MAX, interval=2.0)
+    out = []
+    i = 0
+    # fast window (3) + confirm streak (2): nothing may page until the
+    # window is FULL of breaches AND the streak is sustained
+    for v in [50.0, 500.0, 500.0, 500.0]:
+        out += _obs(eng, v, i)
+        i += 1
+    assert out == []
+    out += _obs(eng, 500.0, i)
+    breach = next(a for a in out if a["kind"] == "breach")
+    assert breach["state"] == "confirmed"
+    assert breach["severity"] == "page"
+    assert breach["burn"]["fast"] == 1.0
+
+
+def test_episode_confirm_heal_rearm():
+    eng = health.HealthEngine(spec=SPEC_MAX, interval=2.0)
+    out = []
+    i = 0
+    for _ in range(8):  # confirm
+        out += _obs(eng, 500.0, i)
+        i += 1
+    assert sum(1 for a in out
+               if a["kind"] == "breach"
+               and a["state"] == "confirmed") == 1
+    assert len(eng.active()) == 1
+    # healing requires the FULL slow window clean (de-flap): a couple
+    # of good samples must not heal
+    out2 = []
+    for _ in range(3):
+        out2 += _obs(eng, 50.0, i)
+        i += 1
+    assert [a for a in out2 if a["state"] == "healed"] == []
+    for _ in range(eng.slow + 2):
+        out2 += _obs(eng, 50.0, i)
+        i += 1
+    healed = [a for a in out2 if a["state"] == "healed"]
+    assert len(healed) == 1
+    assert eng.active() == []
+    # re-arm: a NEW sustained breach re-confirms (never latched)
+    out3 = []
+    for _ in range(8):
+        out3 += _obs(eng, 500.0, i)
+        i += 1
+    assert sum(1 for a in out3
+               if a["kind"] == "breach"
+               and a["state"] == "confirmed") == 1
+
+
+def test_stale_rollup_never_advances_streaks():
+    """Repeated rollups without fresh beacons (mark unchanged) must not
+    sustain a confirm streak — a wedged fleet is not new evidence."""
+    eng = health.HealthEngine(spec=SPEC_MAX, interval=2.0)
+    out = []
+    for i in range(20):
+        out += eng.observe({"beacons_ingested": 1},  # mark frozen
+                           now_ms=1000 + i * 2000,
+                           signals={"x.p99": 500.0})
+    # only the FIRST observe was fresh: no window fill, no page
+    assert [a for a in out if a["kind"] == "breach"] == []
+
+
+def test_ramp_forecast_precedes_breach_by_two_intervals():
+    eng = health.HealthEngine(spec=SPEC_MAX, interval=2.0)
+    out, v = [], 50.0
+    for i in range(30):
+        out += _obs(eng, v, i)
+        v += 6.0
+    fc = next(a for a in out if a["kind"] == "forecast")
+    br = next(a for a in out if a["kind"] == "breach")
+    assert fc["severity"] == "warn"
+    assert fc["forecast"]["eta_intervals"] > 0
+    lead = (br["ts_ms"] - fc["ts_ms"]) / 1000.0 / eng.interval_s
+    assert lead >= 2
+    # one forecast per episode, not one per beat
+    assert sum(1 for a in out if a["kind"] == "forecast") == 1
+
+
+def test_engine_records_ring_history():
+    eng = health.HealthEngine(spec=SPEC_MAX, interval=2.0)
+    _obs(eng, 50.0, 0)
+    _obs(eng, 500.0, 1)
+    recs = list(eng.ring.records)
+    assert len(recs) == 2
+    assert recs[0]["failed"] == [] and recs[1]["failed"] == ["lat"]
+    for r in recs:
+        health.validate_health(r)
+
+
+def test_unknown_signal_stays_unknown_no_alert():
+    eng = health.HealthEngine(spec=SPEC_MAX, interval=2.0)
+    out = []
+    for i in range(10):
+        out += eng.observe({"beacons_ingested": i + 1},
+                           now_ms=1000 + i * 2000, signals={})
+    assert out == []
+    assert list(eng.ring.records)[-1]["unknown"] == ["lat"]
+
+
+# -- attribution ------------------------------------------------------------
+
+def _bus_rollup():
+    return {
+        "fleet": {"tasks_dispatched": 100, "tasks_completed": 60},
+        "peers": {
+            "busd-1": {"proc": "busd", "shard": 0, "bus": {
+                "slow_consumer_drops": 0, "slow_consumer_evictions": 0,
+                "queued_bytes": 10, "fanout_kbps": 5.0}},
+            "busd-2": {"proc": "busd", "shard": 1, "bus": {
+                "slow_consumer_drops": 40, "slow_consumer_evictions": 2,
+                "queued_bytes": 90000, "fanout_kbps": 900.0}},
+        },
+    }
+
+
+def test_attribution_bus_signal_picks_hot_shard():
+    slo_entry = {"name": "shed", "signal": "bus.slow_consumer_drops",
+                 "max": 0}
+    v = {"threshold": {"max": 0}, "observed": 40}
+    att, reco = health.attribute(_bus_rollup(), None, slo_entry, v)
+    assert att["kind"] == "bus_shard" and att["id"] == "s1"
+    assert reco == {"direction": "up", "actuator": "spawn_shard",
+                    "target": "s1"}
+
+
+def test_attribution_region_pick_and_merge_direction():
+    rollup = {
+        "fleet": {"tasks_dispatched": 50, "tasks_completed": 50},
+        "federation": {"per_region": {
+            "r0": {"peer": "mgr-a", "tasks_per_s": 0.1,
+                   "pending_handoffs": 0},
+            "r1": {"peer": "mgr-b", "tasks_per_s": 9.0,
+                   "pending_handoffs": 7},
+        }},
+        "peers": {},
+    }
+    slo_entry = {"name": "hand", "signal": "fed.handoffs_sent",
+                 "max": 10}
+    v = {"threshold": {"max": 10}, "observed": 12}
+    att, reco = health.attribute(rollup, None, slo_entry, v)
+    assert att["kind"] == "region" and att["id"] == "r1"
+    assert reco["actuator"] == "split_region"
+    # min-breach with NO backlog = idle fleet: scale-in, coldest region
+    slo2 = {"name": "tps", "signal": "fed.tasks", "min": 5}
+    v2 = {"threshold": {"min": 5}, "observed": 1}
+    att2, reco2 = health.attribute(rollup, None, slo2, v2)
+    assert att2["id"] == "r0"
+    assert reco2 == {"direction": "down", "actuator": "merge_regions",
+                     "target": "r0"}
+
+
+def test_attribution_tenant_from_audit_ns():
+    rollup = {
+        "fleet": {"tasks_dispatched": 10, "tasks_completed": 2},
+        "audit": {"active": [
+            {"class": "roster", "ns": "acme", "peer_a": "m1",
+             "detail": "view fork"}]},
+        "peers": {},
+    }
+    slo_entry = {"name": "x", "signal": "fleet.tasks_per_s", "min": 1}
+    v = {"threshold": {"min": 1}, "observed": 0.1}
+    att, reco = health.attribute(rollup, None, slo_entry, v)
+    assert att["kind"] == "tenant" and att["id"] == "acme"
+    assert reco["actuator"] == "evict_tenant"
+
+
+def test_attribution_manager_backlog_fallback():
+    rollup = {
+        "fleet": {"tasks_pending": 30, "tasks_dispatched": 40,
+                  "tasks_completed": 20},
+        "peers": {
+            "mgr-1": {"proc": "manager_centralized", "mgr_tasks": {
+                "dispatched": 40, "completed": 20, "pending": 30}},
+        },
+    }
+    slo_entry = {"name": "backlog", "signal": "fleet.tasks_pending",
+                 "max": 10}
+    v = {"threshold": {"max": 10}, "observed": 30}
+    att, reco = health.attribute(rollup, None, slo_entry, v)
+    assert att["kind"] == "peer" and att["id"] == "mgr-1"
+    assert att["proc"] == "manager_centralized"
+    assert reco["actuator"] == "shed_load"
+
+
+def test_attribution_empty_rollup_targets_fleet():
+    slo_entry = {"name": "x", "signal": "fleet.tasks_per_s", "min": 1}
+    v = {"threshold": {"min": 1}, "observed": 0}
+    att, reco = health.attribute({}, None, slo_entry, v)
+    assert att is None
+    assert reco["target"] == "fleet"
+    assert reco["actuator"] == "shed_load"
+
+
+# -- aggregator health section + fleet_top lines ----------------------------
+
+def test_aggregator_health_section_tracks_episodes():
+    agg = FleetAggregator()
+    assert agg.rollup()["health"] is None
+    assert agg.ingest({"type": "health_beacon", "seq": 5,
+                       "interval_s": 2.0, "spec": "rated-load",
+                       "active": 0, "alerts": 0}, now_ms=1000)
+    assert agg.ingest(_alert(), now_ms=1100)
+    h = agg.rollup(now_ms=1200)["health"]
+    assert h["beacon"]["seq"] == 5
+    assert h["stale"] is False
+    assert [a["name"] for a in h["active"]] == ["lat"]
+    # the heal removes the episode from active
+    assert agg.ingest(_alert(state="healed"), now_ms=1300)
+    h2 = agg.rollup(now_ms=1400)["health"]
+    assert h2["active"] == [] and h2["alerts"] == 2
+    # a dead watcher reads stale, never silently green
+    h3 = agg.rollup(now_ms=1000 + 60_000)["health"]
+    assert h3["stale"] is True
+
+
+def test_fleet_top_health_and_alert_lines():
+    sys.path.insert(0, str(ROOT / "analysis"))
+    import fleet_top
+
+    agg = FleetAggregator()
+    agg.ingest({"type": "metrics_beacon", "peer_id": "m1",
+                "proc": "manager_centralized", "pid": 1,
+                "interval_s": 2.0, "metrics": {}}, now_ms=1000)
+    agg.ingest({"type": "health_beacon", "seq": 7, "interval_s": 2.0,
+                "spec": "rated-load", "active": 1, "alerts": 2},
+               now_ms=1000)
+    agg.ingest(_alert(
+        forecast={"eta_s": 12.0, "confidence": 0.8,
+                  "eta_intervals": 6.0},
+        attribution={"kind": "peer", "id": "m1", "detail": "backlog"},
+        recommendation={"direction": "up", "actuator": "shed_load",
+                        "target": "m1"}), now_ms=1000)
+    out = fleet_top.render(agg.rollup(now_ms=1100))
+    assert "HEALTH spec=rated-load seq=7" in out
+    assert "ALERT PAGE [lat]" in out
+    assert "eta=12" in out
+    assert "peer m1" in out
+    assert "shed_load(m1)" in out
+
+
+def test_fleet_top_no_health_line_without_watcher():
+    sys.path.insert(0, str(ROOT / "analysis"))
+    import fleet_top
+
+    agg = FleetAggregator()
+    agg.ingest({"type": "metrics_beacon", "peer_id": "m1",
+                "proc": "manager_centralized", "pid": 1,
+                "interval_s": 2.0, "metrics": {}}, now_ms=1000)
+    out = fleet_top.render(agg.rollup(now_ms=1100))
+    assert "HEALTH" not in out and "ALERT" not in out
+
+
+# -- blackbox --alerts ------------------------------------------------------
+
+def test_blackbox_merges_alerts(tmp_path):
+    (tmp_path / "healthd.alerts.jsonl").write_text(
+        json.dumps(_alert(
+            capture="/tmp/x.capture.json",
+            attribution={"kind": "peer", "id": "m1",
+                         "detail": "backlog"})) + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "analysis" / "blackbox.py"),
+         "--dir", str(tmp_path), "--alerts", "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["health_alerts"] == 1
+    ev = doc["events"][0]
+    assert ev["event"] == "health.alert"
+    assert ev["peer"] == "peer:m1"
+    assert ev["capture"] == "/tmp/x.capture.json"
+    # without --alerts the same dir is empty (and exits 1)
+    proc2 = subprocess.run(
+        [sys.executable, str(ROOT / "analysis" / "blackbox.py"),
+         "--dir", str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert proc2.returncode == 1
+
+
+# -- fleetsim traffic shapes ------------------------------------------------
+
+def test_shape_rate_generators():
+    sys.path.insert(0, str(ROOT / "analysis"))
+    from fleetsim import shape_rate
+
+    # ramp: linear base->peak across the period, held at peak after
+    assert shape_rate("ramp", 0.0, 1.0, 9.0, 40.0) == 1.0
+    assert shape_rate("ramp", 20.0, 1.0, 9.0, 40.0) == pytest.approx(5.0)
+    assert shape_rate("ramp", 40.0, 1.0, 9.0, 40.0) == 9.0
+    assert shape_rate("ramp", 400.0, 1.0, 9.0, 40.0) == 9.0
+    # flash: base except the last 20% of each period
+    assert shape_rate("flash", 5.0, 1.0, 9.0, 40.0) == 1.0
+    assert shape_rate("flash", 33.0, 1.0, 9.0, 40.0) == 9.0
+    assert shape_rate("flash", 45.0, 1.0, 9.0, 40.0) == 1.0  # wraps
+    # storm: 4-step staircase base->peak
+    steps = {shape_rate("storm", t, 1.0, 7.0, 40.0)
+             for t in (0.0, 11.0, 21.0, 31.0)}
+    assert steps == {1.0, 3.0, 5.0, 7.0}
+    # none / unknown: the legacy constant wire
+    assert shape_rate("none", 33.0, 2.5, 9.0, 40.0) == 2.5
+    assert shape_rate("weird", 33.0, 2.5, 9.0, 40.0) == 2.5
+
+
+# -- shared judging core (obs/slo.py satellite) -----------------------------
+
+def test_evaluate_one_matches_evaluate_and_keeps_unknown_rule():
+    spec = _slo.load_spec(SPEC_MAX)
+    entry = spec["slos"][0]
+    v = _slo.evaluate_one(entry, {"x.p99": 140.0})
+    assert v["status"] == "fail"
+    # the missing-signal => explicit unknown rule holds in the shared
+    # core (and therefore in BOTH the CLI and healthd paths)
+    v2 = _slo.evaluate_one(entry, {})
+    assert v2["status"] == "unknown"
+    full = _slo.evaluate(spec, {"x.p99": 140.0})
+    assert full["verdicts"][0] == v
+    assert _slo.exit_code(_slo.evaluate(spec, {})) == 2
+
+
+# -- kill switch ------------------------------------------------------------
+
+def test_health_kill_switch_env():
+    saved = os.environ.get(health.KILL_ENV)
+    try:
+        os.environ.pop(health.KILL_ENV, None)
+        assert not health.enabled()  # OFF by default: wire pinned
+        os.environ[health.KILL_ENV] = "0"
+        assert not health.enabled()
+        os.environ[health.KILL_ENV] = "1"
+        assert health.enabled()
+    finally:
+        if saved is None:
+            os.environ.pop(health.KILL_ENV, None)
+        else:
+            os.environ[health.KILL_ENV] = saved
+
+
+def _capture_fleet_top_bytes(env_extra, seconds=2.0):
+    """Raw-socket pin (the test_ha idiom): a fake bus hub captures
+    every byte fleet_top's client sends during a short --once run."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    received = []
+
+    def server():
+        conn, _ = srv.accept()
+        conn.sendall(b'{"op":"welcome","peer_id":"x",'
+                     b'"caps":["relay1"]}\n')
+        end = time.monotonic() + seconds
+        buf = b""
+        conn.settimeout(0.25)
+        while time.monotonic() < end:
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            if not chunk:
+                break
+            buf += chunk
+        received.append(buf)
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    env = {**os.environ, "JG_AUDIT": "0", **env_extra}
+    env.pop("JG_HA", None)
+    proc = subprocess.Popen(
+        [sys.executable, str(ROOT / "analysis" / "fleet_top.py"),
+         "--port", str(port), "--once", "--wait", "1.2"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    try:
+        t.join(timeout=seconds + 30)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        srv.close()
+    assert received, "fleet_top never connected to the pin socket"
+    return received[0]
+
+
+def test_health_kill_switch_pins_wire():
+    """JG_HEALTH unset keeps fleet_top's byte stream free of ANY
+    health-plane traffic (no mapd.alert subscription); JG_HEALTH=1
+    subscribes — token-pinned, the established kill-switch idiom."""
+    saved = os.environ.pop("JG_HEALTH", None)
+    try:
+        quiet = _capture_fleet_top_bytes({})
+    finally:
+        if saved is not None:
+            os.environ["JG_HEALTH"] = saved
+    assert b"mapd.alert" not in quiet
+    loud = _capture_fleet_top_bytes({"JG_HEALTH": "1"})
+    assert b"mapd.alert" in loud
+
+
+# -- live e2e (slow): ramp shape => forecast precedes breach ----------------
+
+@pytest.mark.slow
+def test_live_ramp_forecast_precedes_breach(tmp_path):
+    """The full acceptance path via scripts/health_smoke.py: a steady
+    clean run records zero alerts; a diurnal-ramp overload forecasts
+    >= 2 evaluation intervals before the confirmed breach, attributes
+    it to the overloaded manager, and ships an auto-capture."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "health_smoke", ROOT / "scripts" / "health_smoke.py")
+    hs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hs)
+    out = tmp_path / "health_e2e.json"
+    rc = hs.main(["--out", str(out),
+                  "--log-dir", str(tmp_path / "logs")])
+    doc = json.loads(out.read_text())
+    assert rc == 0, doc
+    assert doc["clean"]["alerts"] == 0
+    assert doc["ramp"]["lead_intervals"] >= 2
+    assert doc["attribution_ok"] and doc["capture_ok"]
+    assert Path(doc["ramp"]["breach"]["capture"]).exists()
