@@ -64,6 +64,11 @@ VARIANTS = {
     "flat-json": {"JG_REGION_GOSSIP": "0", "JG_BUS_FASTFRAME": "0"},
     "flat": {"JG_REGION_GOSSIP": "0"},
     "region": {},
+    # ISSUE 18: region wire + same-host shared-memory rings — identical
+    # traffic, the droppable class moves out of the TCP stack entirely
+    "shm": {"JG_BUS_SHM": "1"},
+    # rings + per-region beacon coalescing (one agg1 frame per window)
+    "shm-agg": {"JG_BUS_SHM": "1", "JG_BUS_AGG_MS": "10"},
 }
 
 
@@ -211,7 +216,7 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int,
             # BEFORE the window; the spy disconnects so the measured
             # fanout never includes it
             pos_share = None
-            if variant != "region":
+            if variant in ("flat-json", "flat"):
                 pos_share = _sample_pos_share(port, 2.0)
             watch.samples.clear()
             cpu0 = _pool_cpu_s(busd_pids)
@@ -246,7 +251,7 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int,
                 }
             fan_msgs = _busd_delta(watch, "bus.fanout_msgs")
             fan_bytes = _busd_delta(watch, "bus.fanout_bytes")
-            if variant == "region":
+            if variant not in ("flat-json", "flat"):
                 pos_fan_bytes = _busd_delta(
                     watch, "bus.fanout_bytes",
                     topic_prefix=region.POS_TOPIC_PREFIX)
@@ -289,6 +294,21 @@ def run_variant(variant: str, args, map_file: str, tick_ms: int,
                     watch, "bus.slow_consumer_drops")),
                 "tasks_done_in_window": int(tasks_done),
             }
+            if variant.startswith("shm"):
+                # lane-plane evidence: how much of the fanout actually
+                # rode the rings, and whether overflow fallbacks fired
+                row["shm_tx_frames_per_s"] = round(
+                    _busd_delta(watch, "bus.shm_tx_frames") / wall, 1)
+                row["shm_rx_frames_per_s"] = round(
+                    _busd_delta(watch, "bus.shm_rx_frames") / wall, 1)
+                row["shm_fallbacks"] = int(_busd_delta(
+                    watch, "bus.shm_fallbacks"))
+            if variant == "shm-agg":
+                row["agg_flushes_per_s"] = round(
+                    _busd_delta(watch, "bus.agg_flushes") / wall, 1)
+                row["agg_entries_per_flush"] = round(
+                    _busd_delta(watch, "bus.agg_entries")
+                    / max(1.0, _busd_delta(watch, "bus.agg_flushes")), 1)
             if shards > 1:
                 # per-shard breakdown: peak fanout (the new headroom
                 # metric), CPU share, and the peering tax
@@ -404,6 +424,18 @@ def main():
                                  by["flat"]["busd_cpu_us_per_msg"]]
     if ratios:
         result["pos_fanout_bytes_ratio_flatjson_over_region"] = ratios
+    # shm-lane comparison (ISSUE 18 acceptance: µs/msg strictly below
+    # the TCP region wire on identical traffic)
+    for tick_ms, by in sorted(by_tick.items()):
+        rg = by.get("region", {})
+        for key in ("shm", "shm-agg"):
+            r = by.get(key, {})
+            if rg.get("busd_cpu_us_per_msg") is None \
+                    or r.get("busd_cpu_us_per_msg") is None:
+                continue
+            result.setdefault("busd_cpu_us_per_msg_region_vs_" + key,
+                              {})[str(tick_ms)] = [
+                rg["busd_cpu_us_per_msg"], r["busd_cpu_us_per_msg"]]
     # shard-pool vs single-hub comparison at each rung (ISSUE 6
     # acceptance: aggregate CPU/msg and per-shard peak fanout improve,
     # tasks/s holds)

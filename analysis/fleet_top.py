@@ -85,6 +85,11 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
             f" links={p['bus']['peer_links']}"
             f" peer rx/tx={p['bus']['peer_rx_msgs']}/{p['bus']['peer_tx_msgs']}"
             f" drops={p['bus']['slow_consumer_drops']}"
+            f" shm={p['bus'].get('shm_lanes', 0)}l"
+            f"/{p['bus'].get('shm_rx_frames', 0)}rx"
+            f"/{p['bus'].get('shm_fallbacks', 0)}fb"
+            f" agg={p['bus'].get('agg_entries', 0)}"
+            f"/{p['bus'].get('agg_flushes', 0)}f"
             for peer, p in bus_rows))
     # field-engine health (ISSUE 9): per-cause sweeps, repair counters,
     # queue depth + starvation age, dynamic-world seq — solverd rows

@@ -814,6 +814,13 @@ def run_replay(capture: dict, log_dir, solver=None, shards=None,
             # control-plane HA (ISSUE 15): every region manager ships
             # its ledger1 stream and gets a warm standby below
             os.environ["JG_HA"] = "1"
+        if chaos is not None and getattr(chaos, "needs_shm", False):
+            # zero-copy lanes (ISSUE 18): the lane faults replay with
+            # the rings armed for every client spawned below (and the
+            # in-process sim pool); ring files live with the run's logs
+            os.environ["JG_BUS_SHM"] = "1"
+            os.environ.setdefault("JG_BUS_SHM_DIR",
+                                  str(log_dir / "shm_lanes"))
         _trace.configure(proc="simfleet")
         _events.configure("simfleet")
 

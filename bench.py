@@ -119,6 +119,12 @@ Replay axis (ISSUE 11): unless BENCH_REPLAY=0, the headline carries a
 original, outcome intactness (nothing lost/duplicated), and the final
 ledger/view digests — so deterministic reproducibility stays measured
 on the BENCH trajectory.
+
+Bus axis (ISSUE 18): unless BENCH_BUS=0, the headline carries a ``bus``
+record — same-host beacon throughput per hub core (beacons relayed per
+busd CPU-second) with the shared-memory rings OFF vs ON on identical
+pos1 traffic, plus the ring share and overflow-fallback count for the
+shm rung.
 """
 
 from __future__ import annotations
@@ -1054,6 +1060,112 @@ def run_audit_axis() -> dict:
     return out
 
 
+def run_bus_axis() -> dict:
+    """Bus-lane rung (ISSUE 18): beacons relayed per busd CPU-second
+    (beacons/s/core — the hub's relay loop is the single core the
+    fanout burns) with the shm rings off vs on, identical single-host
+    pos1 traffic.  Failures are recorded, never fatal."""
+    import base64
+    import tempfile
+    import threading
+
+    from p2p_distributed_tswap_tpu.obs import registry as regmod
+    from p2p_distributed_tswap_tpu.runtime import plan_codec
+    from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+    from p2p_distributed_tswap_tpu.runtime.buspool import free_port
+    from p2p_distributed_tswap_tpu.runtime.fleet import BUILD_DIR
+
+    busd = BUILD_DIR / "mapd_bus"
+    if not busd.exists():
+        return {"skipped": "C++ runtime unavailable"}
+
+    def busd_cpu_s(pid: int) -> float:
+        stat = open(f"/proc/{pid}/stat").read().rsplit(") ", 1)[1].split()
+        return (int(stat[11]) + int(stat[12])) / os.sysconf("SC_CLK_TCK")
+
+    def rung(shm: bool, window_s: float = 3.0) -> dict:
+        lane_dir = tempfile.mkdtemp(prefix="jg-bench-bus-")
+        env = dict(os.environ, JG_BUS_SHM="1" if shm else "0",
+                   JG_BUS_SHM_DIR=lane_dir)
+        port = free_port()
+        proc = subprocess.Popen([str(busd), str(port)], env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+        try:
+            time.sleep(0.3)
+            r_sub = regmod.Registry()
+            sub = BusClient(port=port, peer_id="bench-sub",
+                            registry=r_sub, shm=shm)
+            pub = BusClient(port=port, peer_id="bench-pub",
+                            registry=regmod.Registry(), shm=shm)
+            for c in (sub, pub):
+                end = time.monotonic() + 3
+                while c.hub_caps is None and time.monotonic() < end:
+                    c.recv(timeout=0.1)
+            sub.subscribe("mapd.pos.0.0")
+            time.sleep(0.2)
+            beacon = {"type": "pos1", "data": base64.b64encode(
+                plan_codec.encode_pos1(7, 42)).decode()}
+            got = [0]
+            stop = threading.Event()
+
+            def drain():
+                while not stop.is_set():
+                    f = sub.recv(timeout=0.2)
+                    if f and f.get("op") == "msg":
+                        got[0] += 1
+
+            t = threading.Thread(target=drain, daemon=True)
+            t.start()
+            cpu0, t0 = busd_cpu_s(proc.pid), time.monotonic()
+            sent = 0
+            while time.monotonic() - t0 < window_s:
+                for _ in range(50):
+                    pub.publish("mapd.pos.0.0", beacon)
+                    sent += 1
+                time.sleep(0.001)  # keep the rings drainable
+            # let the tail flush before sampling the counters
+            time.sleep(0.3)
+            cpu = busd_cpu_s(proc.pid) - cpu0
+            wall = time.monotonic() - t0
+            stop.set()
+            t.join(timeout=2)
+            counters = r_sub.snapshot()["counters"]
+            row = {
+                "shm": shm,
+                "window_s": round(wall, 2),
+                "beacons_sent": sent,
+                "beacons_delivered": got[0],
+                "busd_cpu_s": round(cpu, 3),
+                "beacons_per_s_per_core": round(got[0] / max(cpu, 1e-6)),
+                "busd_cpu_us_per_beacon": round(1e6 * cpu
+                                                / max(got[0], 1), 3),
+            }
+            if shm:
+                row["shm_rx_frames"] = int(
+                    counters.get("bus.shm_rx_frames", 0))
+                pc = pub.registry.snapshot()["counters"]
+                row["shm_tx_frames"] = int(pc.get("bus.shm_tx_frames", 0))
+                row["shm_fallbacks"] = int(pc.get("bus.shm_fallbacks", 0))
+            pub.close()
+            sub.close()
+            return row
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    try:
+        off = rung(False)
+        on = rung(True)
+    except Exception as e:  # noqa: BLE001 — axis must never kill BENCH
+        return {"error": f"{type(e).__name__}: {e}"}
+    out = {"rungs": [off, on]}
+    if off.get("beacons_per_s_per_core") and on.get("beacons_per_s_per_core"):
+        out["shm_speedup_per_core"] = round(
+            on["beacons_per_s_per_core"] / off["beacons_per_s_per_core"], 2)
+    return out
+
+
 def run_health_axis() -> dict:
     """Health-plane rung (ISSUE 16): evaluation µs per watcher beat —
     the full engine pass (SLO judging + burn windows + forecasters +
@@ -1214,6 +1326,9 @@ def main():
     if os.environ.get("BENCH_HEALTH", "1") != "0":
         # health axis (ISSUE 16): evaluation µs/beat + forecast lead
         head["health"] = run_health_axis()
+    if os.environ.get("BENCH_BUS", "1") != "0":
+        # bus axis (ISSUE 18): beacons/s/core, shm rings off vs on
+        head["bus"] = run_bus_axis()
     print(json.dumps(head), flush=True)
 
 

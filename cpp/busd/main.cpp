@@ -56,6 +56,27 @@
 // - `JG_BUS_SHARDS=1` (the default) is the kill switch: no peers, no new
 //   caps, byte-identical single-hub wire.
 //
+// Zero-copy same-host lanes + beacon aggregation (ISSUE 18):
+//
+// - shm1: a client whose hello carries caps:["shm1"] and a
+//   `"shm":{"path":...,"v":1}` block offers a mapped SPSC ring pair
+//   (common/shmlane.hpp ≡ runtime/shmlane.py) it created under the run
+//   dir.  The hub attaches, echoes "shm1" in welcome, and from then on
+//   the DROPPABLE topic class (beacons/metrics/path — the measured
+//   dominant traffic) moves through the rings as the exact relay frames:
+//   client publishes ride the c2s ring, deliveries the s2c ring.  TCP
+//   stays the control channel, carries oversized/overflow frames
+//   (`bus.shm_fallbacks` — never a stall), and remains the only
+//   transport cross-host.  A dead client's lane is reaped with its TCP
+//   session.  `JG_BUS_SHM` unset/0 keeps the wire byte-identical.
+// - agg1 (`--agg-ms` / JG_BUS_AGG_MS, default 0 = off): pos1 beacons of
+//   one region topic arriving within the window coalesce into a single
+//   agg1 frame (plan_codec.hpp, packed1 family) delivered once per
+//   agg1-capable subscriber — O(agents)→O(regions) fanout on the
+//   dominant topic class.  Legacy subscribers keep receiving singles;
+//   peer links always carry singles (the remote shard re-aggregates for
+//   its own subscribers), so aggregation composes across the pool.
+//
 // Usage: mapd_bus [port]           (default 7400)
 
 #include <limits.h>
@@ -78,8 +99,10 @@
 #include "../common/log.hpp"
 #include "../common/metrics.hpp"
 #include "../common/net.hpp"
+#include "../common/plan_codec.hpp"  // agg1 beacon aggregate (ISSUE 18)
 #include "../common/region.hpp"  // kPosTopicPrefix (droppable beacons)
 #include "../common/shardmap.hpp"
+#include "../common/shmlane.hpp"  // same-host ring lanes (ISSUE 18)
 
 using namespace mapd;
 
@@ -90,12 +113,19 @@ struct OutFrame {
   bool droppable;
 };
 
+// relay fanout scoping for beacon aggregation: singles go to everyone
+// minus the agg1 subscribers; the coalesced agg1 frame goes to ONLY them
+enum class Fanout { kAll, kSkipAgg, kOnlyAgg };
+
 struct Client {
   LineConn conn;  // input framing only; output goes through the queue
   std::string peer_id;
   bool fast = false;   // advertised caps:["relay1"] in hello
   bool shard1 = false;  // shard-aware client: routes its own subs/pubs
   bool is_peer = false;  // busd↔busd peering link (caps:["peer1"])
+  bool agg1 = false;   // advertised caps:["agg1"] AND window active:
+                       // receives coalesced region beacons, not singles
+  shm::Lane lane;      // attached shm ring pair (valid() if negotiated)
   int peer_shard = -1;   // shard index of the remote busd (peer links)
   std::set<std::string> topics;
   std::set<std::string> prefixes;  // from "<prefix>.*" subscriptions
@@ -201,6 +231,12 @@ int main(int argc, char** argv) {
   // so backpressure tests shrink it to hit the policy deterministically.
   const int sndbuf_kb = static_cast<int>(
       knobs.get_int("--sndbuf-kb", "JG_BUS_SNDBUF_KB", 0));
+  // shm lanes (ISSUE 18): accept client lane offers unless explicitly
+  // disabled (clients only offer when JG_BUS_SHM is set truthy, so the
+  // unset default keeps the wire byte-identical end to end)
+  const bool shm_ok = knobs.get_int("--shm", "JG_BUS_SHM", 1) != 0;
+  // beacon aggregation window (ms); 0 = off (byte-identical wire)
+  const int64_t agg_ms = knobs.get_int("--agg-ms", "JG_BUS_AGG_MS", 0);
   signal(SIGINT, handle_stop);
   signal(SIGTERM, handle_stop);
   signal(SIGPIPE, SIG_IGN);
@@ -340,7 +376,7 @@ int main(int argc, char** argv) {
   // spans every shard (they already saw it at the origin shard).
   auto relay_payload = [&](const std::string& topic, const std::string& from,
                            const std::string& raw, int except_fd,
-                           bool from_peer) {
+                           bool from_peer, Fanout mode = Fanout::kAll) {
     std::shared_ptr<const std::string> fast, legacy;
     const bool droppable = droppable_topic(topic);
     int fanout = 0;
@@ -351,6 +387,8 @@ int main(int argc, char** argv) {
       Client& c = *it->second;
       if (fd == except_fd || c.peer_id.empty()) return;
       if (from_peer && (c.is_peer || (c.shard1 && via_span_prefix))) return;
+      if (mode == Fanout::kSkipAgg && c.agg1) return;
+      if (mode == Fanout::kOnlyAgg && !c.agg1) return;
       const auto& frame = c.fast
           ? (fast ? fast
                   : (fast = std::make_shared<const std::string>(
@@ -360,9 +398,19 @@ int main(int argc, char** argv) {
                            "{\"op\":\"msg\",\"topic\":" +
                            json_quote(topic) + ",\"from\":" +
                            json_quote(from) + ",\"data\":" + raw + "}\n")));
-      enqueue(c, fd, frame, droppable);
       ++fanout;
       fanout_bytes += static_cast<double>(frame->size());
+      // shm fast path: droppable frames to a lane-attached relay1 client
+      // ride the s2c ring (frame minus the trailing '\n').  A full ring
+      // or torn-down lane falls back to the TCP queue — never a stall.
+      if (droppable && c.fast && c.lane.valid()) {
+        if (c.lane.send(frame->data(), frame->size() - 1)) {
+          metrics_count("bus.shm_tx_frames");
+          return;
+        }
+        metrics_count("bus.shm_fallbacks");
+      }
+      enqueue(c, fd, frame, droppable);
       if (c.is_peer) {
         metrics_count("bus.peer_tx_msgs");
         metrics_count("bus.peer_tx_bytes",
@@ -391,6 +439,105 @@ int main(int argc, char** argv) {
     }
   };
 
+  // Beacon aggregation (ISSUE 18): pos1 beacons of one region topic
+  // buffered within the agg window, flushed as ONE agg1 frame to the
+  // agg1-capable subscribers.  Singles still go out immediately to
+  // everyone else (legacy interop) — the agg1 crowd is simply excluded
+  // from the per-beacon fanout.  Peer links carry singles; the remote
+  // shard re-aggregates for its own subscribers.
+  struct AggPending {
+    std::vector<codec::Agg1Entry> entries;
+    int64_t first_ms = 0;
+  };
+  std::map<std::string, AggPending> agg_pending;  // wire topic -> window
+  int agg1_subs = 0;  // live agg1-capable clients (skip work when none)
+
+  // Publish ingress: every published payload (fast P, legacy pub, peer M)
+  // funnels through here so aggregation sees one stream.
+  auto ingress_pub = [&](const std::string& topic, const std::string& from,
+                         const std::string& raw, int except_fd,
+                         bool from_peer) {
+    if (agg_ms > 0 && agg1_subs > 0) {
+      const std::string logical = shardmap::strip_ns(topic);
+      if (logical.compare(0, strlen(kPosTopicPrefix), kPosTopicPrefix) ==
+          0) {
+        // coalescing needs the pos1 blob, so this (opt-in) path pays one
+        // JSON parse per beacon — bought back many times over by the
+        // O(agents)→O(regions) fanout cut
+        auto parsed = Json::parse(raw);
+        if (parsed && parsed->is_object() &&
+            (*parsed)["type"].as_str() == "pos1") {
+          auto blob = codec::b64_decode((*parsed)["data"].as_str());
+          if (blob) {
+            auto& p = agg_pending[topic];
+            if (p.entries.empty()) p.first_ms = mono_ms();
+            p.entries.push_back({from, std::move(*blob)});
+            metrics_count("bus.agg_coalesced");
+            relay_payload(topic, from, raw, except_fd, from_peer,
+                          Fanout::kSkipAgg);
+            return;
+          }
+        }
+      }
+    }
+    relay_payload(topic, from, raw, except_fd, from_peer);
+  };
+
+  auto flush_aggs = [&]() {
+    if (agg_pending.empty()) return;
+    const int64_t now = mono_ms();
+    // agg frames must ride the rings too: chunk each window so the framed
+    // fast-path line fits the smallest attached agg1 lane slot, else every
+    // flush TCP-falls-back and bus.shm_fallbacks becomes steady-state
+    // noise instead of an anomaly signal.  No lane-attached agg1 subs =>
+    // one frame per window as before.
+    size_t min_slot = 0;
+    for (auto& [cfd, cc] : clients) {
+      (void)cfd;
+      if (cc->agg1 && cc->lane.valid() &&
+          (min_slot == 0 || cc->lane.slot_size < min_slot))
+        min_slot = cc->lane.slot_size;
+    }
+    for (auto it = agg_pending.begin(); it != agg_pending.end();) {
+      AggPending& p = it->second;
+      if (now - p.first_ms < agg_ms && p.entries.size() < 4096) {
+        ++it;
+        continue;
+      }
+      size_t raw_budget = SIZE_MAX;  // unlimited when no lanes listen
+      if (min_slot) {
+        // fast frame: "M<topic> <from> {"type":"agg1","data":"<b64>"}"
+        const size_t overhead =
+            1 + it->first.size() + 1 + my_peer_id.size() + 1 +
+            sizeof("{\"type\":\"agg1\",\"data\":\"\"}") - 1;
+        raw_budget =
+            min_slot > overhead ? (min_slot - overhead) / 4 * 3 : 0;
+      }
+      size_t i = 0;
+      while (i < p.entries.size()) {
+        std::vector<codec::Agg1Entry> chunk;
+        size_t sz = 8;  // agg1 fixed header
+        while (i < p.entries.size()) {
+          const size_t esz =
+              4 + p.entries[i].name.size() + p.entries[i].blob.size();
+          if (!chunk.empty() && sz + esz > raw_budget) break;
+          sz += esz;
+          chunk.push_back(std::move(p.entries[i]));
+          ++i;
+        }
+        const std::string payload = "{\"type\":\"agg1\",\"data\":\"" +
+                                    codec::encode_agg1_b64(chunk) +
+                                    "\"}";
+        metrics_count("bus.agg_flushes");
+        metrics_count("bus.agg_entries",
+                      static_cast<double>(chunk.size()));
+        relay_payload(it->first, my_peer_id, payload, -1, false,
+                      Fanout::kOnlyAgg);
+      }
+      it = agg_pending.erase(it);
+    }
+  };
+
   // Control frames (welcome / peers / peer_joined / peer_left) stay JSON
   // on both wires; `topic` routes them ("" = every client).  Peer links
   // never receive them — discovery is per-shard (the control plane meets
@@ -405,6 +552,28 @@ int main(int argc, char** argv) {
     }
   };
 
+  // One fast publish (`P<topic> <payload>`), whether it arrived on the
+  // TCP link or through the client's c2s shm ring — topic peek, no parse.
+  auto handle_fast_pub = [&](Client& c, int fd, const std::string& line,
+                             bool via_shm) {
+    size_t sp = line.find(' ');
+    if (sp == std::string::npos || sp < 2) return;
+    const std::string topic = line.substr(1, sp - 1);
+    const std::string raw = line.substr(sp + 1);
+    if (drop_left > 0 && !drop_type.empty()) {
+      auto parsed = Json::parse(raw);  // fault-injection test mode
+      if (parsed && (*parsed)["type"].as_str() == drop_type) {
+        --drop_left;
+        log_warn("💉 fault injection: dropped %s frame from %s "
+                 "(%lld more)\n", drop_type.c_str(), c.peer_id.c_str(),
+                 static_cast<long long>(drop_left));
+        return;
+      }
+    }
+    metrics_count(via_shm ? "bus.shm_rx_frames" : "bus.relay_fast_frames");
+    ingress_pub(topic, c.peer_id, raw, fd, false);
+  };
+
   // The hub beacons its own registry too (same schema as every BusClient):
   // fan-out volume per topic + connected-client gauge, as peer "busd"
   // (single hub) / "busd-s<i>" (pool member, `shard` field on the payload
@@ -416,13 +585,17 @@ int main(int argc, char** argv) {
     next_beacon_ms = now + 2000;
     size_t queued = 0;
     size_t live_peers = 0;
+    size_t shm_lanes = 0;
     for (auto& [fd, c] : clients) {
       queued += c->out_bytes;
       if (c->is_peer) ++live_peers;
+      if (c->lane.valid()) ++shm_lanes;
     }
     metrics_gauge("bus.clients",
                   static_cast<double>(clients.size() - live_peers));
     metrics_gauge("bus.queued_bytes", static_cast<double>(queued));
+    if (shm_lanes) metrics_gauge("bus.shm_lanes",
+                                 static_cast<double>(shm_lanes));
     if (num_shards > 1)
       metrics_gauge("bus.peer_links", static_cast<double>(live_peers));
     Json b = make_metrics_beacon(my_peer_id, "busd", 2.0);
@@ -584,12 +757,46 @@ int main(int argc, char** argv) {
     for (const auto& slot : peer_slots)
       if (slot.pending_fd >= 0)
         pfds.push_back({slot.pending_fd, POLLOUT, 0});
-    int rc = poll(pfds.data(), pfds.size(), 1000);
+    // shm lanes: spin-then-park.  A lane with frames already waiting
+    // forces a zero-timeout poll (spin); otherwise we park — set the
+    // ring's parked flag (re-checking for the race) and let the client's
+    // doorbell FIFO wake us through the poll set.
+    int timeout_ms = 1000;
+    for (auto& [fd, c] : clients) {
+      if (!c->lane.valid()) continue;
+      if (c->lane.rx_pending() || !c->lane.rx.reader_park())
+        timeout_ms = 0;
+      else if (c->lane.bell_rx_fd >= 0)
+        pfds.push_back({c->lane.bell_rx_fd, POLLIN, 0});
+    }
+    // a pending agg window bounds the sleep to its flush deadline
+    if (timeout_ms > 0 && !agg_pending.empty()) {
+      int64_t next = INT64_MAX;
+      for (const auto& [t, p] : agg_pending)
+        next = std::min(next, p.first_ms + agg_ms);
+      const int64_t wait = next - mono_ms();
+      timeout_ms = static_cast<int>(
+          std::max<int64_t>(0, std::min<int64_t>(wait, timeout_ms)));
+    }
+    int rc = poll(pfds.data(), pfds.size(), timeout_ms);
     if (rc < 0) {
       if (errno == EINTR) continue;
       break;
     }
     maybe_beacon();
+
+    // drain the client->hub rings (unpark first so writers stop ringing;
+    // per-lane budget so one firehose lane cannot starve the rest)
+    for (auto& [fd, c] : clients) {
+      if (!c->lane.valid()) continue;
+      c->lane.rx.reader_unpark();
+      c->lane.drain_bell();
+      std::string frame;
+      for (int budget = 4096; budget > 0 && c->lane.recv(&frame); --budget)
+        if (!frame.empty() && frame[0] == 'P')
+          handle_fast_pub(*c, fd, frame, true);
+    }
+    flush_aggs();
 
     // accept new connections
     if (pfds[0].revents & POLLIN) {
@@ -639,24 +846,7 @@ int main(int argc, char** argv) {
         auto line = c.conn.next_line();
         if (!line) break;
         if (!line->empty() && (*line)[0] == 'P') {
-          // fast publish: `P<topic> <payload>` — topic peek, no parse
-          size_t sp = line->find(' ');
-          if (sp == std::string::npos || sp < 2) continue;
-          const std::string topic = line->substr(1, sp - 1);
-          const std::string raw = line->substr(sp + 1);
-          if (drop_left > 0 && !drop_type.empty()) {
-            auto parsed = Json::parse(raw);  // fault-injection test mode
-            if (parsed && (*parsed)["type"].as_str() == drop_type) {
-              --drop_left;
-              log_warn("💉 fault injection: dropped %s frame from %s "
-                       "(%lld more)\n", drop_type.c_str(),
-                       c.peer_id.c_str(),
-                       static_cast<long long>(drop_left));
-              continue;
-            }
-          }
-          metrics_count("bus.relay_fast_frames");
-          relay_payload(topic, c.peer_id, raw, fd, false);
+          handle_fast_pub(c, fd, *line, false);
           continue;
         }
         if (!line->empty() && (*line)[0] == 'M' && c.is_peer) {
@@ -674,7 +864,7 @@ int main(int argc, char** argv) {
           metrics_count("bus.peer_rx_msgs");
           metrics_count("bus.peer_rx_bytes",
                         static_cast<double>(line->size() + 1));
-          relay_payload(topic, from, raw, fd, true);
+          ingress_pub(topic, from, raw, fd, true);
           continue;
         }
         auto parsed = Json::parse(*line);
@@ -683,13 +873,33 @@ int main(int argc, char** argv) {
         const std::string& op = j["op"].as_str();
         if (op == "hello") {
           c.peer_id = j["peer_id"].as_str();
+          bool wants_shm = false;
           for (const auto& cap : j["caps"].as_array()) {
             if (cap.as_str() == "relay1") c.fast = true;
             if (cap.as_str() == "shard1") c.shard1 = true;
+            if (cap.as_str() == "shm1") wants_shm = true;
+            if (cap.as_str() == "agg1") c.agg1 = agg_ms > 0;
             if (cap.as_str() == "peer1" && num_shards > 1) {
               // inbound peering link from a higher-index shard
               c.is_peer = true;
               c.peer_shard = static_cast<int>(j["shard"].as_int());
+            }
+          }
+          if (c.agg1) ++agg1_subs;
+          // shm lane offer: attach the client-created ring file; the
+          // "shm1" welcome echo is the client's signal the lane is live.
+          // Any malformed offer is refused (logged), never fatal.
+          if (wants_shm && shm_ok && !c.is_peer && c.fast) {
+            const std::string lane_path = j["shm"]["path"].as_str();
+            std::string err;
+            if (!lane_path.empty()) c.lane = shm::Lane::attach(lane_path, &err);
+            if (c.lane.valid()) {
+              metrics_count("bus.shm_attaches");
+              log_info("🧵 shm lane up for %s (%s)\n", c.peer_id.c_str(),
+                       lane_path.c_str());
+            } else {
+              log_warn("shm lane refused for %s: %s\n", c.peer_id.c_str(),
+                       err.c_str());
             }
           }
           event_emit(c.is_peer ? "bus.peer_link_joined" : "bus.peer_joined",
@@ -697,6 +907,8 @@ int main(int argc, char** argv) {
           Json caps;
           caps.push_back(Json("relay1"));
           if (num_shards > 1) caps.push_back(Json("peer1"));
+          if (c.lane.valid()) caps.push_back(Json("shm1"));
+          if (c.agg1) caps.push_back(Json("agg1"));
           Json welcome;
           welcome.set("op", "welcome")
               .set("peer_id", c.peer_id)
@@ -797,7 +1009,7 @@ int main(int argc, char** argv) {
             continue;
           }
           metrics_count("bus.relay_json_frames");
-          relay_payload(topic, c.peer_id, j["data"].dump(), fd, false);
+          ingress_pub(topic, c.peer_id, j["data"].dump(), fd, false);
         } else if (op == "peers") {
           const std::string& topic = j["topic"].as_str();
           Json peers;
@@ -835,6 +1047,15 @@ int main(int argc, char** argv) {
         event_emit(was_peer_link ? "bus.peer_link_left" : "bus.peer_left",
                    nullptr, -1, peer);
       drop_subs(fd, *it->second);
+      if (it->second->agg1) --agg1_subs;
+      if (it->second->lane.valid()) {
+        // reap the dead client's ring: mark it torn down (a half-dead
+        // writer sharing the mapping stops immediately) and unlink the
+        // file + bells so nothing stale survives the session
+        it->second->lane.mark_detached();
+        it->second->lane.close_lane(true);
+        log_info("🧵 shm lane reaped for %s\n", peer.c_str());
+      }
       it->second->conn.close_fd();
       clients.erase(it);
       if (was_peer_link) {

@@ -45,6 +45,22 @@
 // in a small bounded outbox replayed when that shard returns.
 // With a single port (JG_BUS_SHARDS=1 kill switch) the wire is
 // byte-identical to the single-hub client.
+//
+// Zero-copy same-host lanes (ISSUE 18, caps `shm1`): with JG_BUS_SHM set
+// truthy the client creates one shared-memory ring pair per shard link
+// (common/shmlane.hpp ≡ runtime/shmlane.py) and offers it in hello
+// (`"shm":{"path":...,"v":1}`); when the hub's welcome echoes `shm1`,
+// droppable-class frames (beacons/metrics/path) move through the rings as
+// the exact relay lines — publishes via the c2s ring, deliveries via s2c —
+// while TCP keeps the control plane, oversized frames, and cross-host
+// links.  Ring overflow falls back to TCP per frame
+// (`bus.shm_fallbacks`), a dead hub tears the lane down with the TCP
+// session.  JG_BUS_SHM unset keeps the wire byte-identical.
+// Beacon aggregation (caps `agg1`, JG_BUS_AGG_MS>0): the hub delivers
+// coalesced agg1 frames per region topic per window (chunked to fit
+// lane slots, so aggregates ride the rings); this client transparently
+// explodes them back into per-peer pos1 messages, so role code never
+// sees the aggregate.
 #pragma once
 
 #include <poll.h>
@@ -65,7 +81,9 @@
 #include "json.hpp"
 #include "metrics.hpp"
 #include "net.hpp"
+#include "plan_codec.hpp"  // agg1 explode (ISSUE 18)
 #include "shardmap.hpp"
+#include "shmlane.hpp"
 
 namespace mapd {
 
@@ -85,6 +103,14 @@ inline int64_t mono_ms() {
 inline bool fastframe_enabled() {
   const char* v = getenv("JG_BUS_FASTFRAME");
   return !v || (*v && strcmp(v, "0") && strcmp(v, "false"));
+}
+
+// Beacon-aggregation window (ms).  >0 makes this client advertise the
+// `agg1` cap (it can decode coalesced region beacons); 0/unset keeps the
+// hello — and therefore the whole wire — byte-identical.
+inline int64_t agg_window_env() {
+  const char* v = getenv("JG_BUS_AGG_MS");
+  return v && *v ? atol(v) : 0;
 }
 
 // Control-plane topics are everything busd itself refuses to shed under
@@ -193,12 +219,17 @@ class BusClient {
   // shard, not just home, so a region beacon on another shard wakes the
   // loop immediately instead of on the next timeout).
   void append_pollfds(std::vector<pollfd>& out) const {
-    for (const auto& l : links_)
+    for (const auto& l : links_) {
       if (l.conn.valid())
         out.push_back({l.conn.fd(),
                        static_cast<short>(
                            POLLIN | (l.conn.wants_write() ? POLLOUT : 0)),
                        0});
+      // the lane doorbell: the hub rings it when it pushes into the s2c
+      // ring while this client is parked (pump() parks before returning)
+      if (l.shm_live && l.lane.valid() && l.lane.bell_rx_fd >= 0)
+        out.push_back({l.lane.bell_rx_fd, POLLIN, 0});
+    }
   }
 
   // Fleet-wide live metrics: publish this process's MetricsRegistry
@@ -281,6 +312,16 @@ class BusClient {
     maybe_publish_beacon();
     bool alive = true;
     for (auto& l : links_) {
+      // drain the hub->client ring first (deliveries racing the TCP
+      // control frames is fine: lanes carry only the droppable class)
+      if (l.shm_live && l.lane.valid()) {
+        l.lane.rx.reader_unpark();
+        l.lane.drain_bell();
+        std::string frame;
+        while (l.lane.recv(&frame))
+          if (!frame.empty() && frame[0] == 'M')
+            handle_line(l, frame, on_msg, on_event);
+      }
       if (!l.conn.valid()) {
         if (!try_reconnect(l)) alive = false;
         continue;
@@ -294,6 +335,18 @@ class BusClient {
       if (l.conn.valid() && !l.conn.on_writable())
         if (!drop_or_retry(l)) alive = false;
     }
+    // spin-then-park: arm each drained lane's parked flag so the hub
+    // rings the doorbell (in append_pollfds' poll set) on the next
+    // frame; a frame that raced the flag is drained before we sleep.
+    for (auto& l : links_) {
+      if (!l.shm_live || !l.lane.valid()) continue;
+      while (!l.lane.rx.reader_park()) {
+        std::string frame;
+        while (l.lane.recv(&frame))
+          if (!frame.empty() && frame[0] == 'M')
+            handle_line(l, frame, on_msg, on_event);
+      }
+    }
     return alive;
   }
 
@@ -306,7 +359,10 @@ class BusClient {
 
   void close() {
     reconnect_ = false;
-    for (auto& l : links_) l.conn.close_fd();
+    for (auto& l : links_) {
+      teardown_lane(l);
+      l.conn.close_fd();
+    }
   }
 
  private:
@@ -318,7 +374,17 @@ class BusClient {
     int64_t backoff_ms = 0;
     int64_t next_attempt_ms = 0;
     std::set<std::string> topics;  // subscriptions owned by this shard
+    shm::Lane lane;         // offered ring pair (valid() once created)
+    bool shm_live = false;  // hub's welcome echoed shm1: lane is on
   };
+
+  void teardown_lane(Link& l) {
+    if (!l.lane.valid()) return;
+    l.lane.mark_detached();
+    l.lane.close_lane(true);
+    l.lane = shm::Lane();
+    l.shm_live = false;
+  }
 
   Link& home() { return links_[shardmap::kHomeShard]; }
   const Link& home() const { return links_[shardmap::kHomeShard]; }
@@ -342,6 +408,26 @@ class BusClient {
     if (n_ > 1) caps.push_back(Json("shard1"));
     // namespaced tenant client (ISSUE 8); absent = legacy wire
     if (!ns_.empty()) caps.push_back(Json("ns1"));
+    // shm lane offer (ISSUE 18): create the ring pair BEFORE the hello
+    // so the hub can attach on receipt; live only after welcome echoes.
+    teardown_lane(l);
+    if (shm::shm_enabled_env() && fastframe_enabled()) {
+      const int shard = static_cast<int>(&l - links_.data());
+      std::string err;
+      l.lane = shm::Lane::create(
+          shm::lane_path_for(peer_id_, shard, shm::lane_dir()),
+          768, 256, &err);
+      if (l.lane.valid()) {
+        caps.push_back(Json("shm1"));
+        Json offer;
+        offer.set("path", l.lane.path).set("v", 1);
+        hello.set("shm", offer);
+      } else {
+        fprintf(stderr, "bus: shm lane create failed (%s); staying on "
+                "TCP\n", err.c_str());
+      }
+    }
+    if (agg_window_env() > 0) caps.push_back(Json("agg1"));
     if (!caps.is_null()) hello.set("caps", caps);
     l.conn.send_line(hello.dump());
   }
@@ -375,6 +461,19 @@ class BusClient {
     if (l.fast_hub && topic.find(' ') == std::string::npos) {
       // fast framing: the hub relays on a topic peek, no JSON parse
       line = "P" + topic + " " + payload;
+      // shm lane fast path: droppable frames ride the c2s ring (exact
+      // P-line, no newline); full/torn ring falls back to TCP per frame
+      if (l.shm_live && l.lane.valid() && !bus_control_topic(topic)) {
+        if (l.lane.send(line.data(), line.size())) {
+          metrics_count("bus.shm_tx_frames");
+          metrics_count("bus.msgs_sent", 1, "topic=\"" + topic + "\"");
+          metrics_count("bus.bytes_sent",
+                        static_cast<double>(line.size() + 1),
+                        "topic=\"" + topic + "\"");
+          return;
+        }
+        metrics_count("bus.shm_fallbacks");
+      }
     } else {
       Json j;
       j.set("op", "pub").set("topic", topic);
@@ -428,6 +527,28 @@ class BusClient {
     return topic;
   }
 
+  // Deliver an agg1 aggregate as its constituent pos1 messages (one per
+  // coalesced sender) — role code never sees the aggregate frame.
+  // Returns false when `data` isn't an agg1 frame.
+  bool deliver_agg1(const std::string& topic, const Json& data,
+                    const std::function<void(const Msg&)>& on_msg) {
+    if (data["type"].as_str() != "agg1") return false;
+    auto a = codec::decode_agg1_b64(data["data"].as_str());
+    if (!a) {
+      metrics_count("bus.agg_rx_malformed");
+      return true;  // malformed aggregate: dropped, counted
+    }
+    metrics_count("bus.agg_rx_frames");
+    metrics_count("bus.agg_rx_entries",
+                  static_cast<double>(a->entries.size()));
+    for (const auto& e : a->entries) {
+      Json d;
+      d.set("type", "pos1").set("data", codec::b64_encode(e.blob));
+      if (on_msg) on_msg(Msg{topic, e.name, d});
+    }
+    return true;
+  }
+
   void handle_line(Link& l, const std::string& line,
                    const std::function<void(const Msg&)>& on_msg,
                    const std::function<void(const Json&)>& on_event) {
@@ -444,6 +565,7 @@ class BusClient {
       metrics_count("bus.bytes_received",
                     static_cast<double>(line.size() + 1),
                     "topic=\"" + topic + "\"");
+      if (deliver_agg1(deliver_topic(topic), *data, on_msg)) return;
       if (on_msg)
         on_msg(Msg{deliver_topic(topic), line.substr(s1 + 1, s2 - s1 - 1),
                    *data});
@@ -460,15 +582,21 @@ class BusClient {
       metrics_count("bus.bytes_received",
                     static_cast<double>(line.size() + 1),
                     "topic=\"" + topic + "\"");
+      if (deliver_agg1(deliver_topic(topic), j["data"], on_msg)) return;
       if (on_msg)
         on_msg(Msg{deliver_topic(topic), j["from"].as_str(), j["data"]});
     } else {
       if (op == "welcome") {
         // caps negotiation: switch publishes to the fast framing only
         // when the hub advertises it (an old hub stays legacy), per link
+        bool hub_shm = false;
         if (fastframe_enabled())
-          for (const auto& cap : j["caps"].as_array())
+          for (const auto& cap : j["caps"].as_array()) {
             if (cap.as_str() == "relay1") l.fast_hub = true;
+            if (cap.as_str() == "shm1") hub_shm = true;
+          }
+        if (l.lane.valid() && !(l.shm_live = hub_shm))
+          teardown_lane(l);  // hub refused (or legacy): lane off, TCP on
       }
       if (on_event) on_event(j);
     }
@@ -481,6 +609,7 @@ class BusClient {
     const int err = errno;  // capture BEFORE close() can overwrite it
     l.conn.close_fd();
     l.fast_hub = false;  // renegotiate with whatever hub comes back
+    teardown_lane(l);    // lane lifetime == TCP session; rebuilt on hello
     if (fatal) return false;
     l.backoff_ms = 250;
     l.next_attempt_ms = mono_ms() + l.backoff_ms;

@@ -159,6 +159,9 @@ inline void put_i64(std::string& b, int64_t v) {
 inline void put_i32v(std::string& b, const std::vector<int32_t>& v) {
   for (int32_t x : v) put_u32(b, static_cast<uint32_t>(x));
 }
+inline uint16_t get_u16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0] | (p[1] << 8));
+}
 inline uint32_t get_u32(const uint8_t* p) {
   return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
          (static_cast<uint32_t>(p[2]) << 16) |
@@ -576,6 +579,96 @@ inline std::optional<Pos1> decode_pos1_b64(const std::string& data) {
   auto raw = b64_decode(data);
   if (!raw) return std::nullopt;
   return decode_pos1(*raw);
+}
+
+// --- agg1: per-region beacon aggregate (ISSUE 18) -------------------------
+// Byte-identical mirror of plan_codec.py encode_agg1/decode_agg1 (see its
+// docstring for the layout).  busd coalesces one region topic's pos1
+// beacons within a tick window into one frame:
+//   u32 "AGG1", u8 version, u8 flags (bit0: 20-byte trace1 block follows),
+//   u16 n_entries, [trace], then per entry u16 name_len + u16 blob_len +
+//   sender peer id + the pos1 blob VERBATIM.
+// Wire shape: {"type":"agg1","data":"<base64>"} on the original region
+// topic.  Decode rejects (nullopt) any malformation: short buffer, bad
+// magic/version, truncated entry, trailing bytes.
+
+constexpr uint32_t kAgg1Magic = 0x31474741;  // b"AGG1"
+constexpr uint8_t kAgg1Version = 1;
+constexpr uint8_t kAgg1FlagTrace = 1;
+
+struct Agg1Entry {
+  std::string name;  // sender peer id
+  std::string blob;  // verbatim pos1 packet
+};
+
+struct Agg1 {
+  std::vector<Agg1Entry> entries;
+  bool has_trace = false;
+  TraceCtx trace;
+};
+
+inline std::string encode_agg1(const std::vector<Agg1Entry>& entries,
+                               const TraceCtx* trace = nullptr) {
+  std::string out;
+  size_t body = 0;
+  for (const auto& e : entries) body += 4 + e.name.size() + e.blob.size();
+  out.reserve(8 + (trace ? kTraceExtLen : 0) + body);
+  detail::put_u32(out, kAgg1Magic);
+  out += static_cast<char>(kAgg1Version);
+  out += static_cast<char>(trace ? kAgg1FlagTrace : 0);
+  detail::put_u16(out, static_cast<uint16_t>(entries.size()));
+  if (trace) detail::put_trace(out, *trace);
+  for (const auto& e : entries) {
+    detail::put_u16(out, static_cast<uint16_t>(e.name.size()));
+    detail::put_u16(out, static_cast<uint16_t>(e.blob.size()));
+    out += e.name;
+    out += e.blob;
+  }
+  return out;
+}
+
+inline std::optional<Agg1> decode_agg1(const std::string& buf) {
+  if (buf.size() < 8) return std::nullopt;
+  const uint8_t* b = reinterpret_cast<const uint8_t*>(buf.data());
+  if (detail::get_u32(b) != kAgg1Magic) return std::nullopt;
+  if (b[4] != kAgg1Version) return std::nullopt;
+  const uint8_t flags = b[5];
+  const uint16_t n = detail::get_u16(b + 6);
+  Agg1 a;
+  size_t off = 8;
+  if (flags & kAgg1FlagTrace) {
+    if (buf.size() < off + kTraceExtLen) return std::nullopt;
+    a.has_trace = true;
+    a.trace = detail::get_trace(b + off);
+    off += kTraceExtLen;
+  }
+  a.entries.reserve(n);
+  for (uint16_t i = 0; i < n; ++i) {
+    if (buf.size() < off + 4) return std::nullopt;
+    const uint16_t name_len = detail::get_u16(b + off);
+    const uint16_t blob_len = detail::get_u16(b + off + 2);
+    off += 4;
+    if (buf.size() < off + name_len + blob_len) return std::nullopt;
+    Agg1Entry e;
+    e.name.assign(buf, off, name_len);
+    off += name_len;
+    e.blob.assign(buf, off, blob_len);
+    off += blob_len;
+    a.entries.push_back(std::move(e));
+  }
+  if (off != buf.size()) return std::nullopt;
+  return a;
+}
+
+inline std::string encode_agg1_b64(const std::vector<Agg1Entry>& entries,
+                                   const TraceCtx* trace = nullptr) {
+  return b64_encode(encode_agg1(entries, trace));
+}
+
+inline std::optional<Agg1> decode_agg1_b64(const std::string& data) {
+  auto raw = b64_decode(data);
+  if (!raw) return std::nullopt;
+  return decode_agg1(*raw);
 }
 
 }  // namespace codec

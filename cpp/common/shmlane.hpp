@@ -1,0 +1,361 @@
+// Zero-copy same-host bus lanes — C++ mirror of runtime/shmlane.py.
+//
+// Layout-identical to the python side (see shmlane.py's header comment for
+// the byte map): one mapped file per (peer, busd-shard) pair holding a c2s
+// and an s2c SPSC ring of fixed-size slots that carry the exact fast-path
+// `P`/`M` relay lines (no trailing newline).  Cursors are 8-byte words
+// accessed through std::atomic_ref-equivalent volatile+fence discipline
+// (plain __atomic builtins on the mapped words — both targets are
+// little-endian with 8-byte atomic loads/stores).  Doorbells are named
+// FIFOs next to the lane file; the reader parks by setting its `parked`
+// word, re-checking the ring, then blocking in poll(2) on the FIFO.
+//
+// Contract (ISSUE 18): push() never blocks — a full ring or oversized
+// frame returns false and the caller sends that frame over TCP
+// (bus.shm_fallbacks).  Only droppable-class topics ride the lane, so the
+// rare TCP/ring interleave cannot reorder the control plane.
+
+#pragma once
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <unistd.h>
+
+namespace mapd {
+namespace shm {
+
+constexpr uint32_t kMagic = 0x314C4853;  // "SHL1"
+constexpr uint16_t kVersion = 1;
+constexpr size_t kHeaderBytes = 4096;
+
+// header field offsets (byte-identical to shmlane.py)
+constexpr size_t kOffMagic = 0;
+constexpr size_t kOffVersion = 4;
+constexpr size_t kOffSlotSize = 8;
+constexpr size_t kOffNSlots = 12;
+constexpr size_t kOffCreatorPid = 16;
+constexpr size_t kOffAttachedPid = 20;
+constexpr size_t kOffDetached = 24;
+// per-ring control offsets: {head, tail, parked}
+constexpr size_t kRingCtrl[2][3] = {{64, 128, 192}, {256, 320, 384}};
+
+inline size_t round_up(size_t n, size_t align) {
+  return (n + align - 1) / align * align;
+}
+
+inline bool pid_alive(uint32_t pid) {
+  if (pid == 0) return false;
+  if (::kill((pid_t)pid, 0) == 0) return true;
+  return errno == EPERM;
+}
+
+// --- atomic accessors on mapped memory -----------------------------------
+inline uint64_t load_u64(const uint8_t* p) {
+  return __atomic_load_n(reinterpret_cast<const uint64_t*>(p),
+                         __ATOMIC_ACQUIRE);
+}
+inline void store_u64(uint8_t* p, uint64_t v) {
+  __atomic_store_n(reinterpret_cast<uint64_t*>(p), v, __ATOMIC_RELEASE);
+}
+inline uint32_t load_u32(const uint8_t* p) {
+  return __atomic_load_n(reinterpret_cast<const uint32_t*>(p),
+                         __ATOMIC_ACQUIRE);
+}
+inline void store_u32(uint8_t* p, uint32_t v) {
+  __atomic_store_n(reinterpret_cast<uint32_t*>(p), v, __ATOMIC_RELEASE);
+}
+inline uint32_t read_u32_plain(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+inline void write_u32_plain(uint8_t* p, uint32_t v) {
+  std::memcpy(p, &v, 4);
+}
+
+// --- one SPSC ring over the mapping --------------------------------------
+struct Ring {
+  uint8_t* base = nullptr;   // mapping base
+  size_t head_off = 0, tail_off = 0, parked_off = 0;
+  size_t data_off = 0;
+  uint32_t nslots = 0, slot_size = 0;
+  size_t stride = 0;
+
+  void init(uint8_t* b, const size_t ctrl[3], size_t data, uint32_t n,
+            uint32_t ssz) {
+    base = b;
+    head_off = ctrl[0]; tail_off = ctrl[1]; parked_off = ctrl[2];
+    data_off = data; nslots = n; slot_size = ssz;
+    stride = round_up(4 + (size_t)ssz, 64);
+  }
+  uint64_t head() const { return load_u64(base + head_off); }
+  uint64_t tail() const { return load_u64(base + tail_off); }
+  bool empty() const { return tail() >= head(); }
+
+  // writer: false = full/oversized, caller falls back to TCP
+  bool push(const char* payload, size_t len) {
+    if (len > slot_size) return false;
+    uint64_t h = head();
+    if (h - tail() >= nslots) return false;
+    uint8_t* slot = base + data_off + (size_t)(h % nslots) * stride;
+    std::memcpy(slot + 4, payload, len);
+    write_u32_plain(slot, (uint32_t)len);
+    store_u64(base + head_off, h + 1);  // release: publishes the slot
+    return true;
+  }
+  // reader: false = empty
+  bool pop(std::string* out) {
+    uint64_t t = tail();
+    if (t >= head()) return false;
+    const uint8_t* slot = base + data_off + (size_t)(t % nslots) * stride;
+    uint32_t n = read_u32_plain(slot);
+    if (n > slot_size) n = slot_size;  // never trust beyond geometry
+    out->assign(reinterpret_cast<const char*>(slot + 4), n);
+    store_u64(base + tail_off, t + 1);
+    return true;
+  }
+
+  // spin-then-park doorbell protocol (see shmlane.py)
+  bool reader_park() {
+    store_u32(base + parked_off, 1);
+    if (!empty()) {  // lost-wakeup guard
+      store_u32(base + parked_off, 0);
+      return false;
+    }
+    return true;
+  }
+  void reader_unpark() { store_u32(base + parked_off, 0); }
+  bool writer_should_ring() {
+    if (load_u32(base + parked_off)) {
+      store_u32(base + parked_off, 0);
+      return true;
+    }
+    return false;
+  }
+};
+
+inline size_t map_bytes(uint32_t slot_size, uint32_t nslots) {
+  size_t stride = round_up(4 + (size_t)slot_size, 64);
+  return kHeaderBytes + 2 * (size_t)nslots * stride;
+}
+
+// --- a mapped lane: the hub attaches, a client creates -------------------
+struct Lane {
+  uint8_t* base = nullptr;
+  size_t map_len = 0;
+  uint32_t slot_size = 0, nslots = 0;
+  bool is_client = false;  // client role: tx=c2s, rx=s2c (hub: reversed)
+  Ring rx;
+  Ring tx;
+  int bell_rx_fd = -1;   // our bell — a parked read blocks on this
+  int bell_tx_fd = -1;   // the peer's bell (lazy open on first ring)
+  std::string path;
+
+  bool valid() const { return base != nullptr; }
+
+  uint32_t creator_pid() const { return load_u32(base + kOffCreatorPid); }
+  uint32_t attached_pid() const { return load_u32(base + kOffAttachedPid); }
+  bool is_detached() const { return load_u32(base + kOffDetached) != 0; }
+  void mark_detached() { store_u32(base + kOffDetached, 1); }
+  bool peer_alive() const {
+    const uint32_t pid = is_client ? attached_pid() : creator_pid();
+    return pid == 0 || pid_alive(pid);  // 0: negotiation still in flight
+  }
+
+  const char* bell_rx_suffix() const {
+    return is_client ? ".s2c.bell" : ".c2s.bell";
+  }
+  const char* bell_tx_suffix() const {
+    return is_client ? ".c2s.bell" : ".s2c.bell";
+  }
+
+  // Client side: build (or rebuild) the lane file + doorbell FIFOs.  A
+  // leftover same-name file (stale after a SIGKILL, or a prior session
+  // of this peer id) is unlinked and rebuilt so the hub always attaches
+  // clean cursors.
+  static Lane create(const std::string& p, uint32_t slot_size,
+                     uint32_t nslots, std::string* err) {
+    Lane lane;
+    if (nslots == 0 || (nslots & (nslots - 1))) {
+      *err = "nslots not a power of two";
+      return lane;
+    }
+    ::unlink(p.c_str());
+    ::unlink((p + ".c2s.bell").c_str());
+    ::unlink((p + ".s2c.bell").c_str());
+    if (::mkfifo((p + ".c2s.bell").c_str(), 0600) != 0 ||
+        ::mkfifo((p + ".s2c.bell").c_str(), 0600) != 0) {
+      *err = "mkfifo failed";
+      return lane;
+    }
+    const size_t size = map_bytes(slot_size, nslots);
+    int fd = ::open(p.c_str(), O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd < 0) { *err = "lane create failed"; return lane; }
+    if (::ftruncate(fd, (off_t)size) != 0) {
+      ::close(fd);
+      *err = "lane ftruncate failed";
+      return lane;
+    }
+    void* mp = ::mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                      fd, 0);
+    ::close(fd);
+    if (mp == MAP_FAILED) { *err = "lane mmap failed"; return lane; }
+    uint8_t* b = static_cast<uint8_t*>(mp);
+    write_u32_plain(b + kOffMagic, kMagic);
+    uint16_t ver = kVersion;
+    std::memcpy(b + kOffVersion, &ver, 2);
+    write_u32_plain(b + kOffSlotSize, slot_size);
+    write_u32_plain(b + kOffNSlots, nslots);
+    write_u32_plain(b + kOffCreatorPid, (uint32_t)::getpid());
+    lane.base = b;
+    lane.map_len = size;
+    lane.slot_size = slot_size;
+    lane.nslots = nslots;
+    lane.is_client = true;
+    lane.path = p;
+    const size_t stride = round_up(4 + (size_t)slot_size, 64);
+    lane.tx.init(b, kRingCtrl[0], kHeaderBytes, nslots, slot_size);
+    lane.rx.init(b, kRingCtrl[1], kHeaderBytes + (size_t)nslots * stride,
+                 nslots, slot_size);
+    lane.bell_rx_fd = ::open((p + lane.bell_rx_suffix()).c_str(),
+                             O_RDONLY | O_NONBLOCK);
+    err->clear();
+    return lane;
+  }
+
+  // hub attach: validate header, map, record our pid.  Empty-path errors
+  // only — a malformed offer must never crash or half-attach busd.
+  static Lane attach(const std::string& p, std::string* err) {
+    Lane lane;
+    struct stat st{};
+    if (::stat(p.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) {
+      *err = "lane not a regular file: " + p;
+      return lane;
+    }
+    if ((size_t)st.st_size < kHeaderBytes) {
+      *err = "lane too short";
+      return lane;
+    }
+    int fd = ::open(p.c_str(), O_RDWR);
+    if (fd < 0) { *err = "lane open failed"; return lane; }
+    void* mp = ::mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                      MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (mp == MAP_FAILED) { *err = "lane mmap failed"; return lane; }
+    uint8_t* b = static_cast<uint8_t*>(mp);
+    uint32_t magic = read_u32_plain(b + kOffMagic);
+    uint16_t version; std::memcpy(&version, b + kOffVersion, 2);
+    uint32_t ssz = read_u32_plain(b + kOffSlotSize);
+    uint32_t n = read_u32_plain(b + kOffNSlots);
+    if (magic != kMagic || version != kVersion || ssz == 0 ||
+        ssz > (1u << 20) || n == 0 || n > (1u << 16) || (n & (n - 1)) ||
+        (size_t)st.st_size < map_bytes(ssz, n)) {
+      ::munmap(mp, (size_t)st.st_size);
+      *err = "bad lane header";
+      return lane;
+    }
+    lane.base = b;
+    lane.map_len = (size_t)st.st_size;
+    lane.slot_size = ssz;
+    lane.nslots = n;
+    lane.path = p;
+    size_t stride = round_up(4 + (size_t)ssz, 64);
+    lane.rx.init(b, kRingCtrl[0], kHeaderBytes, n, ssz);
+    lane.tx.init(b, kRingCtrl[1], kHeaderBytes + (size_t)n * stride, n, ssz);
+    store_u32(b + kOffAttachedPid, (uint32_t)::getpid());
+    // our bell (c2s): clients create both FIFOs before the hello
+    lane.bell_rx_fd =
+        ::open((p + ".c2s.bell").c_str(), O_RDONLY | O_NONBLOCK);
+    err->clear();
+    return lane;
+  }
+
+  // push one frame toward the client; rings its doorbell if parked.
+  // false = caller must deliver over TCP.
+  bool send(const char* payload, size_t len) {
+    if (!valid() || is_detached()) return false;
+    if (!tx.push(payload, len)) return false;
+    if (tx.writer_should_ring()) ring_bell();
+    return true;
+  }
+  bool recv(std::string* out) { return valid() && rx.pop(out); }
+  bool rx_pending() const { return valid() && !rx.empty(); }
+
+  void ring_bell() {
+    if (bell_tx_fd < 0) {
+      bell_tx_fd = ::open((path + bell_tx_suffix()).c_str(),
+                          O_WRONLY | O_NONBLOCK);
+      if (bell_tx_fd < 0) return;  // reader side not open: not parked
+    }
+    char b = 'x';
+    if (::write(bell_tx_fd, &b, 1) < 0 &&
+        (errno == EPIPE || errno == ENXIO)) {
+      ::close(bell_tx_fd);
+      bell_tx_fd = -1;
+    }
+  }
+
+  void drain_bell() {
+    if (bell_rx_fd < 0) return;
+    char buf[256];
+    while (::read(bell_rx_fd, buf, sizeof buf) > 0) {}
+  }
+
+  void close_lane(bool unlink_files) {
+    if (bell_rx_fd >= 0) { ::close(bell_rx_fd); bell_rx_fd = -1; }
+    if (bell_tx_fd >= 0) { ::close(bell_tx_fd); bell_tx_fd = -1; }
+    if (base) {
+      ::munmap(base, map_len);
+      base = nullptr;
+    }
+    if (unlink_files && !path.empty()) {
+      ::unlink(path.c_str());
+      ::unlink((path + ".c2s.bell").c_str());
+      ::unlink((path + ".s2c.bell").c_str());
+    }
+  }
+};
+
+// Lanes are OPT-IN: JG_BUS_SHM unset/0/false keeps the wire byte-identical.
+inline bool shm_enabled_env() {
+  const char* v = std::getenv("JG_BUS_SHM");
+  if (!v) return false;
+  std::string s(v);
+  return !s.empty() && s != "0" && s != "false";
+}
+
+// Lane files live under JG_BUS_SHM_DIR (the fleet runner points it at the
+// run dir) or a per-uid tmp subdir — byte-for-byte the python lane_dir().
+inline std::string lane_dir() {
+  const char* v = std::getenv("JG_BUS_SHM_DIR");
+  std::string d = (v && *v) ? std::string(v)
+                            : std::string("/tmp/jg_shm_") +
+                                  std::to_string(::getuid());
+  ::mkdir(d.c_str(), 0777);  // best-effort; create_lane errors if unusable
+  return d;
+}
+
+// Canonical lane path for a (peer, busd-shard) pair (= py lane_path_for).
+inline std::string lane_path_for(const std::string& peer_id, int shard,
+                                 const std::string& dir) {
+  std::string safe;
+  for (char ch : peer_id) {
+    if (safe.size() >= 80) break;
+    const bool ok = (ch >= 'a' && ch <= 'z') || (ch >= 'A' && ch <= 'Z') ||
+                    (ch >= '0' && ch <= '9') || ch == '-' || ch == '_' ||
+                    ch == '.';
+    safe += ok ? ch : '_';
+  }
+  return dir + "/" + safe + "-s" + std::to_string(shard) + ".shl";
+}
+
+}  // namespace shm
+}  // namespace mapd

@@ -114,12 +114,13 @@ int main(int argc, char** argv) {
       mode != "--world-encode" && mode != "--audit-digest" &&
       mode != "--audit-encode" && mode != "--audit-decode" &&
       mode != "--fedmap" && mode != "--handoff-encode" &&
-      mode != "--ledger-encode" && mode != "--ledger-decode") {
+      mode != "--ledger-encode" && mode != "--ledger-decode" &&
+      mode != "--agg1-encode" && mode != "--agg1-decode") {
     fprintf(stderr,
             "usage: codec_golden --encode|--decode|--pos1-encode|"
             "--pos1-decode|--shardmap|--world-encode|--audit-digest|"
             "--audit-encode|--audit-decode|--fedmap|--handoff-encode|"
-            "--ledger-encode|--ledger-decode"
+            "--ledger-encode|--ledger-decode|--agg1-encode|--agg1-decode"
             " < lines\n");
     return 2;
   }
@@ -177,6 +178,52 @@ int main(int argc, char** argv) {
           .set("goal", static_cast<int64_t>(p->goal))
           .set("task", p->has_task ? Json(p->task_id) : Json())
           .set("trace", trace_json(p->has_trace, p->trace));
+      printf("%s\n", out.dump().c_str());
+      continue;
+    }
+    if (mode == "--agg1-encode") {
+      // {"entries": [["peer", "<b64 pos1 blob>"], ...],
+      //  "trace": [tid, hop, send_ms]?}  ->  one base64 agg1 per line
+      auto parsed = Json::parse(line);
+      if (!parsed || !parsed->is_object()) {
+        fprintf(stderr, "codec_golden: bad agg1 script line\n");
+        return 1;
+      }
+      const Json& j = *parsed;
+      std::vector<codec::Agg1Entry> entries;
+      for (const auto& e : j["entries"].as_array()) {
+        const auto& pair = e.as_array();
+        auto blob = codec::b64_decode(pair[1].as_str());
+        if (pair.size() != 2 || !blob) {
+          fprintf(stderr, "codec_golden: bad agg1 entry\n");
+          return 1;
+        }
+        entries.push_back({pair[0].as_str(), *blob});
+      }
+      codec::TraceCtx tc;
+      const bool has_tc = parse_trace(j, &tc);
+      printf("%s\n",
+             codec::encode_agg1_b64(entries, has_tc ? &tc : nullptr)
+                 .c_str());
+      continue;
+    }
+    if (mode == "--agg1-decode") {
+      auto a = codec::decode_agg1_b64(line);
+      if (!a) {
+        printf("null\n");
+        continue;
+      }
+      Json entries;
+      for (const auto& e : a->entries) {
+        Json pair;
+        pair.push_back(Json(e.name));
+        pair.push_back(Json(codec::b64_encode(e.blob)));
+        entries.push_back(pair);
+      }
+      if (entries.is_null()) entries = Json(JsonArray{});
+      Json out;
+      out.set("entries", entries)
+          .set("trace", trace_json(a->has_trace, a->trace));
       printf("%s\n", out.dump().c_str());
       continue;
     }

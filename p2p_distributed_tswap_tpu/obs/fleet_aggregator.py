@@ -366,6 +366,16 @@ class FleetAggregator:
                     counter_total(m, "bus.slow_consumer_drops")),
                 "slow_consumer_evictions": int(
                     counter_total(m, "bus.slow_consumer_evictions")),
+                # same-host shm lanes + beacon aggregation (ISSUE 18):
+                # live lane count, ring traffic both ways, TCP fallbacks
+                # (nonzero = rings overflowing), and the coalesce ratio
+                # (agg_entries / agg_flushes = beacons per agg1 frame)
+                "shm_lanes": int(gauges.get("bus.shm_lanes") or 0),
+                "shm_rx_frames": int(counter_total(m, "bus.shm_rx_frames")),
+                "shm_tx_frames": int(counter_total(m, "bus.shm_tx_frames")),
+                "shm_fallbacks": int(counter_total(m, "bus.shm_fallbacks")),
+                "agg_flushes": int(counter_total(m, "bus.agg_flushes")),
+                "agg_entries": int(counter_total(m, "bus.agg_entries")),
             }
         if tick_hist and tick_hist["count"]:
             out["tick"] = {

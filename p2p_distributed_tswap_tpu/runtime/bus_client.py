@@ -52,6 +52,25 @@ shards always self-heal with the same backoff, independent of the
 to lose — with this, losing busd degrades the fleet instead of
 destroying it (VERDICT r2 item 5).
 
+Zero-copy same-host lanes (ISSUE 18, caps ``shm1``): with ``JG_BUS_SHM``
+set truthy (or ``shm=True``) the client creates one shared-memory ring
+pair per shard link (runtime/shmlane.py ≡ cpp/common/shmlane.hpp) and
+offers it in hello (``"shm": {"path": ..., "v": 1}``); once the hub's
+welcome echoes ``shm1``, droppable-class frames (beacons/metrics/path)
+move through the rings as the exact relay lines — publishes via the c2s
+ring, deliveries via the s2c ring — while TCP keeps the control plane,
+oversized frames, and cross-host links.  Ring overflow falls back to TCP
+per frame (``bus.shm_fallbacks`` — never a stall); the lane's lifetime is
+the TCP session (torn down + unlinked on disconnect, rebuilt on
+reconnect).  ``JG_BUS_SHM`` unset keeps the wire byte-identical (pinned
+by tests/test_shmlane.py).
+
+Beacon aggregation (ISSUE 18, caps ``agg1``): with ``JG_BUS_AGG_MS>0``
+the client advertises ``agg1`` and the hub may deliver one coalesced
+multi-agent frame per region topic per window; :meth:`recv` transparently
+explodes it back into per-peer ``pos1`` message dicts, so consumers never
+see the aggregate.
+
 Network accounting lives in the unified live-metrics registry
 (obs/registry.py): per-topic ``bus.msgs_sent`` / ``bus.bytes_sent`` /
 ``bus.msgs_received`` / ``bus.bytes_received`` counters, counting ACTUAL
@@ -63,6 +82,7 @@ rolled-up view; the ``mapd.metrics`` beacon ships the raw counters.
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import select
@@ -73,7 +93,8 @@ from typing import Callable, Iterator, List, Optional
 
 from p2p_distributed_tswap_tpu.obs import registry as _reg
 from p2p_distributed_tswap_tpu.obs import trace
-from p2p_distributed_tswap_tpu.runtime import busns, shardmap
+from p2p_distributed_tswap_tpu.runtime import busns, shardmap, shmlane
+from p2p_distributed_tswap_tpu.runtime import plan_codec
 
 # Topics busd's slow-consumer policy may shed (droppable streams) — the
 # complement is the control plane the replay outbox preserves.  Judged
@@ -93,7 +114,8 @@ class _Link:
     backoff state (each shard negotiates and fails independently)."""
 
     __slots__ = ("shard", "port", "sock", "buf", "topics", "backoff",
-                 "next_attempt", "attempted", "fast_hub", "hub_caps")
+                 "next_attempt", "attempted", "fast_hub", "hub_caps",
+                 "lane", "shm_live")
 
     def __init__(self, shard: int, port: int):
         self.shard = shard
@@ -106,6 +128,8 @@ class _Link:
         self.attempted = False  # ever dialed (lazy links dial on demand)
         self.fast_hub = False
         self.hub_caps: Optional[list] = None
+        self.lane: Optional[shmlane.ShmLane] = None  # offered ring pair
+        self.shm_live = False  # hub's welcome echoed shm1: lane is on
 
 
 class BusClient:
@@ -116,7 +140,8 @@ class BusClient:
                  registry: Optional[_reg.Registry] = None,
                  fastframe: Optional[bool] = None,
                  shard_ports: Optional[List[int]] = None,
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None,
+                 shm: Optional[bool] = None):
         self.peer_id = peer_id or f"py-{int(time.time() * 1000) % 10 ** 10}"
         self._host, self._timeout = host, timeout
         self._reconnect = reconnect
@@ -130,6 +155,17 @@ class BusClient:
         self._fastframe = (os.environ.get("JG_BUS_FASTFRAME", "1")
                            not in ("0", "false", "")
                            if fastframe is None else fastframe)
+        # shm lanes (ISSUE 18) are OPT-IN: offered only when JG_BUS_SHM
+        # is truthy (or shm=True); they ride the relay framing, so
+        # JG_BUS_FASTFRAME=0 vetoes them too
+        self._shm = (shmlane.shm_enabled() if shm is None
+                     else bool(shm)) and self._fastframe
+        # beacon-aggregation window: >0 advertises the agg1 cap (we can
+        # decode coalesced region beacons); 0/unset = legacy singles
+        self._agg_ms = int(os.environ.get("JG_BUS_AGG_MS", "0") or 0)
+        # frames ready ahead of the TCP buffers: lane deliveries and
+        # exploded agg1 entries queue here for recv()/_next_buffered
+        self._pending: deque = deque()
         # shard pool map: explicit arg beats JG_BUS_SHARD_PORTS beats the
         # single `port` (the legacy single-hub wire, byte-identical)
         ports = (list(shard_ports) if shard_ports
@@ -205,11 +241,39 @@ class BusClient:
         if self._ns:
             # namespaced tenant client (ISSUE 8); absent = legacy wire
             caps.append("ns1")
+        # shm lane offer (ISSUE 18): create the ring pair BEFORE the
+        # hello so the hub can attach on receipt; frames ride it only
+        # after the welcome echoes shm1.  A same-name leftover (stale
+        # after a SIGKILL) is reclaimed by create_lane.
+        self._teardown_lane(link)
+        if self._shm:
+            try:
+                link.lane = shmlane.create_lane(
+                    shmlane.lane_path_for(self.peer_id, link.shard))
+                caps.append("shm1")
+                hello["shm"] = {"path": str(link.lane.path), "v": 1}
+            except OSError as e:
+                link.lane = None
+                trace.instant("bus.shm_create_failed", err=str(e))
+        if self._agg_ms > 0:
+            caps.append("agg1")
         if caps:
             hello["caps"] = caps
         self._send_raw(link, hello)
         for t in sorted(link.topics):
             self._send_raw(link, {"op": "sub", "topic": t})
+
+    def _teardown_lane(self, link: _Link) -> None:
+        """Detach and unlink a link's shm lane (its lifetime is the TCP
+        session: a fresh ring pair is offered on every (re)connect)."""
+        if link.lane is not None:
+            try:
+                link.lane.detach()
+                link.lane.close(unlink=True)
+            except OSError:
+                pass
+            link.lane = None
+        link.shm_live = False
 
     def _drop(self, link: _Link) -> None:
         """Connection died: close and arm the backoff timer (reconnect
@@ -221,6 +285,7 @@ class BusClient:
             except OSError:
                 pass
             link.sock = None
+        self._teardown_lane(link)
         link.fast_hub = False  # renegotiate with whatever hub comes back
         if link.shard == shardmap.HOME_SHARD and not self._reconnect:
             raise ConnectionError("bus closed")
@@ -331,6 +396,19 @@ class BusClient:
         if link.fast_hub and " " not in topic:
             # fast framing: the hub relays on a topic peek, no JSON parse
             line = f"P{topic} " + json.dumps(data)
+            # shm fast path (ISSUE 18): droppable-class frames ride the
+            # c2s ring as the exact relay line (no newline); a full ring
+            # falls back to TCP per frame — never a stall.  Control-plane
+            # topics stay on TCP (ordered, outbox-replayed).
+            if (link.shm_live and link.lane is not None
+                    and not _is_control_topic(topic)):
+                if link.lane.send(line.encode()):
+                    self.registry.count("bus.shm_tx_frames")
+                    self.registry.count("bus.msgs_sent", topic=topic)
+                    self.registry.count("bus.bytes_sent", len(line) + 1,
+                                        topic=topic)
+                    return
+                self.registry.count("bus.shm_fallbacks")
         else:
             line = json.dumps({"op": "pub", "topic": topic, "data": data})
         try:
@@ -382,6 +460,28 @@ class BusClient:
             return topic[len(self._ns_prefix):]
         return topic
 
+    def _explode_agg1(self, topic: str, data: dict) -> Optional[dict]:
+        """A coalesced ``agg1`` region frame -> the first per-peer pos1
+        msg dict (the rest queue on ``self._pending``), so consumers see
+        the same singles stream the hub would have sent without
+        aggregation.  Malformed aggregates are dropped and counted —
+        never surfaced (a bad frame must not crash a role loop)."""
+        try:
+            entries, _ = plan_codec.decode_agg1_b64(data.get("data") or "")
+        except plan_codec.CodecError:
+            self.registry.count("bus.agg_rx_malformed")
+            return None
+        if not entries:
+            return None
+        self.registry.count("bus.agg_rx_frames")
+        self.registry.count("bus.agg_rx_entries", len(entries))
+        msgs = [{"op": "msg", "topic": topic, "from": name,
+                 "data": {"type": "pos1",
+                          "data": base64.b64encode(blob).decode()}}
+                for name, blob in entries]
+        self._pending.extend(msgs[1:])
+        return msgs[0]
+
     def _parse_line(self, link: _Link, line: bytes) -> Optional[dict]:
         """One framed line -> normalized frame dict, or None to skip."""
         if line[:1] == b"M":
@@ -397,6 +497,8 @@ class BusClient:
             self.registry.count("bus.msgs_received", topic=topic)
             self.registry.count("bus.bytes_received", len(line) + 1,
                                 topic=topic)
+            if isinstance(data, dict) and data.get("type") == "agg1":
+                return self._explode_agg1(self._deliver_topic(topic), data)
             return {"op": "msg", "topic": self._deliver_topic(topic),
                     "from": sender.decode(errors="replace"),
                     "data": data}
@@ -411,18 +513,32 @@ class BusClient:
             self.registry.count("bus.bytes_received", len(line) + 1,
                                 topic=topic)
             frame["topic"] = self._deliver_topic(topic)
+            data = frame.get("data")
+            if isinstance(data, dict) and data.get("type") == "agg1":
+                return self._explode_agg1(frame["topic"], data)
         elif frame.get("op") == "welcome":
             # caps negotiation: switch publishes to fast framing only
             # when the hub advertises it (old hub -> legacy), per link
             link.hub_caps = frame.get("caps") or []
             link.fast_hub = (self._fastframe
                              and "relay1" in link.hub_caps)
+            # the lane goes live only when the hub echoes shm1 (it
+            # attached our rings); otherwise tear down the offer — an
+            # old hub, a refused attach, or JG_BUS_SHM=0 hub-side
+            if link.lane is not None:
+                link.shm_live = "shm1" in link.hub_caps
+                if not link.shm_live:
+                    self._teardown_lane(link)
         return frame
 
     def _next_buffered(self) -> Optional[dict]:
         """Pop the next complete frame already buffered on any link
         (round-robin across shards, so one busy shard cannot starve the
-        others)."""
+        others).  Frames already exploded/drained ahead of the TCP
+        buffers (agg1 entries, lane deliveries) come first — they are
+        older than anything still framed."""
+        if self._pending:
+            return self._pending.popleft()
         for k in range(self._n):
             link = self._links[(self._rr + k) % self._n]
             while True:
@@ -437,6 +553,35 @@ class BusClient:
                     return frame
         return None
 
+    def _drain_lanes(self) -> None:
+        """Pull every frame waiting in live s2c rings onto the pending
+        queue.  Lane frames are the exact relay ``M`` lines (no
+        newline), so they reuse :meth:`_parse_line` unchanged."""
+        for link in self._links:
+            lane = link.lane
+            if lane is None or not link.shm_live:
+                continue
+            lane.unpark()  # also drains accumulated doorbell bytes
+            while True:
+                raw = lane.recv()
+                if raw is None:
+                    break
+                self.registry.count("bus.shm_rx_frames")
+                parsed = self._parse_line(link, raw)
+                if parsed is not None:
+                    self._pending.append(parsed)
+
+    def _park_lanes(self) -> bool:
+        """Arm every live lane's parked flag so the hub rings the
+        doorbell; False when frames raced in (caller must drain before
+        sleeping — the classic lost-wakeup guard)."""
+        ok = True
+        for link in self._links:
+            if link.lane is not None and link.shm_live:
+                if not link.lane.park():
+                    ok = False
+        return ok
+
     def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
         """Next frame (any op, any shard) or None on timeout.  In
         reconnect mode an outage reads as a timeout (backoff-paced
@@ -444,6 +589,7 @@ class BusClient:
         raises — its regions degrade while the rest of the pool flows."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            self._drain_lanes()
             frame = self._next_buffered()
             if frame is not None:
                 return frame
@@ -471,14 +617,23 @@ class BusClient:
                 max(0.001, min(0.25, deadline - time.monotonic()))
             if deadline is not None and deadline - time.monotonic() <= 0:
                 return None
+            # park live lanes so the hub rings the doorbell while we
+            # sleep; a failed park means frames raced in — drain first
+            if not self._park_lanes():
+                continue
+            rlist = socks + [link.lane.bell_fd() for link in self._links
+                             if link.lane is not None and link.shm_live
+                             and link.lane.bell_fd() >= 0]
             try:
-                readable, _, _ = select.select(socks, [], [], slice_s)
+                readable, _, _ = select.select(rlist, [], [], slice_s)
             except (OSError, ValueError):
                 readable = []  # a sock died mid-select: sweep below
             if not readable and deadline is not None \
                     and time.monotonic() >= deadline:
                 return None
             for sock in readable:
+                if isinstance(sock, int):
+                    continue  # doorbell fd: lanes drain at loop top
                 link = next(l for l in self._links if l.sock is sock)
                 try:
                     sock.settimeout(self._timeout)
@@ -514,3 +669,4 @@ class BusClient:
                 except OSError:
                     pass
                 link.sock = None
+            self._teardown_lane(link)

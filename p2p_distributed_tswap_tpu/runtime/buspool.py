@@ -22,6 +22,8 @@ import time
 from pathlib import Path
 from typing import Callable, List, Optional, Sequence
 
+from p2p_distributed_tswap_tpu.runtime import shmlane
+
 SHARD_PORTS_ENV = "JG_BUS_SHARD_PORTS"
 
 
@@ -103,6 +105,14 @@ class BusPool:
         # loop owns a core.  Spec: "0,1,2" (shard i -> cpu[i % len]),
         # "auto" (spread over this process's allowed CPUs), None = off.
         self.cpu_affinity = parse_cpu_affinity(cpu_affinity)
+        # zero-copy lanes (ISSUE 18): lane files of clients that died by
+        # SIGKILL survive their sessions; sweep the lane dir once per
+        # pool spawn so a fresh fleet never trips over a dead pid's ring
+        if shmlane.shm_enabled():
+            try:
+                shmlane.reclaim_stale()
+            except OSError:
+                pass  # best-effort hygiene: a locked dir must not block
         for i, port in enumerate(self.ports):
             cmd = [str(binary), str(port),
                    *shard_args(i, num_shards, self.ports),

@@ -26,6 +26,7 @@ from p2p_distributed_tswap_tpu.core.config import RuntimeConfig
 from p2p_distributed_tswap_tpu.obs import trace
 from p2p_distributed_tswap_tpu.runtime import buspool
 from p2p_distributed_tswap_tpu.runtime import region as regionlib
+from p2p_distributed_tswap_tpu.runtime import shmlane
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 BUILD_DIR = REPO_ROOT / "cpp" / "build"
@@ -128,6 +129,12 @@ class Fleet:
         # land next to the per-process logs unless the caller routed them
         # elsewhere — so a fleet incident leaves logs AND rings together
         penv.setdefault("JG_FLIGHT_DIR", str(self.log_dir))
+        # zero-copy bus lanes (ISSUE 18): when JG_BUS_SHM is on, the
+        # fleet's ring files live under the run dir with its logs — one
+        # sweep cleans a run, and two concurrent fleets never collide on
+        # the default /tmp lane dir
+        penv.setdefault(shmlane.SHM_DIR_ENV,
+                        str(self.log_dir / "shm_lanes"))
         if config is not None:
             # one RuntimeConfig configures every binary in the fleet
             # (MAPD_* env knobs, cpp/common/knobs.hpp)

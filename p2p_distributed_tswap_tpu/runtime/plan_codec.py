@@ -581,3 +581,118 @@ def decode_pos1_full_b64(data: str
                          ) -> Tuple[int, int, Optional[int],
                                     Optional[TraceCtx]]:
     return decode_pos1_full(_pos1_raw(data))
+
+
+# ---------------------------------------------------------------------------
+# agg1 — per-region beacon aggregate (ISSUE 18, packed1 family).
+#
+# busd coalesces the pos1 beacons of one region topic arriving within a
+# tick window into ONE multi-agent frame delivered once per agg1-capable
+# subscriber — the O(agents)→O(regions) fanout cut on the dominant topic
+# class.  Wire shape (on the ORIGINAL region topic, e.g. mapd.pos.2.3,
+# with busd as the frame `from`):
+#
+#     {"type": "agg1", "data": "<base64>"}
+#
+# Binary layout (little-endian, byte-identical to the C++ mirror in
+# cpp/common/plan_codec.hpp — golden + fuzz gated):
+#
+#     u32 magic       "AGG1" (0x31474741)
+#     u8  version     1
+#     u8  flags       bit0 TRACE: 20-byte trace1 block follows the header
+#                     (the aggregate's own span; each entry's pos1 blob
+#                     keeps its sender's trace block intact, so trace1
+#                     composes through the coalesce hop)
+#     u16 n_entries
+#     [trace1 block]  i64 trace_id, i64 send_unix_ms, u32 hop
+#     per entry:      u16 name_len, u16 blob_len, name bytes,
+#                     pos1 blob VERBATIM (re-encoded by nobody: the bytes
+#                     the sender published are the bytes delivered)
+#
+# Legacy subscribers (no agg1 cap in their hello) keep receiving singles;
+# capable clients transparently explode the aggregate back into per-peer
+# pos1 messages inside BusClient, so consumer role code never sees agg1.
+# ---------------------------------------------------------------------------
+
+AGG1_MAGIC = 0x31474741  # b"AGG1" little-endian
+AGG1_VERSION = 1
+AGG1_FLAG_TRACE = 1
+_AGG1_HEAD = struct.Struct("<IBBH")
+_AGG1_ENTRY = struct.Struct("<HH")
+
+
+def encode_agg1(entries: Sequence[Tuple[str, bytes]],
+                trace: Optional[TraceCtx] = None) -> bytes:
+    """``entries`` is ``[(sender_peer_id, pos1_blob), ...]`` in arrival
+    order.  Raises :class:`CodecError` when an entry exceeds the u16
+    field widths (busd flushes well below them)."""
+    if len(entries) > 0xFFFF:
+        raise CodecError(f"agg1 entry count {len(entries)} > 65535")
+    flags = AGG1_FLAG_TRACE if trace is not None else 0
+    parts = [_AGG1_HEAD.pack(AGG1_MAGIC, AGG1_VERSION, flags, len(entries))]
+    if trace is not None:
+        parts.append(_TRACE_EXT.pack(trace.trace_id, trace.send_ms,
+                                     trace.hop))
+    for name, blob in entries:
+        nb = name.encode()
+        if len(nb) > 0xFFFF or len(blob) > 0xFFFF:
+            raise CodecError("agg1 entry field exceeds u16")
+        parts.append(_AGG1_ENTRY.pack(len(nb), len(blob)))
+        parts.append(nb)
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def decode_agg1(buf: bytes
+                ) -> Tuple[List[Tuple[str, bytes]], Optional[TraceCtx]]:
+    """``([(sender, pos1_blob), ...], trace-or-None)``; raises
+    :class:`CodecError` on any malformation (short, bad magic/version,
+    truncated entry, trailing bytes).  Inner pos1 blobs are NOT decoded
+    here — they pass through verbatim for the consumer's own decode."""
+    if len(buf) < _AGG1_HEAD.size:
+        raise CodecError("short agg1 packet")
+    magic, version, flags, n = _AGG1_HEAD.unpack_from(buf, 0)
+    if magic != AGG1_MAGIC:
+        raise CodecError(f"bad agg1 magic 0x{magic:08x}")
+    if version != AGG1_VERSION:
+        raise CodecError(f"unsupported agg1 version {version}")
+    off = _AGG1_HEAD.size
+    trace = None
+    if flags & AGG1_FLAG_TRACE:
+        if len(buf) < off + _TRACE_EXT.size:
+            raise CodecError("agg1 trace block truncated")
+        tid, send_ms, hop = _TRACE_EXT.unpack_from(buf, off)
+        trace = TraceCtx(tid, hop, send_ms)
+        off += _TRACE_EXT.size
+    entries: List[Tuple[str, bytes]] = []
+    for _ in range(n):
+        if len(buf) < off + _AGG1_ENTRY.size:
+            raise CodecError("agg1 entry header truncated")
+        name_len, blob_len = _AGG1_ENTRY.unpack_from(buf, off)
+        off += _AGG1_ENTRY.size
+        if len(buf) < off + name_len + blob_len:
+            raise CodecError("agg1 entry body truncated")
+        try:
+            name = buf[off:off + name_len].decode()
+        except UnicodeDecodeError as e:
+            raise CodecError(f"agg1 entry name not utf-8: {e}") from None
+        off += name_len
+        entries.append((name, bytes(buf[off:off + blob_len])))
+        off += blob_len
+    if off != len(buf):
+        raise CodecError(f"agg1 trailing bytes ({len(buf) - off})")
+    return entries, trace
+
+
+def encode_agg1_b64(entries: Sequence[Tuple[str, bytes]],
+                    trace: Optional[TraceCtx] = None) -> str:
+    return base64.b64encode(encode_agg1(entries, trace)).decode()
+
+
+def decode_agg1_b64(data: str
+                    ) -> Tuple[List[Tuple[str, bytes]], Optional[TraceCtx]]:
+    try:
+        raw = base64.b64decode(data, validate=True)
+    except Exception as e:
+        raise CodecError(f"bad agg1 base64 framing: {e}") from None
+    return decode_agg1(raw)

@@ -13,6 +13,14 @@ no cmake needed; SKIPs with a warning when no toolchain exists), then:
   wildcard watcher every region beacon, and the hub's own metrics beacon
   must report the fan-out.
 
+``--shm`` (ISSUE 18) runs the SHM-LANE smoke instead: one busd with
+same-host shared-memory lanes on and a 10 ms beacon-aggregation window;
+an shm publisher and an agg1-capable shm subscriber must negotiate
+lanes, every beacon must cross the rings (zero TCP fallbacks), busd
+must coalesce the region fanout >= 4x into agg1 frames the subscriber
+transparently explodes back into singles, and closing the clients must
+leave the lane directory empty (no ring-file litter).
+
 ``--shards 3`` (ISSUE 6) runs the FEDERATED-POOL smoke instead: a
 3-shard busd pool with peering links, a shard-aware publisher spraying
 region beacons across every owning shard, a shard-aware wildcard watcher
@@ -50,6 +58,84 @@ def _drain(client, seconds: float, sink):
         f = client.recv(timeout=0.1)
         if f and f.get("op") == "msg":
             sink.append((f["topic"], f.get("data") or {}))
+
+
+def shm_smoke(binary) -> int:
+    import os
+    import tempfile
+
+    from p2p_distributed_tswap_tpu.obs import registry as _reg
+    from p2p_distributed_tswap_tpu.runtime import shmlane
+
+    n_pos, n_regions = 240, 4
+    with tempfile.TemporaryDirectory(prefix="jg_bus_smoke_shm_") as td:
+        saved = {k: os.environ.get(k)
+                 for k in (shmlane.SHM_DIR_ENV, "JG_BUS_AGG_MS")}
+        os.environ[shmlane.SHM_DIR_ENV] = td
+        os.environ["JG_BUS_AGG_MS"] = "10"
+        port = free_port()
+        bus = subprocess.Popen(
+            [str(binary), str(port)],
+            env=dict(os.environ, JG_BUS_SHM="1"),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            time.sleep(0.3)
+            r_sub, r_pub = _reg.Registry(), _reg.Registry()
+            sub = BusClient(port=port, peer_id="shm-sub", shm=True,
+                            registry=r_sub)
+            sub.subscribe("mapd.pos.*")
+            pub = BusClient(port=port, peer_id="shm-pub", shm=True,
+                            registry=r_pub)
+            for c in (sub, pub):
+                deadline = time.monotonic() + 3
+                while time.monotonic() < deadline and c.hub_caps is None:
+                    c.recv(timeout=0.2)
+                assert c.hub_caps and "shm1" in c.hub_caps, \
+                    f"{c.peer_id}: hub did not negotiate the shm lane"
+            time.sleep(0.2)
+
+            for k in range(n_pos):
+                pub.publish(f"mapd.pos.{k % n_regions}.0",
+                            {"type": "pos1",
+                             "data": pc.encode_pos1_b64(k, k + 1)})
+            got = []
+            t_end = time.monotonic() + 10
+            while time.monotonic() < t_end and len(got) < n_pos:
+                f = sub.recv(timeout=0.2)
+                if f and f.get("op") == "msg" \
+                        and f["topic"].startswith("mapd.pos."):
+                    got.append(pc.decode_pos1_b64(f["data"]["data"])[0])
+            assert sorted(got) == list(range(n_pos)), (
+                f"shm subscriber saw {len(got)}/{n_pos} beacons "
+                f"(losses or dupes across the rings)")
+
+            cp = r_pub.snapshot()["counters"]
+            cs = r_sub.snapshot()["counters"]
+            assert cp.get("bus.shm_tx_frames", 0) == n_pos, cp
+            assert cp.get("bus.shm_fallbacks", 0) == 0, cp
+            assert cs.get("bus.shm_rx_frames", 0) >= 1, cs
+            assert cs.get("bus.agg_rx_entries", 0) == n_pos, cs
+            frames = cs.get("bus.agg_rx_frames", 0)
+            assert 0 < frames <= n_pos // 4, (
+                f"agg1 fanout cut below 4x: {n_pos} beacons arrived as "
+                f"{frames} frames")
+
+            sub.close()
+            pub.close()
+            leftovers = sorted(Path(td).glob("*.shl"))
+            assert not leftovers, f"lane files not reclaimed: {leftovers}"
+            print(f"bus smoke OK (shm): {n_pos} beacons over rings "
+                  f"(0 TCP fallbacks), agg1 coalesced {n_pos} -> {frames} "
+                  f"frames ({n_pos / frames:.1f}x fanout cut), lane dir "
+                  f"clean after close")
+            return 0
+        finally:
+            bus.terminate()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
 
 def sharded_smoke(binary, num_shards: int) -> int:
@@ -139,7 +225,15 @@ def main() -> int:
     ap.add_argument("--shards", type=int, default=1,
                     help="run the federated-pool smoke with this many "
                          "busd shards (default: single-hub smoke)")
+    ap.add_argument("--shm", action="store_true",
+                    help="run the shm-lane + agg1 smoke (ISSUE 18)")
     args = ap.parse_args()
+    if args.shm:
+        binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
+        if binary is None:
+            print("bus smoke: SKIPPED (no g++/binary)", file=sys.stderr)
+            return 0
+        return shm_smoke(binary)
     if args.shards > 1:
         binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
         if binary is None:

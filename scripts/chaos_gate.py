@@ -23,6 +23,11 @@ with a reproducible trigger and an automated judge:
 - ``peer_partition`` — SIGSTOP a busd pool member (a link partition:
   the process lives, its traffic stalls), then SIGCONT: the fleet rides
   through on the surviving shards + reconnects.
+- ``shm_peer_kill`` — replay with the zero-copy lanes armed
+  (JG_BUS_SHM=1, ISSUE 18), spawn a dedicated shm-lane beacon peer,
+  SIGKILL it mid-window: busd must reap the dead peer's ring (lane file
+  unlinked with the TCP session), the surviving lane users keep
+  flowing, and the replay stays divergence-free.
 
 Verdict per fault: ``green`` iff the outcome ledger is intact (every
 captured task completed exactly once), any required detection fired AND
@@ -70,6 +75,7 @@ class Fault:
     kind = "clean"
     needs_solverd = False
     needs_shards = 1
+    needs_shm = False
     extra_drain_s = 0.0
 
     def __init__(self, at_s: float = 0.0, recover_after_s: float = 0.0):
@@ -248,8 +254,83 @@ class PeerPartition(Fault):
         return {**super().summary(), "shard": self.shard}
 
 
+class ShmPeerKill(Fault):
+    """ISSUE 18: the replay runs with the zero-copy lanes armed
+    (JG_BUS_SHM=1 — the sim pool itself rides rings), a dedicated
+    shm-lane beacon peer is spawned at ``at_s`` and SIGKILLed a few
+    seconds later.  The contract: busd reaps the dead peer's ring with
+    its TCP session (the lane FILE is unlinked — nothing stale
+    survives), the surviving lane users keep flowing, and the replay
+    outcome stays intact with no RED divergence."""
+
+    kind = "shm_peer_kill"
+    needs_shm = True
+    extra_drain_s = 15.0
+
+    def __init__(self, at_s: float, kill_after_s: float = 4.0):
+        super().__init__(at_s, recover_after_s=kill_after_s)
+        self.victim = None
+        self.lane_negotiated = None
+        self.reaped = None
+
+    def _lane_path(self):
+        from p2p_distributed_tswap_tpu.runtime import shmlane
+        return shmlane.lane_path_for("shm-victim", 0)
+
+    def fire(self, ctx) -> None:
+        import subprocess
+        code = (
+            "import sys, time, base64\n"
+            f"sys.path.insert(0, {str(ROOT)!r})\n"
+            "from p2p_distributed_tswap_tpu.obs import registry as reg\n"
+            "from p2p_distributed_tswap_tpu.runtime import plan_codec\n"
+            "from p2p_distributed_tswap_tpu.runtime.bus_client import "
+            "BusClient\n"
+            f"c = BusClient(port={ctx.pool.home_port}, "
+            "peer_id='shm-victim', shm=True, registry=reg.Registry())\n"
+            "c.subscribe('mapd.pos.*')\n"
+            "beat = {'type': 'pos1', 'data': base64.b64encode("
+            "plan_codec.encode_pos1(66, 66)).decode()}\n"
+            "while True:\n"
+            "    c.publish('mapd.pos.66.66', beat)\n"
+            "    c.recv(timeout=0.02)\n")
+        self.victim = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        # the lane file appearing proves the ring pair was offered;
+        # busd's welcome echo arms it moments later
+        path, end = self._lane_path(), time.monotonic() + 5.0
+        while not path.exists() and time.monotonic() < end:
+            time.sleep(0.1)
+        self.lane_negotiated = path.exists()
+        ctx.note(f"spawned shm-lane victim (pid {self.victim.pid}, "
+                 f"lane {'up' if self.lane_negotiated else 'MISSING'}) "
+                 f"at t={self.fired_at}s")
+
+    def recover(self, ctx) -> None:
+        self.victim.send_signal(signal.SIGKILL)
+        try:
+            self.victim.wait(timeout=10)
+        except Exception:
+            pass
+        ctx.note(f"SIGKILLed shm-lane victim at t={self.recovered_at}s")
+        # busd sees the TCP session die in its next poll cycle and must
+        # unlink the ring + doorbells — nothing stale survives
+        path, end = self._lane_path(), time.monotonic() + 5.0
+        while path.exists() and time.monotonic() < end:
+            time.sleep(0.1)
+        self.reaped = not path.exists()
+        ctx.note("shm lane reaped (ring file unlinked)" if self.reaped
+                 else f"shm lane NOT reaped: {path} survived the kill")
+
+    def summary(self) -> dict:
+        return {**super().summary(),
+                "lane_negotiated": self.lane_negotiated,
+                "reaped": self.reaped}
+
+
 FAULT_KINDS = ("clean", "bus_shard_kill", "solverd_sigkill",
-               "manager_sigstop", "peer_partition",
+               "manager_sigstop", "peer_partition", "shm_peer_kill",
                "manager_handoff_kill", "manager_kill_failover")
 
 
@@ -272,6 +353,8 @@ def build_fault(kind: str, capture: dict,
         return ManagerSigstop(at_s=mid)
     if kind == "peer_partition":
         return PeerPartition(at_s=mid)
+    if kind == "shm_peer_kill":
+        return ShmPeerKill(at_s=mid)
     if kind == "manager_handoff_kill":
         if ha is None:
             ha = os.environ.get("JG_HA", "") not in ("", "0")
@@ -429,6 +512,16 @@ def classify(kind: str, res: dict) -> dict:
         if red_confirmed:
             reasons.append("clean replay confirmed RED divergence(s): "
                            f"{red_confirmed}")
+    elif kind == "shm_peer_kill":
+        # the lane-hygiene contract (ISSUE 18): the victim's ring must
+        # have been negotiated AND unlinked by busd after the kill
+        notes = res.get("chaos_notes") or []
+        if not any("lane up" in n for n in notes):
+            reasons.append("victim never negotiated an shm lane — the "
+                           "kill tested nothing")
+        if not any("shm lane reaped" in n for n in notes):
+            reasons.append("victim's ring file survived the kill — "
+                           "busd never reaped the lane")
     verdict = "green" if not reasons else "red"
     return {"fault": kind, "verdict": verdict,
             "outcome_ok": outcome_ok, "healed": healed,

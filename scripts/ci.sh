@@ -62,6 +62,8 @@ python -m compileall -q -f \
     scripts/federation_smoke.py \
     p2p_distributed_tswap_tpu/runtime/fleet.py \
     p2p_distributed_tswap_tpu/runtime/plan_codec.py \
+    p2p_distributed_tswap_tpu/runtime/shmlane.py \
+    p2p_distributed_tswap_tpu/runtime/bus_client.py \
     bench.py
 echo "syntax OK"
 
@@ -91,6 +93,12 @@ echo "== busd shard-pool smoke =="
 # spanning without duplicates, peering to a legacy client, and the
 # one-shard-kill degradation contract
 JAX_PLATFORMS=cpu python scripts/bus_smoke.py --shards 3
+
+echo "== busd shm-lane smoke =="
+# zero-copy same-host lanes + per-region beacon aggregation (ISSUE 18):
+# shm1 negotiation, every beacon over the rings with zero TCP fallbacks,
+# >= 4x agg1 fanout cut, lane files reclaimed on close
+JAX_PLATFORMS=cpu python scripts/bus_smoke.py --shm
 
 echo "== trace smoke =="
 # ISSUE 5: a tiny live fleet under JG_TRACE=1 JG_TRACE_SAMPLE=1.0 must

@@ -13,7 +13,11 @@ Four properties per seed:
    round-trips py bytes, and truncated/corrupted packets are rejected on
    both sides (ISSUE 4);
 4. plan fuzz — a TickRunner fed packed deltas (device-resident state)
-   returns the same moves as one fed legacy JSON full-fleet requests.
+   returns the same moves as one fed legacy JSON full-fleet requests;
+5. agg1 + shm fuzz (ISSUE 18) — random beacon aggregates round-trip the
+   py agg1 codec byte-identical to the native one with malformed packets
+   rejected on both sides, and random frame streams through a SHL1 ring
+   stay FIFO-exact while corrupted lane headers are refused at attach.
 
 Runs in ~30 s on the CPU backend; scripts/ci.sh invokes it before the
 tier-1 suite.
@@ -437,6 +441,151 @@ def ledger_fuzz(seed: int, ticks: int = 24) -> bool:
     return True
 
 
+def agg1_fuzz(seed: int, count: int = 80) -> bool:
+    """Random agg1 beacon aggregates (ISSUE 18): py round-trip, py<->cpp
+    byte identity (outer trace1 + inner blobs passed through VERBATIM),
+    and malformed-packet rejection on both sides.  Returns False when
+    the golden binary is unavailable (pure-python checks still ran)."""
+    import base64 as _b64
+    import json as _json
+
+    rng = np.random.default_rng(seed)
+    cases = []  # (entries, trace, py b64)
+    for _ in range(count):
+        entries = []
+        for _k in range(int(rng.integers(0, 9))):
+            name = f"ag{int(rng.integers(1 << 20)):x}"
+            if rng.random() < 0.8:
+                tr = None
+                if rng.random() < 0.5:  # each sender's own trace1 block
+                    tr = pc.TraceCtx(int(rng.integers(1, 1 << 52)),
+                                     int(rng.integers(0, 1 << 16)),
+                                     int(rng.integers(1, 1 << 44)))
+                blob = pc.encode_pos1(
+                    int(rng.integers(1 << 20)), int(rng.integers(1 << 20)),
+                    int(rng.integers(1 << 40))
+                    if rng.random() < 0.5 else None, tr)
+            else:  # the aggregate never re-encodes: any bytes pass through
+                blob = rng.integers(0, 256, size=int(rng.integers(0, 40)),
+                                    dtype=np.uint8).tobytes()
+            entries.append((name, blob))
+        trace = None
+        if rng.random() < 0.5:  # the aggregate's own span
+            trace = pc.TraceCtx(int(rng.integers(1, 1 << 52)),
+                                int(rng.integers(0, 1 << 16)),
+                                int(rng.integers(1, 1 << 44)))
+        b64 = pc.encode_agg1_b64(entries, trace)
+        assert pc.decode_agg1_b64(b64) == (entries, trace), \
+            f"agg1 seed {seed}: py round-trip diverged"
+        raw = pc.encode_agg1(entries, trace)
+        for bad in (raw[:-1], b"\xff" + raw[1:], raw + b"\x00",
+                    raw[:4] + b"\x07" + raw[5:], b""):
+            try:
+                pc.decode_agg1(bad)
+            except pc.CodecError:
+                continue
+            raise AssertionError(f"agg1 seed {seed}: bad packet accepted")
+        cases.append((entries, trace, b64))
+    binary = _golden_binary()
+    if binary is None:
+        return False
+    feed = "\n".join(
+        _json.dumps(dict(
+            entries=[[n, _b64.b64encode(b).decode()] for n, b in entries],
+            **({} if tr is None
+               else {"trace": [tr.trace_id, tr.hop, tr.send_ms]})))
+        for entries, tr, _ in cases) + "\n"
+    out = subprocess.run([str(binary), "--agg1-encode"], input=feed,
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    assert out.stdout.split() == [b64 for _, _, b64 in cases], \
+        f"agg1 seed {seed}: cpp encoder bytes diverged"
+    out = subprocess.run([str(binary), "--agg1-decode"],
+                         input="\n".join([b64 for _, _, b64 in cases]
+                                         + ["AAAA"]) + "\n",
+                         capture_output=True, text=True, check=True,
+                         timeout=120)
+    lines = out.stdout.splitlines()
+    assert lines[-1] == "null", \
+        f"agg1 seed {seed}: cpp accepted a malformed blob"
+    for (entries, tr, _), got in zip(cases, lines):
+        g = _json.loads(got)
+        want = [[n, _b64.b64encode(b).decode()] for n, b in entries]
+        want_tr = None if tr is None else [tr.trace_id, tr.hop, tr.send_ms]
+        assert g["entries"] == want and g.get("trace") == want_tr, \
+            f"agg1 seed {seed}: cpp decoder diverged"
+    return True
+
+
+def shm_fuzz(seed: int, steps: int = 400) -> None:
+    """shm-lane handshake fuzz (ISSUE 18): random frame streams through
+    a SHL1 ring stay FIFO-exact in BOTH directions under arbitrary
+    push/pop interleavings, a detached lane refuses every send, and a
+    corrupted lane header must be rejected by attach_lane — never a
+    crash or a half-attach of the hub."""
+    import tempfile
+
+    from p2p_distributed_tswap_tpu.runtime import shmlane
+
+    rng = np.random.default_rng(seed)
+    with tempfile.TemporaryDirectory(prefix="jg_shmfuzz_") as td:
+        lane_path = Path(td) / "fuzz.shl"
+        client = shmlane.create_lane(lane_path, slot_size=256, nslots=16)
+        hub = shmlane.attach_lane(lane_path)
+        tx = {"c2s": client, "s2c": hub}
+        rx = {"c2s": hub, "s2c": client}
+        flights = {"c2s": [], "s2c": []}
+        for _ in range(steps):
+            d = "c2s" if rng.random() < 0.5 else "s2c"
+            if rng.random() < 0.6:
+                frame = rng.integers(0, 256,
+                                     size=int(rng.integers(1, 200)),
+                                     dtype=np.uint8).tobytes()
+                if tx[d].send(frame):
+                    flights[d].append(frame)
+                else:  # full ring is the TCP-fallback signal, never a drop
+                    assert len(flights[d]) >= client.nslots - 1, \
+                        f"shm seed {seed}: ring refused below capacity"
+            else:
+                got = rx[d].recv()
+                if flights[d]:
+                    assert got == flights[d].pop(0), \
+                        f"shm seed {seed}: ring reordered frames"
+                else:
+                    assert got is None
+        for d in ("c2s", "s2c"):
+            while flights[d]:
+                assert rx[d].recv() == flights[d].pop(0), \
+                    f"shm seed {seed}: drain reordered frames"
+            assert rx[d].recv() is None
+        hub.detach()
+        assert client.send(b"x") is False and hub.send(b"x") is False, \
+            f"shm seed {seed}: detached lane accepted a frame"
+        good = lane_path.read_bytes()
+        hub.close()
+        client.close(unlink=True)
+
+        bad_path = Path(td) / "bad.shl"
+        muts = [good[:100],                              # below header
+                b"\x00\x00\x00\x00" + good[4:],          # bad magic
+                good[:4] + b"\x63\x00" + good[6:],       # version 99
+                good[:8] + b"\x00\x00\x00\x00" + good[12:],   # slot 0
+                good[:12] + b"\x03\x00\x00\x00" + good[16:],  # nslots !pow2
+                good[:5000]]                             # < geometry
+        for off in rng.integers(0, 6, size=4):           # magic/version
+            off = int(off)
+            flip = bytes([good[off] ^ 0xFF])
+            muts.append(good[:off] + flip + good[off + 1:])
+        for mut in muts:
+            bad_path.write_bytes(mut)
+            try:
+                shmlane.attach_lane(bad_path)
+            except shmlane.LaneError:
+                continue
+            raise AssertionError(
+                f"shm seed {seed}: malformed lane header attached")
+
+
 def golden_fuzz(lines_by_seed: dict) -> bool:
     binary = _golden_binary()
     if binary is None:
@@ -544,6 +693,17 @@ def main() -> int:
               "byte-identical, malformed rejected")
     else:
         print("audit1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
+              file=sys.stderr)
+    for seed in range(args.seeds):
+        shm_fuzz(seed)
+    print(f"shm-lane fuzz: {args.seeds} seeds FIFO-exact both ways, "
+          "malformed headers rejected")
+    agg1_native = all([agg1_fuzz(seed) for seed in range(args.seeds)])
+    if agg1_native:
+        print(f"agg1 fuzz: {args.seeds} seeds round-trip, cpp "
+              "byte-identical, malformed rejected")
+    else:
+        print("agg1 fuzz: py round-trip OK; cpp SKIPPED (no g++/binary)",
               file=sys.stderr)
     ledger_native = all([ledger_fuzz(seed) for seed in range(args.seeds)])
     if ledger_native:

@@ -1,0 +1,468 @@
+"""Zero-copy same-host bus lanes + beacon aggregation (ISSUE 18).
+
+Covers the shm ring transport (runtime/shmlane.py ≡ cpp/common/shmlane.hpp)
+and the agg1 coalesced-beacon codec/delivery path:
+
+- ring unit laws: FIFO round-trip, wraparound, overflow refusal, the
+  park/doorbell lost-wakeup guard;
+- lifecycle edges: ring overflow -> per-frame TCP fallback (never a
+  stall), a dead creator's stale lane file reclaimed, lane torn down
+  with its TCP session;
+- kill switch: JG_BUS_SHM unset keeps the hello/publish wire
+  byte-identical, pinned against a raw socket;
+- agg1 codec: py round-trip, py<->cpp byte-identity (codec_golden),
+  malformed rejection on both sides;
+- live busd interop: shm lanes negotiated and carrying traffic both
+  directions, agg1 subscribers get exploded singles, legacy subscribers
+  keep per-peer singles.
+"""
+
+import base64
+import json
+import os
+import socket
+import struct
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from p2p_distributed_tswap_tpu.obs import registry as _reg
+from p2p_distributed_tswap_tpu.runtime import plan_codec, shmlane
+from p2p_distributed_tswap_tpu.runtime.bus_client import BusClient
+from p2p_distributed_tswap_tpu.runtime.buspool import free_port
+from p2p_distributed_tswap_tpu.runtime.fleet import build_single_tu
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def busd_binary() -> Path:
+    binary = build_single_tu("mapd_bus", "cpp/busd/main.cpp")
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    return binary
+
+
+def golden_binary() -> Path:
+    binary = build_single_tu("mapd_codec_golden",
+                             "cpp/probes/codec_golden.cpp")
+    if binary is None:
+        pytest.skip("no C++ toolchain")
+    return binary
+
+
+@pytest.fixture
+def lane_dir(tmp_path, monkeypatch):
+    d = tmp_path / "lanes"
+    monkeypatch.setenv(shmlane.SHM_DIR_ENV, str(d))
+    return d
+
+
+def _pump_welcome(client, timeout=3.0):
+    end = time.monotonic() + timeout
+    while client.hub_caps is None and time.monotonic() < end:
+        client.recv(timeout=0.1)
+    assert client.hub_caps is not None, "no welcome from hub"
+
+
+def _spawn_busd(tmp_path, extra=()):
+    port = free_port()
+    log = open(tmp_path / "busd.log", "w")
+    proc = subprocess.Popen([str(busd_binary()), str(port), *extra],
+                            stdout=log, stderr=subprocess.STDOUT)
+    time.sleep(0.3)
+    return proc, port, log
+
+
+# ---------------------------------------------------------------------------
+# ring unit laws
+# ---------------------------------------------------------------------------
+
+def test_ring_fifo_wrap_and_overflow(lane_dir):
+    """SPSC ring: frames come out in order, the cursor wraps past the
+    slot-count boundary, and a full ring REFUSES the push (the caller's
+    cue to fall back to TCP) instead of overwriting."""
+    path = lane_dir / "unit.shl"
+    client = shmlane.create_lane(path, slot_size=64, nslots=8)
+    hub = shmlane.attach_lane(path)
+    # FIFO + wraparound: 3 laps of the 8-slot ring
+    for lap in range(3):
+        frames = [f"Pmapd.pos.r0 {{\"lap\":{lap},\"i\":{i}}}".encode()
+                  for i in range(8)]
+        for f in frames:
+            assert client.send(f)
+        got = []
+        while (f := hub.recv()) is not None:
+            got.append(f)
+        assert got == frames
+    # overflow: the 9th push into an undrained ring is refused
+    for i in range(8):
+        assert client.send(b"x" * 10)
+    assert not client.send(b"overflow")
+    # oversized frame: refused regardless of occupancy
+    hub_drained = 0
+    while hub.recv() is not None:
+        hub_drained += 1
+    assert hub_drained == 8
+    assert not client.send(b"y" * 65)  # slot_size=64
+    client.close(unlink=True)
+    hub.close()
+
+
+def test_ring_park_doorbell_and_lost_wakeup_guard(lane_dir):
+    """The park protocol: a parked reader's doorbell FIFO becomes
+    readable when the writer pushes; parking with frames already waiting
+    fails (the lost-wakeup guard), forcing the caller to drain first."""
+    path = lane_dir / "bell.shl"
+    client = shmlane.create_lane(path)
+    hub = shmlane.attach_lane(path)
+    # hub side parks its rx (the c2s ring) -> client's send rings c2s bell
+    assert hub.park()
+    assert client.send(b"Pmapd.pos.r0 {}")
+    import select as _select
+    readable, _, _ = _select.select([hub.bell_fd()], [], [], 2.0)
+    assert readable, "doorbell never rang"
+    hub.unpark()
+    assert hub.recv() == b"Pmapd.pos.r0 {}"
+    # lost-wakeup guard: frames raced in before the park -> park fails
+    assert client.send(b"Pmapd.pos.r0 {\"i\":1}")
+    assert not hub.park()
+    assert hub.recv() is not None
+    client.close(unlink=True)
+    hub.close()
+
+
+def test_attach_rejects_malformed_lane(lane_dir):
+    """A truncated or alien file must never be mapped as a ring."""
+    lane_dir.mkdir(parents=True, exist_ok=True)
+    bogus = lane_dir / "bogus.shl"
+    bogus.write_bytes(b"not a lane")
+    with pytest.raises(shmlane.LaneError):
+        shmlane.attach_lane(bogus)
+    # right size, wrong magic
+    bad = lane_dir / "badmagic.shl"
+    real = shmlane.create_lane(lane_dir / "real.shl",
+                               slot_size=64, nslots=8)
+    bad.write_bytes((lane_dir / "real.shl").read_bytes())
+    buf = bytearray(bad.read_bytes())
+    struct.pack_into("<I", buf, 0, 0xDEADBEEF)
+    bad.write_bytes(bytes(buf))
+    with pytest.raises(shmlane.LaneError):
+        shmlane.attach_lane(bad)
+    real.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle edges
+# ---------------------------------------------------------------------------
+
+def test_stale_lane_of_dead_pid_reclaimed(lane_dir):
+    """A SIGKILLed client leaves its ring file behind; reclaim_stale
+    (run by buspool at spawn) unlinks lanes whose creator is dead, and
+    create_lane reclaims a same-name leftover on reconnect."""
+    lane = shmlane.create_lane(lane_dir / "stale.shl")
+    lane.close()
+    # forge a dead creator: a pid from a just-reaped child is free
+    child = subprocess.Popen(["true"])
+    child.wait()
+    with open(lane_dir / "stale.shl", "r+b") as f:
+        f.seek(16)  # creator_pid field
+        f.write(struct.pack("<i", child.pid))
+    live = shmlane.create_lane(lane_dir / "live.shl")  # ours, alive
+    reclaimed = shmlane.reclaim_stale(lane_dir)
+    assert lane_dir / "stale.shl" not in [p for p in lane_dir.iterdir()]
+    assert [p.name for p in reclaimed] == ["stale.shl"]
+    assert (lane_dir / "live.shl").exists(), "live lane must survive"
+    # reconnect over a leftover path: create_lane replaces it cleanly
+    again = shmlane.create_lane(lane_dir / "live.shl")
+    assert again.send(b"Pmapd.pos.r0 {}")
+    again.close(unlink=True)
+    live.close()
+
+
+def test_publish_falls_back_to_tcp_on_full_ring(tmp_path, lane_dir,
+                                                monkeypatch):
+    """Ring overflow is a PER-FRAME TCP fallback, never a stall or a
+    drop: with the lane wedged full, every publish still arrives over
+    the socket and bus.shm_fallbacks counts each one."""
+    monkeypatch.setenv("JG_BUS_SHM", "1")
+    proc, port, log = _spawn_busd(tmp_path)
+    try:
+        reg_pub = _reg.Registry()
+        sub = BusClient(port=port, peer_id="tcp-sub",
+                        registry=_reg.Registry(), shm=False)
+        pub = BusClient(port=port, peer_id="shm-pub", registry=reg_pub)
+        _pump_welcome(pub)
+        _pump_welcome(sub)
+        assert "shm1" in pub.hub_caps
+        sub.subscribe("mapd.pos.r0")
+        time.sleep(0.2)
+        # wedge the lane: make every ring push fail
+        link = pub._links[0]
+        assert link.shm_live and link.lane is not None
+        monkeypatch.setattr(link.lane.tx, "push", lambda frame: False)
+        payload = {"type": "pos1",
+                   "data": base64.b64encode(
+                       plan_codec.encode_pos1(3, 9)).decode()}
+        for _ in range(5):
+            pub.publish("mapd.pos.r0", payload)
+        got = [f for f in sub.messages(2.0)
+               if f["topic"] == "mapd.pos.r0"]
+        assert len(got) == 5, got
+        counters = reg_pub.snapshot()["counters"]
+        fallbacks = sum(v for k, v in counters.items()
+                        if k.startswith("bus.shm_fallbacks"))
+        assert fallbacks == 5, counters
+        pub.close()
+        sub.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        log.close()
+
+
+def test_lane_torn_down_with_session(tmp_path, lane_dir, monkeypatch):
+    """The lane's lifetime is the TCP session: close() unlinks the ring
+    file and its doorbells — nothing stale survives."""
+    monkeypatch.setenv("JG_BUS_SHM", "1")
+    proc, port, log = _spawn_busd(tmp_path)
+    try:
+        c = BusClient(port=port, peer_id="brief", registry=_reg.Registry())
+        _pump_welcome(c)
+        assert "shm1" in c.hub_caps
+        lane_files = list(lane_dir.iterdir())
+        assert lane_files, "no lane created"
+        c.close()
+        time.sleep(0.2)
+        assert not list(lane_dir.iterdir()), list(lane_dir.iterdir())
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# kill switch: JG_BUS_SHM unset -> wire byte-identical
+# ---------------------------------------------------------------------------
+
+def test_shm_unset_wire_bytes_unchanged(monkeypatch):
+    """With JG_BUS_SHM unset the hello must carry neither the shm offer
+    nor the shm1/agg1 caps, and publishes must render exactly the
+    pre-lane bytes — pinned against a raw socket, like the shard-plane
+    pin test."""
+    monkeypatch.delenv("JG_BUS_SHM", raising=False)
+    monkeypatch.delenv("JG_BUS_AGG_MS", raising=False)
+    received = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def server():
+        conn, _ = srv.accept()
+        conn.sendall(b'{"op":"welcome","peer_id":"x","caps":["relay1"]}\n')
+        end = time.monotonic() + 3
+        buf = b""
+        while time.monotonic() < end and buf.count(b"\n") < 3:
+            conn.settimeout(0.5)
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            if not chunk:
+                break
+            buf += chunk
+        received.append(buf)
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    c = BusClient(port=port, peer_id="pinned", registry=_reg.Registry())
+    c.subscribe("mapd.pos.r0")
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not c.fast_hub:
+        c.recv(timeout=0.2)
+    c.publish("mapd.pos.r0", {"type": "pos"})
+    c.close()
+    t.join(timeout=5)
+    srv.close()
+    lines = received[0].split(b"\n")
+    assert lines[0] == b'{"op": "hello", "peer_id": "pinned", ' \
+        b'"caps": ["relay1"]}', lines[0]
+    assert lines[1] == b'{"op": "sub", "topic": "mapd.pos.r0"}', lines[1]
+    assert lines[2] == b'Pmapd.pos.r0 {"type": "pos"}', lines[2]
+
+
+# ---------------------------------------------------------------------------
+# agg1 codec: round-trip, py<->cpp golden, malformed rejection
+# ---------------------------------------------------------------------------
+
+def _sample_entries():
+    return [("peer-a", plan_codec.encode_pos1(3, 17)),
+            ("peer-b", plan_codec.encode_pos1(70000, 2, task_id=9)),
+            ("peer-c", plan_codec.encode_pos1(
+                5, 6, trace=plan_codec.TraceCtx(11, 2, 1234)))]
+
+
+def test_agg1_roundtrip_py():
+    entries = _sample_entries()
+    tr = plan_codec.TraceCtx(77, 1, 999)
+    for trace in (None, tr):
+        blob = plan_codec.encode_agg1(entries, trace)
+        out, got_tr = plan_codec.decode_agg1(blob)
+        assert out == entries
+        if trace is None:
+            assert got_tr is None
+        else:
+            assert (got_tr.trace_id, got_tr.hop, got_tr.send_ms) == \
+                (77, 1, 999)
+        # inner blobs decode as ordinary pos1
+        pos, goal = plan_codec.decode_pos1(out[0][1])[:2]
+        assert (pos, goal) == (3, 17)
+
+
+def test_agg1_py_cpp_byte_identity():
+    """The same entry list must encode to the SAME bytes in py and cpp
+    (packed1 family law), and each side must decode the other's."""
+    golden = golden_binary()
+    entries = _sample_entries()
+    for trace in (None, plan_codec.TraceCtx(42, 3, 555)):
+        py_b64 = plan_codec.encode_agg1_b64(entries, trace)
+        req = {"entries": [[n, base64.b64encode(b).decode()]
+                           for n, b in entries]}
+        if trace is not None:
+            req["trace"] = [trace.trace_id, trace.hop, trace.send_ms]
+        cpp_b64 = subprocess.run(
+            [str(golden), "--agg1-encode"], input=json.dumps(req) + "\n",
+            capture_output=True, text=True, check=True).stdout.strip()
+        assert cpp_b64 == py_b64
+        # cpp decodes the py encoding back to the same entries
+        dec = json.loads(subprocess.run(
+            [str(golden), "--agg1-decode"], input=py_b64 + "\n",
+            capture_output=True, text=True, check=True).stdout)
+        assert [[n, base64.b64encode(b).decode()] for n, b in entries] \
+            == dec["entries"]
+
+
+def test_agg1_malformed_rejected_both_sides():
+    good = plan_codec.encode_agg1([("p", b"\x01\x02")])
+    bad_cases = [
+        b"\x00" * 4,                      # short
+        b"XXXX\x01\x00\x01\x00",          # bad magic
+        good[:-1],                        # truncated tail
+        good + b"\x00",                   # trailing byte
+        bytes([good[0], good[1], good[2], good[3], 9]) + good[5:],  # ver
+    ]
+    golden = golden_binary()
+    for raw in bad_cases:
+        with pytest.raises(plan_codec.CodecError):
+            plan_codec.decode_agg1(raw)
+        out = subprocess.run(
+            [str(golden), "--agg1-decode"],
+            input=base64.b64encode(raw).decode() + "\n",
+            capture_output=True, text=True, check=True).stdout.strip()
+        assert out == "null", (raw, out)
+    with pytest.raises(plan_codec.CodecError):
+        plan_codec.decode_agg1(b"")
+    with pytest.raises(plan_codec.CodecError):
+        plan_codec.decode_agg1_b64("!!!not-base64!!!")
+
+
+# ---------------------------------------------------------------------------
+# live busd interop
+# ---------------------------------------------------------------------------
+
+def test_shm_lane_carries_traffic_both_directions(tmp_path, lane_dir,
+                                                  monkeypatch):
+    """With JG_BUS_SHM=1, droppable frames ride the rings both ways
+    (publish c2s, delivery s2c) while control-plane frames stay on TCP;
+    delivered content is identical to the TCP path."""
+    monkeypatch.setenv("JG_BUS_SHM", "1")
+    proc, port, log = _spawn_busd(tmp_path)
+    try:
+        r_pub, r_sub = _reg.Registry(), _reg.Registry()
+        sub = BusClient(port=port, peer_id="s", registry=r_sub)
+        pub = BusClient(port=port, peer_id="p", registry=r_pub)
+        _pump_welcome(pub)
+        _pump_welcome(sub)
+        assert "shm1" in pub.hub_caps and "shm1" in sub.hub_caps
+        sub.subscribe("mapd.pos.r1")
+        sub.subscribe("mapd")  # control-plane topic
+        time.sleep(0.2)
+        beacon = {"type": "pos1",
+                  "data": base64.b64encode(
+                      plan_codec.encode_pos1(1, 2)).decode()}
+        for _ in range(10):
+            pub.publish("mapd.pos.r1", beacon)
+        pub.publish("mapd", {"type": "task", "task_id": 5})
+        got = list(sub.messages(2.0))
+        pos = [f for f in got if f["topic"] == "mapd.pos.r1"]
+        ctl = [f for f in got if f["topic"] == "mapd"]
+        assert len(pos) == 10 and all(f["data"] == beacon for f in pos)
+        assert len(ctl) == 1 and ctl[0]["data"]["task_id"] == 5
+        cp = r_pub.snapshot()["counters"]
+        cs = r_sub.snapshot()["counters"]
+        assert cp.get("bus.shm_tx_frames", 0) == 10, cp
+        assert cs.get("bus.shm_rx_frames", 0) >= 10, cs
+        pub.close()
+        sub.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        log.close()
+
+
+def test_agg1_explodes_and_legacy_keeps_singles(tmp_path, lane_dir,
+                                                monkeypatch):
+    """busd --agg-ms coalesces one region's beacons into one agg1 frame
+    for agg1 subscribers (recv explodes it back to per-peer singles) —
+    while a LEGACY subscriber on the same topic keeps getting singles.
+    The fanout cut shows up as agg_rx_frames << agg_rx_entries."""
+    monkeypatch.setenv("JG_BUS_SHM", "1")
+    proc, port, log = _spawn_busd(tmp_path, extra=("--agg-ms", "10"))
+    try:
+        r_agg, r_leg = _reg.Registry(), _reg.Registry()
+        monkeypatch.setenv("JG_BUS_AGG_MS", "10")
+        agg_sub = BusClient(port=port, peer_id="agg-sub", registry=r_agg)
+        monkeypatch.delenv("JG_BUS_AGG_MS")
+        leg_sub = BusClient(port=port, peer_id="leg-sub", registry=r_leg)
+        pub = BusClient(port=port, peer_id="beacon-src",
+                        registry=_reg.Registry())
+        for c in (agg_sub, leg_sub, pub):
+            _pump_welcome(c)
+        assert "agg1" in agg_sub.hub_caps
+        assert "agg1" not in leg_sub.hub_caps
+        agg_sub.subscribe("mapd.pos.r2")
+        leg_sub.subscribe("mapd.pos.r2")
+        time.sleep(0.2)
+        n = 16
+        for i in range(n):
+            pub.publish("mapd.pos.r2",
+                        {"type": "pos1",
+                         "data": base64.b64encode(
+                             plan_codec.encode_pos1(i, i + 1)).decode()})
+        got_agg = [f for f in agg_sub.messages(2.0)
+                   if f["topic"] == "mapd.pos.r2"]
+        got_leg = [f for f in leg_sub.messages(2.0)
+                   if f["topic"] == "mapd.pos.r2"]
+        assert len(got_agg) == n, len(got_agg)
+        assert len(got_leg) == n, len(got_leg)
+        # both streams carry the SAME per-peer pos1 singles
+        for f in got_agg + got_leg:
+            assert f["data"]["type"] == "pos1"
+            assert f["from"] == "beacon-src"
+        decoded = sorted(plan_codec.decode_pos1(
+            base64.b64decode(f["data"]["data"]))[0] for f in got_agg)
+        assert decoded == list(range(n))
+        # the fanout cut: n entries arrived in far fewer wire frames
+        ca = r_agg.snapshot()["counters"]
+        assert ca.get("bus.agg_rx_entries", 0) == n, ca
+        assert ca.get("bus.agg_rx_frames", 0) <= n // 4, ca
+        for c in (agg_sub, leg_sub, pub):
+            c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        log.close()
