@@ -108,6 +108,12 @@ def render(rollup: dict, spec=None, color: bool = False) -> str:
                 cell += f" world_seq={f['world_seq']}"
             if f.get("promotions"):
                 cell += f" promoted={f['promotions']}"
+            if f.get("mirror_evictions"):
+                cell += f" mev={f['mirror_evictions']}"
+            sec = f.get("sector")
+            if sec:
+                cell += (f" sector r/e/f={sec['routes']}"
+                         f"/{sec['reentries']}/{sec['fallbacks']}")
             return cell
 
         lines.append("FIELD " + " | ".join(

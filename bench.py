@@ -125,6 +125,12 @@ record — same-host beacon throughput per hub core (beacons relayed per
 busd CPU-second) with the shared-memory rings OFF vs ON on identical
 pos1 traffic, plus the ring share and overflow-fallback count for the
 shm rung.
+
+Sector axis (ISSUE 19): unless BENCH_SECTOR=0, the headline carries a
+``sector`` record — fresh-goal p50/p95 of the full field pipeline vs
+the hierarchical sector planner on a 512^2 rung (analysis/
+sector_bench.py --quick) plus the measured suboptimality bound, so the
+corridor planner's latency win stays tracked on the BENCH trajectory.
 """
 
 from __future__ import annotations
@@ -861,6 +867,49 @@ def run_field_engine_axis() -> dict:
     }
 
 
+def run_sector_axis() -> dict:
+    """Sector-planner rung (ISSUE 19): fresh-goal p50/p95 of the full
+    field pipeline vs the hierarchical sector planner on a 512^2 rung
+    (analysis/sector_bench.py --quick), plus the measured suboptimality
+    bound.  Failures are recorded, never fatal."""
+    import tempfile
+    from pathlib import Path
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = Path(tempfile.mkdtemp(prefix="jg-bench-sector-")) / "sector.json"
+    cmd = [sys.executable,
+           os.path.join(root, "analysis", "sector_bench.py"),
+           "--quick", "--out", str(out)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=900,
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                              cwd=root)
+    except subprocess.TimeoutExpired:
+        return {"error": "sector_bench timeout"}
+    if not out.exists():
+        return {"error": (proc.stderr or proc.stdout or "no output")[-300:]}
+    try:
+        doc = json.loads(out.read_text())
+    except json.JSONDecodeError as e:
+        return {"error": f"artifact parse: {e}"}
+    fg = doc.get("fresh_goal") or {}
+    row = (fg.get("sector") or [{}])[0]
+    return {
+        "grid": fg.get("grid"),
+        "full_ms_p50": fg.get("full_ms_p50"),
+        "full_ms_p95": fg.get("full_ms_p95"),
+        "sector_s": row.get("s"),
+        "sector_ms_p50": row.get("plan_ms_p50"),
+        "sector_ms_p95": row.get("plan_ms_p95"),
+        "speedup_p95": row.get("speedup_p95_vs_full"),
+        "corridor_fraction": row.get("corridor_fraction"),
+        "eps_max": (doc.get("epsilon") or {}).get("eps_max"),
+        "eps_within_bound": (doc.get("epsilon") or {}).get(
+            "within_bound"),
+    }
+
+
 def run_mesh_axis() -> dict:
     """Mesh-solverd rung (ISSUE 13): flat vs 2-way vs 8-way virtual-mesh
     tick/sweep ms + per-device resident bytes + the bit_identical
@@ -1315,6 +1364,9 @@ def main():
     if os.environ.get("BENCH_MESH", "1") != "0":
         # mesh axis (ISSUE 13): flat vs 2/8-way virtual-mesh solverd
         head["mesh"] = run_mesh_axis()
+    if os.environ.get("BENCH_SECTOR", "1") != "0":
+        # sector axis (ISSUE 19): fresh-goal p50/p95 full vs sector
+        head["sector"] = run_sector_axis()
     if os.environ.get("BENCH_FEDERATION", "1") != "0":
         # federation axis (ISSUE 14): 2x1 region pairs, exact-once
         # world-spanning completion + handoff evidence

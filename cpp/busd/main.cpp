@@ -84,6 +84,7 @@
 #include <signal.h>
 #include <sys/uio.h>
 
+#include <chrono>
 #include <cstdio>
 #include <deque>
 #include <map>
@@ -126,6 +127,12 @@ struct Client {
   bool agg1 = false;   // advertised caps:["agg1"] AND window active:
                        // receives coalesced region beacons, not singles
   shm::Lane lane;      // attached shm ring pair (valid() if negotiated)
+  // shm spin-then-park state: last instant the lane had frames (the
+  // idle-spin budget counts from here) and whether the reader is
+  // currently parked (bus.shm_parks counts busy->parked transitions
+  // only, so a long park is one event, not one per poll iteration)
+  int64_t lane_busy_us = 0;
+  bool lane_parked = false;
   int peer_shard = -1;   // shard index of the remote busd (peer links)
   std::set<std::string> topics;
   std::set<std::string> prefixes;  // from "<prefix>.*" subscriptions
@@ -235,6 +242,13 @@ int main(int argc, char** argv) {
   // disabled (clients only offer when JG_BUS_SHM is set truthy, so the
   // unset default keeps the wire byte-identical end to end)
   const bool shm_ok = knobs.get_int("--shm", "JG_BUS_SHM", 1) != 0;
+  // shm idle-spin budget (µs): after a lane's last frame, keep the poll
+  // loop hot (zero-timeout) this long before parking on the doorbell
+  // FIFO.  0 (default) parks immediately — the pre-knob behavior.  A
+  // bursty publisher that resumes within the budget skips the
+  // park/doorbell syscall round trip at the cost of busd CPU.
+  const int64_t shm_spin_us =
+      knobs.get_int("--shm-spin-us", "JG_BUS_SHM_SPIN_US", 0);
   // beacon aggregation window (ms); 0 = off (byte-identical wire)
   const int64_t agg_ms = knobs.get_int("--agg-ms", "JG_BUS_AGG_MS", 0);
   signal(SIGINT, handle_stop);
@@ -758,16 +772,37 @@ int main(int argc, char** argv) {
       if (slot.pending_fd >= 0)
         pfds.push_back({slot.pending_fd, POLLOUT, 0});
     // shm lanes: spin-then-park.  A lane with frames already waiting
-    // forces a zero-timeout poll (spin); otherwise we park — set the
-    // ring's parked flag (re-checking for the race) and let the client's
-    // doorbell FIFO wake us through the poll set.
+    // forces a zero-timeout poll (spin); an idle lane keeps spinning
+    // within --shm-spin-us of its last frame; past the budget we park —
+    // set the ring's parked flag (re-checking for the race) and let the
+    // client's doorbell FIFO wake us through the poll set.
     int timeout_ms = 1000;
+    const int64_t now_us = std::chrono::duration_cast<std::chrono::microseconds>(
+        std::chrono::steady_clock::now().time_since_epoch()).count();
     for (auto& [fd, c] : clients) {
       if (!c->lane.valid()) continue;
-      if (c->lane.rx_pending() || !c->lane.rx.reader_park())
+      if (c->lane.rx_pending()) {
+        c->lane_busy_us = now_us;
+        c->lane_parked = false;
         timeout_ms = 0;
-      else if (c->lane.bell_rx_fd >= 0)
-        pfds.push_back({c->lane.bell_rx_fd, POLLIN, 0});
+      } else if (shm_spin_us > 0 &&
+                 now_us - c->lane_busy_us < shm_spin_us) {
+        // idle-spin budget not yet spent: stay hot, no park flag
+        c->lane_parked = false;
+        timeout_ms = 0;
+      } else if (!c->lane.rx.reader_park()) {
+        // a writer slipped a frame in during the park race: stay hot
+        c->lane_busy_us = now_us;
+        c->lane_parked = false;
+        timeout_ms = 0;
+      } else {
+        if (!c->lane_parked) {
+          c->lane_parked = true;
+          metrics_count("bus.shm_parks");
+        }
+        if (c->lane.bell_rx_fd >= 0)
+          pfds.push_back({c->lane.bell_rx_fd, POLLIN, 0});
+      }
     }
     // a pending agg window bounds the sleep to its flush deadline
     if (timeout_ms > 0 && !agg_pending.empty()) {
@@ -792,9 +827,15 @@ int main(int argc, char** argv) {
       c->lane.rx.reader_unpark();
       c->lane.drain_bell();
       std::string frame;
-      for (int budget = 4096; budget > 0 && c->lane.recv(&frame); --budget)
+      int budget = 4096;
+      for (; budget > 0 && c->lane.recv(&frame); --budget)
         if (!frame.empty() && frame[0] == 'P')
           handle_fast_pub(*c, fd, frame, true);
+      if (budget < 4096) {
+        // frames arrived: restart the idle-spin budget from now
+        c->lane_busy_us = now_us;
+        c->lane_parked = false;
+      }
     }
     flush_aggs();
 
@@ -894,6 +935,12 @@ int main(int argc, char** argv) {
             std::string err;
             if (!lane_path.empty()) c.lane = shm::Lane::attach(lane_path, &err);
             if (c.lane.valid()) {
+              // the idle-spin budget counts from attach, so a fresh
+              // lane is not charged a park before its first frame
+              c.lane_busy_us =
+                  std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::steady_clock::now().time_since_epoch())
+                      .count();
               metrics_count("bus.shm_attaches");
               log_info("🧵 shm lane up for %s (%s)\n", c.peer_id.c_str(),
                        lane_path.c_str());

@@ -412,7 +412,24 @@ class FleetAggregator:
                 "promotions": int(
                     counter_total(m, "solverd.field_queue_promotions")),
                 "world_seq": int(gauges.get("solverd.world_seq") or 0),
+                # host repair-mirror pressure: each eviction turns that
+                # goal's next repair into a full recompute, so a rising
+                # rate here EXPLAINS a rising repair_fallbacks rate
+                "mirror_evictions": int(
+                    counter_total(m, "solverd.mirror_evictions")),
             }
+            # hierarchical sector planner (ISSUE 19): corridor plans
+            # served vs full-sweep fallbacks — only present when
+            # JG_SECTOR routed at least one goal
+            routes = counter_total(m, "solverd.sector_routes")
+            if routes:
+                out["field"]["sector"] = {
+                    "routes": int(routes),
+                    "reentries": int(
+                        counter_total(m, "solverd.sector_reentries")),
+                    "fallbacks": int(
+                        counter_total(m, "solverd.sector_fallbacks")),
+                }
         if task_hist and task_hist["count"]:
             out["tasks"] = {
                 "completed": task_hist["count"],

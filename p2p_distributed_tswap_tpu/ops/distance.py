@@ -306,6 +306,28 @@ def pack_directions(fields: jnp.ndarray) -> jnp.ndarray:
     return word
 
 
+def unpack_code_np(packed_row: np.ndarray, cell: int) -> int:
+    """Host-side single-cell unpack of one packed direction row — the
+    nibble twin of gather_packed for host copies (the sector planner's
+    corridor-membership checks read these without a device sync)."""
+    word = int(packed_row[cell >> 3])
+    return (word >> (4 * (cell & 7))) & 0xF
+
+
+def unpack_rows_np(packed: np.ndarray, num_cells: int) -> np.ndarray:
+    """Host-side inverse of pack_directions for (..., pc) uint32 rows:
+    returns (..., num_cells) uint8 codes (pad nibbles dropped).  Test
+    and analysis helper — bit-identity assertions compare unpacked
+    codes instead of eyeballing nibble words."""
+    packed = np.asarray(packed)
+    out = np.empty(packed.shape[:-1] + (packed.shape[-1] * PACKED_LANES,),
+                   np.uint8)
+    for lane in range(PACKED_LANES):
+        out[..., lane::PACKED_LANES] = (packed >> np.uint32(4 * lane)) \
+            & np.uint32(0xF)
+    return out[..., :num_cells]
+
+
 def gather_packed(packed: jnp.ndarray, row: jnp.ndarray,
                   pos_idx: jnp.ndarray) -> jnp.ndarray:
     """Direction code at flat cell ``pos_idx`` from packed row ``row``:

@@ -166,6 +166,13 @@ def _window_fixpoint(seed: jnp.ndarray, free_w: jnp.ndarray) -> jnp.ndarray:
     return d
 
 
+# Public name for the sector planner (ops/sector.py), whose batched
+# intra-sector and corridor solves on accelerator backends pad to pow2
+# windows and run this same program — one fixpoint kernel for repair
+# windows and sector windows alike.
+window_fixpoint = _window_fixpoint
+
+
 # Windows up to this many cells run the host bucket-Dijkstra instead of
 # the jitted fixpoint: a localized toggle's window is a few hundred
 # cells, where a per-shape XLA compile (seconds on the CPU floor) would

@@ -101,6 +101,7 @@ from p2p_distributed_tswap_tpu.obs import flightrec
 from p2p_distributed_tswap_tpu.obs.beacon import MetricsBeacon
 from p2p_distributed_tswap_tpu.obs.heartbeat import TICK_BUDGET_MS
 from p2p_distributed_tswap_tpu.ops import field_repair
+from p2p_distributed_tswap_tpu.ops import sector
 from p2p_distributed_tswap_tpu.ops.distance import (
     DIR_DXDY,
     DIR_STAY,
@@ -251,6 +252,12 @@ class PlanService:
     # goal whose mirror is evicted keeps its packed row; its next repair
     # just falls back to one full recompute.
     MIRROR_BYTES = 256 << 20
+    # Start-cell hints retained per goal for the sector planner (ISSUE
+    # 19): folding more distinct lane positions than this into one
+    # corridor adds sectors without adding route information (plan_goal
+    # itself folds at most sector.MAX_PLAN_STARTS per call; later lanes
+    # re-enter lazily).
+    SECTOR_HINTS_MAX = 64
 
     def __init__(self, grid: Grid, capacity_min: int = 16,
                  field_cache: int = 4096,
@@ -333,6 +340,20 @@ class PlanService:
         self.dirs_mirror: Dict[int, np.ndarray] = {}  # goal -> (H,W) u8
         self.dist_seq: Dict[int, int] = {}  # goal -> log length at sweep
         self.max_mirrors = max(16, self.MIRROR_BYTES // (5 * grid.num_cells))
+        # Hierarchical sector planner (ISSUE 19): with JG_SECTOR=1 a
+        # fresh goal gets a corridor plan (O(route-sector area)) instead
+        # of a full-grid sweep.  Unset, self.sector stays None and every
+        # sector branch below is dead code — the wire and the compiled
+        # programs are byte-identical (tests/test_sector.py pins this).
+        # The planner holds free_np BY REFERENCE: apply_world_update's
+        # in-place mask mutation is visible to it immediately, and
+        # apply_toggles repairs the portal graph right after.
+        self.sector: Optional["sector.SectorPlanner"] = None
+        self.sector_hints: Dict[int, set] = {}  # goal -> start cells
+        if sector.sector_enabled():
+            self.sector = sector.SectorPlanner(self.free_np)
+            registry.get_registry().gauge("solverd.sector_cells",
+                                          self.sector.s)
         self.queue_clock = 0                # process_field_queue calls
         self._last_cap = 0
         self._seen_programs = 0
@@ -391,6 +412,9 @@ class PlanService:
         self.dist_mirror.pop(g, None)
         self.dirs_mirror.pop(g, None)
         self.dist_seq.pop(g, None)
+        if self.sector is not None:
+            self.sector.forget(g)
+            self.sector_hints.pop(g, None)
         return row
 
     def _store_mirror(self, g: int, dist_row: np.ndarray,
@@ -418,7 +442,13 @@ class PlanService:
         single-goal call must not pay 8x padding waste.  In dynamic
         mode the host repair mirrors + staleness stamps record per
         goal.  Shared by the fresh-sweep path (_ensure_fields) and the
-        repair full-recompute fallback (_repair_goals)."""
+        repair full-recompute fallback (_repair_goals).  With the
+        sector planner on, goals it can corridor-plan never reach the
+        full sweep at all — _sector_sweep peels them off first."""
+        if self.sector is not None:
+            goals, rows = self._sector_sweep(goals, rows)
+            if not goals:
+                return
         parts = []
         o, c = 0, self.FIELD_CHUNK
         while o < len(goals):
@@ -443,6 +473,76 @@ class PlanService:
         fields = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         self.dirs = self._pin_dirs(
             self.dirs.at[jnp.asarray(rows, jnp.int32)].set(fields))
+
+    # -- hierarchical sector planning (ISSUE 19) --------------------------
+
+    def _sector_hint(self, goal: int, pos: int) -> None:
+        """Record one lane position as a corridor start for ``goal``'s
+        next sector plan (no-op when the planner is off or the goal is
+        the STAY pseudo-goal)."""
+        if self.sector is None or goal == -1:
+            return
+        hs = self.sector_hints.setdefault(int(goal), set())
+        if len(hs) < self.SECTOR_HINTS_MAX:
+            hs.add(int(pos))
+
+    def _sector_sweep(self, goals: List[int], rows: List[int]
+                      ) -> Tuple[List[int], List[int]]:
+        """Corridor-plan as many of ``goals`` as the planner can
+        (consuming the start hints recorded at state-application time),
+        scatter their packed rows in one device write, and return the
+        remainder for the full-sweep path.  A goal with no recorded
+        start (e.g. a prime prefetch before any lane holds it) falls
+        back to the full sweep — that row is then whole-grid exact, so
+        ``solverd.sector_fallbacks`` measures lost latency, never lost
+        field quality."""
+        reg = registry.get_registry()
+        rem_g: List[int] = []
+        rem_r: List[int] = []
+        srows: List[int] = []
+        packed: List[np.ndarray] = []
+        for g, r in zip(goals, rows):
+            starts = self.sector_hints.pop(g, ())
+            plan = self.sector.plan_goal(g, starts)
+            if plan is None:
+                rem_g.append(g)
+                rem_r.append(r)
+                reg.count("solverd.sector_fallbacks")
+                continue
+            srows.append(r)
+            packed.append(plan.packed)
+            self.dist_seq[g] = len(self.world_log)
+            reg.count("solverd.sector_routes")
+            reg.observe("solverd.sector_plan_ms", self.sector.last_plan_ms)
+        if srows:
+            self.dirs = self._pin_dirs(
+                self.dirs.at[jnp.asarray(srows, jnp.int32)].set(
+                    jnp.asarray(np.stack(packed))))
+        return rem_g, rem_r
+
+    def _sector_reenter(self, goal: int, pos: int) -> None:
+        """Extend ``goal``'s corridor when a lane reads STAY outside it:
+        one plan_goal call folds the lane's cell (plus any hints that
+        accumulated since the last plan) into the existing corridor —
+        a portal route from the new start, never a world sweep — and
+        rewrites the goal's cached row in place.  plan_goal always plans
+        against the live mask at the planner's current epoch, so a
+        re-entry also heals staleness and the world stamp advances."""
+        if self.sector is None or not self.sector.manages(goal):
+            return
+        if not self.sector.needs_reentry(goal, pos):
+            return
+        starts = self.sector_hints.pop(goal, set()) | {int(pos)}
+        plan = self.sector.plan_goal(goal, starts)
+        if plan is None:
+            return
+        self.dist_seq[goal] = len(self.world_log)
+        reg = registry.get_registry()
+        reg.count("solverd.sector_reentries")
+        reg.observe("solverd.sector_plan_ms", self.sector.last_plan_ms)
+        self.dirs = self._pin_dirs(
+            self.dirs.at[self.goal_rows[goal]].set(
+                jnp.asarray(plan.packed)))
 
     def _is_stale(self, g: int) -> bool:
         """A cached row swept before the latest world toggle no longer
@@ -556,6 +656,15 @@ class PlanService:
         cap = self._capacity(n)
         t_plan0 = time.perf_counter()
         goals = [g for _, _, g in agents]
+        if self.sector is not None:
+            # cached goals get a corridor re-entry check for each agent
+            # position; fresh ones bank the positions as corridor starts
+            # for the sweep below
+            for _, p, g in agents:
+                if g in self.goal_rows:
+                    self._sector_reenter(g, int(p))
+                else:
+                    self._sector_hint(g, int(p))
         with trace.span("solverd.cache_lookup", agents=n,
                         parent="solverd.tick"):
             # counts hits/misses and LRU-touches cached request goals
@@ -844,16 +953,23 @@ class PlanService:
             reg.count("solverd.prefetched_fields", len(missing))
         self._repair_stale([g for g, _ in popped])
 
-    def _slot_of(self, lane: int, goal: int) -> int:
+    def _slot_of(self, lane: int, goal: int,
+                 pos: Optional[int] = None) -> int:
         """Field row for a lane's goal; with deferred fields on, a missing
         row parks the lane on the STAY row and queues the sweep (front of
         the queue: a waiting agent outranks speculative prefetch).  A
         stale cached row (world toggle since its sweep) serves as-is —
         the STAY safety patch keeps it wall-legal — with its repair
-        queued for the idle window."""
+        queued for the idle window.  ``pos`` (when the caller knows it)
+        feeds the sector planner: a corridor start hint for a goal not
+        yet planned, a re-entry check for one that is."""
         self._unwait(lane)
+        if pos is not None:
+            self._sector_hint(goal, pos)
         row = self.goal_rows.get(goal)
         if row is not None:
+            if pos is not None:
+                self._sector_reenter(goal, int(pos))
             if self._is_stale(goal):
                 self._queue_goal(goal, "repair")
             return row
@@ -944,6 +1060,17 @@ class PlanService:
             registry.get_registry().count("solverd.world_log_compactions")
         self.world_log.extend(c for c, _ in changed)
         self.free = jnp.asarray(self.free_np)
+        if self.sector is not None:
+            # the mask already mutated in place above — repair the
+            # portal graph incrementally (dirty sectors + neighbors);
+            # corridor plans re-derive through the normal staleness /
+            # repair queue below
+            t0 = time.perf_counter()
+            n_sect = self.sector.apply_toggles([c for c, _ in changed])
+            reg_s = registry.get_registry()
+            reg_s.count("solverd.sector_rebuilds", n_sect)
+            reg_s.observe("solverd.sector_repair_ms",
+                          1000.0 * (time.perf_counter() - t0))
         newly_blocked = [c for c, b in changed if b]
         if newly_blocked and self.dirs is not None:
             self._stay_patch(newly_blocked)
@@ -1124,12 +1251,18 @@ class PlanService:
             goals = [int(g) for g in upd.goal]
             for g in goals:
                 self._ref_goal(g, +1)
+            if self.sector is not None:
+                # corridor starts must be banked BEFORE the sweep below
+                # plans the fresh goals
+                for p, g in zip(upd.pos, goals):
+                    self._sector_hint(g, int(p))
             self._ensure_rows_or_defer(goals)
             self.h_pos[lanes] = upd.pos
             self.h_goal[lanes] = upd.goal
             self.h_slot[lanes] = np.fromiter(
-                (self._slot_of(int(l), g)
-                 for l, g in zip(lanes, goals)), np.int32, len(goals))
+                (self._slot_of(int(l), g, int(p))
+                 for l, g, p in zip(lanes, goals, upd.pos)),
+                np.int32, len(goals))
             self.h_active[lanes] = True
             # a snapshot IS the O(N) resync: one full upload
             self.d_pos = self._lane_put(self.h_pos)
@@ -1157,6 +1290,7 @@ class PlanService:
             if v is not None:
                 self._ref_goal(v[1], +1)
                 goals.append(v[1])
+                self._sector_hint(v[1], v[0])
         self._ensure_rows_or_defer(goals)
         m = len(final)
         lanes = np.fromiter(final.keys(), np.int32, m)
@@ -1169,7 +1303,7 @@ class PlanService:
                 self._unwait(lane)
                 continue
             vp[k], vg[k] = v
-            vs[k] = self._slot_of(lane, v[1])
+            vs[k] = self._slot_of(lane, v[1], v[0])
             va[k] = True
         self.h_pos[lanes] = vp
         self.h_goal[lanes] = vg
@@ -1873,15 +2007,22 @@ class TenantSlab:
                 if not s:
                     del self.wait_lanes[g]
 
-    def _slot_of(self, row: int, lane: int, goal: int) -> int:
+    def _slot_of(self, row: int, lane: int, goal: int,
+                 pos: Optional[int] = None) -> int:
         """Field row for a lane's goal; a missing row parks the lane on
         the shared STAY row and front-queues the sweep (a waiting agent
         outranks speculative prefetch).  Stale rows (world toggle since
-        their sweep) queue a repair, like the flat path."""
+        their sweep) queue a repair, like the flat path — which also
+        owns the sector planner: hints and re-entry route through the
+        shared service, so corridors fold starts across tenants."""
         svc = self.service
         self._unwait(row, lane)
+        if pos is not None:
+            svc._sector_hint(goal, pos)
         r = svc.goal_rows.get(goal)
         if r is not None:
+            if pos is not None:
+                svc._sector_reenter(goal, int(pos))
             if svc._is_stale(goal):
                 svc._queue_goal(goal, "repair")
             return r
@@ -1957,12 +2098,16 @@ class TenantSlab:
             goals = [int(g) for g in upd.goal]
             for g in goals:
                 svc._ref_goal(g, +1)
+            if svc.sector is not None:
+                for p, g in zip(upd.pos, goals):
+                    svc._sector_hint(g, int(p))
             self._ensure_rows_or_defer(goals)
             self.h_pos[row, lanes] = upd.pos
             self.h_goal[row, lanes] = upd.goal
             self.h_slot[row, lanes] = np.fromiter(
-                (self._slot_of(row, int(l), g)
-                 for l, g in zip(lanes, goals)), np.int32, len(goals))
+                (self._slot_of(row, int(l), g, int(p))
+                 for l, g, p in zip(lanes, goals, upd.pos)),
+                np.int32, len(goals))
             self.h_active[row, lanes] = True
             self._row_set(row)  # a snapshot IS the O(fleet) row resync
             reg.count("solverd.snapshots_applied")
@@ -1982,6 +2127,7 @@ class TenantSlab:
             if v is not None:
                 svc._ref_goal(v[1], +1)
                 goals.append(v[1])
+                svc._sector_hint(v[1], v[0])
         self._ensure_rows_or_defer(goals)
         m = len(final)
         lanes = np.fromiter(final.keys(), np.int32, m)
@@ -1994,7 +2140,7 @@ class TenantSlab:
                 self._unwait(row, lane)
                 continue
             vp[k], vg[k] = v
-            vs[k] = self._slot_of(row, lane, v[1])
+            vs[k] = self._slot_of(row, lane, v[1], v[0])
             va[k] = True
         self.h_pos[row, lanes] = vp
         self.h_goal[row, lanes] = vg

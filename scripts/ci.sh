@@ -36,6 +36,10 @@ python -m compileall -q -f \
     p2p_distributed_tswap_tpu/runtime/solverd.py \
     p2p_distributed_tswap_tpu/ops/field_repair.py \
     p2p_distributed_tswap_tpu/ops/field_fused.py \
+    p2p_distributed_tswap_tpu/ops/sector.py \
+    scripts/sector_fuzz.py \
+    analysis/sector_bench.py \
+    tests/test_sector.py \
     p2p_distributed_tswap_tpu/obs/slo.py \
     p2p_distributed_tswap_tpu/obs/audit.py \
     scripts/audit_smoke.py \
@@ -82,6 +86,13 @@ echo "== field-repair fuzz gate =="
 # repair must stay bit-identical to full recompute (chained, so drift
 # compounds), incl. ROI-overflow fallback + freed-door window growth
 JAX_PLATFORMS=cpu python scripts/field_fuzz.py
+
+echo "== sector planner fuzz gate =="
+# ISSUE 19: seeded random worlds + chained toggles through the
+# hierarchical sector planner — corridor descent valid from every
+# start, suboptimality <= the committed 0.05 bound, and apply_toggles
+# == from-scratch rebuild after every block/unblock batch
+JAX_PLATFORMS=cpu python scripts/sector_fuzz.py
 
 echo "== busd relay micro-smoke =="
 # N-client fanout sanity under the fast relay framing (ISSUE 4): fast +

@@ -289,10 +289,12 @@ def test_aggregator_field_engine_section():
     assert f == {"queue": 7, "max_age": 9,
                  "sweeps": {"fresh_goal": 12, "prime": 5, "repair": 3},
                  "repairs": 2, "repair_fallbacks": 1, "promotions": 4,
-                 "world_seq": 2}
+                 "world_seq": 2, "mirror_evictions": 0}
     text = render(roll)
     assert "FIELD" in text and "sweeps f/p/r=12/5/3" in text \
         and "world_seq=2" in text
+    # zero evictions / no sector routing -> neither suffix rendered
+    assert "mev=" not in text and "sector r/e/f=" not in text
     # a beacon without field counters keeps the section None (no line)
     agg2 = FleetAggregator()
     agg2.ingest({"type": "metrics_beacon", "peer_id": "a", "proc": "agent",
@@ -302,6 +304,38 @@ def test_aggregator_field_engine_section():
     roll2 = agg2.rollup(now_ms=1000)
     assert roll2["peers"]["a"]["field"] is None
     assert "FIELD" not in render(roll2)
+
+
+def test_aggregator_field_mirror_evictions_and_sector():
+    """ISSUE 19: mirror-eviction pressure and the hierarchical sector
+    planner's route/reentry/fallback counters roll up into the ``field``
+    section and render on the FIELD line."""
+    from analysis.fleet_top import render
+
+    agg = FleetAggregator()
+    agg.ingest({
+        "type": "metrics_beacon", "peer_id": "solverd", "proc": "solverd",
+        "pid": 1,
+        "metrics": {
+            "uptime_s": 5.0,
+            "counters": {
+                'solverd.field_sweeps{cause="fresh_goal"}': 2,
+                "solverd.field_repairs": 6,
+                "solverd.field_repair_fallbacks": 5,
+                "solverd.mirror_evictions": 5,
+                "solverd.sector_routes": 40,
+                "solverd.sector_reentries": 7,
+                "solverd.sector_fallbacks": 1,
+            },
+            "gauges": {"solverd.field_queue": 0,
+                       "solverd.field_queue_max_age": 0},
+            "hists": {}}}, now_ms=1000)
+    roll = agg.rollup(now_ms=1000)
+    f = roll["peers"]["solverd"]["field"]
+    assert f["mirror_evictions"] == 5
+    assert f["sector"] == {"routes": 40, "reentries": 7, "fallbacks": 1}
+    text = render(roll)
+    assert "mev=5" in text and "sector r/e/f=40/7/1" in text
 
 
 def test_aggregator_mesh_section_and_line():

@@ -1,0 +1,467 @@
+"""Hierarchical sector-graph planner (ISSUE 19).
+
+Covers the planner in isolation (portal-graph construction, corridor
+exactness, bounded suboptimality, incremental toggle repair ==
+fresh rebuild, host/jit window parity) and wired into the serving layer
+(PlanService corridor rows, re-entry, JG_SECTOR-unset pin, and a slow
+live-churn e2e where every task completes)."""
+
+import numpy as np
+import pytest
+
+from p2p_distributed_tswap_tpu.core.grid import Grid
+from p2p_distributed_tswap_tpu.obs import registry
+from p2p_distributed_tswap_tpu.ops import distance, sector
+
+
+def _bfs_dist(free: np.ndarray, goal: int) -> np.ndarray:
+    """Reference full-grid BFS distance (independent of the planner and
+    of ops/distance.py)."""
+    from collections import deque
+
+    h, w = free.shape
+    d = np.full(h * w, int(sector.INF), np.int64)
+    fr = free.reshape(-1)
+    if fr[goal]:
+        d[goal] = 0
+        dq = deque([goal])
+        while dq:
+            c = dq.popleft()
+            y, x = divmod(c, w)
+            for dy, dx in ((0, 1), (1, 0), (0, -1), (-1, 0)):
+                ny, nx = y + dy, x + dx
+                if 0 <= ny < h and 0 <= nx < w:
+                    nc = ny * w + nx
+                    if fr[nc] and d[nc] > d[c] + 1:
+                        d[nc] = d[c] + 1
+                        dq.append(nc)
+    return d
+
+
+# -- portal graph construction -------------------------------------------
+
+def test_portal_single_run_per_open_border():
+    """A fully open 4x8 border (two 4x4 sectors) is ONE maximal run ->
+    one portal cell per sector, at the run midpoint."""
+    free = np.ones((4, 8), bool)
+    pl = sector.SectorPlanner(free, s=4, use_jit=False)
+    assert pl.sy * pl.sx == 2
+    assert len(pl.portals[0]) == 1 and len(pl.portals[1]) == 1
+
+
+def test_portal_runs_split_by_straddling_wall():
+    """A wall cell on one side of the border splits the run: two
+    portals per sector, and routes detour around the wall."""
+    free = np.ones((4, 8), bool)
+    free[2, 3] = False  # west side of the border, row 2
+    pl = sector.SectorPlanner(free, s=4, use_jit=False)
+    assert len(pl.portals[0]) == 2 and len(pl.portals[1]) == 2
+    plan = pl.plan_goal(0 * 8 + 6, [2 * 8 + 0], keep_dist=True)
+    fd = _bfs_dist(free, 0 * 8 + 6)
+    assert int(plan.dist.reshape(-1)[2 * 8 + 0]) == int(fd[2 * 8 + 0])
+
+
+def test_fully_walled_sector_has_no_portals_and_stays():
+    """A sector sealed off by a full wall column contributes no portals;
+    a start there is unreachable and its corridor code is STAY (matching
+    the full field, which is also STAY on unreachable cells)."""
+    free = np.ones((4, 8), bool)
+    free[:, 3] = False  # seals sector 0 from sector 1 entirely
+    pl = sector.SectorPlanner(free, s=4, use_jit=False)
+    assert len(pl.portals.get(0, ())) == 0
+    assert len(pl.portals.get(1, ())) == 0
+    goal, start = 0 * 8 + 6, 0 * 8 + 0
+    plan = pl.plan_goal(goal, [start])
+    assert plan is not None
+    assert pl.code_at(goal, start) == int(distance.DIR_STAY)
+    # unreachable start must NOT trigger endless re-entry replans
+    assert not pl.needs_reentry(goal, start)
+
+
+def test_non_divisible_grid_edge_sectors_clip():
+    """H, W not multiples of s: edge sectors clip to the grid and plans
+    stay exact end to end."""
+    rng = np.random.default_rng(5)
+    free = rng.random((50, 70)) > 0.15
+    pl = sector.SectorPlanner(free, s=16, use_jit=False)
+    assert (pl.sy, pl.sx) == (4, 5)
+    cells = np.flatnonzero(free.reshape(-1))
+    checked = 0
+    for _ in range(12):
+        st, gl = (int(c) for c in rng.choice(cells, 2, replace=False))
+        fd = _bfs_dist(free, gl)
+        plan = pl.plan_goal(gl, [st], keep_dist=True)
+        if fd[st] >= int(sector.INF):
+            continue
+        assert int(plan.dist.reshape(-1)[st]) >= int(fd[st])
+        checked += 1
+    assert checked >= 6
+
+
+# -- corridor exactness and suboptimality --------------------------------
+
+def test_corridor_spanning_grid_is_bit_identical_to_full_sweep():
+    """With one sector covering the whole grid the corridor IS the grid:
+    the packed row must equal the device full sweep bit for bit
+    (same distances, same first-min tie-break, same packing)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    H = W = 32
+    free = rng.random((H, W)) > 0.15
+    pl = sector.SectorPlanner(free, s=64, use_jit=False)
+    fj = jnp.asarray(free)
+    cells = np.flatnonzero(free.reshape(-1))
+    for t in range(4):
+        st, gl = (int(c) for c in rng.choice(cells, 2, replace=False))
+        plan = pl.plan_goal(gl, [st])
+        dd = distance.distance_fields(fj, jnp.asarray([gl]))
+        dirs = distance.directions_from_distance(dd[0], fj)
+        pk = np.asarray(distance.pack_directions(dirs.reshape(1, -1)))[0]
+        assert np.array_equal(plan.packed, pk), t
+
+
+def test_bounded_suboptimality_and_descent():
+    """Property test on seeded random worlds: corridor distance at the
+    start is within the committed epsilon of the true shortest path, and
+    the packed field strictly descends — the walk reaches the goal in
+    exactly corridor-distance steps without ever reading STAY."""
+    rng = np.random.default_rng(3)
+    H = W = 96
+    free = rng.random((H, W)) > 0.15
+    pl = sector.SectorPlanner(free, s=32, use_jit=False)
+    cells = np.flatnonzero(free.reshape(-1))
+    eps_max = 0.0
+    checked = 0
+    for trial in range(30):
+        st, gl = (int(c) for c in rng.choice(cells, 2, replace=False))
+        plan = pl.plan_goal(gl, [st], keep_dist=True)
+        fd = _bfs_dist(free, gl)
+        if fd[st] >= int(sector.INF):
+            continue
+        cd = int(plan.dist.reshape(-1)[st])
+        assert cd >= int(fd[st]), (trial, cd, int(fd[st]))
+        eps = (cd - int(fd[st])) / max(1, int(fd[st]))
+        eps_max = max(eps_max, eps)
+        c, steps = st, 0
+        while c != gl and steps <= cd:
+            code = pl.code_at(gl, c)
+            assert code != int(distance.DIR_STAY), (trial, c)
+            dx, dy = distance.DIR_DXDY[code]
+            y, x = divmod(c, W)
+            c = (y + dy) * W + (x + dx)
+            assert free.reshape(-1)[c], (trial, c)
+            steps += 1
+        assert c == gl and steps == cd, (trial, steps, cd)
+        checked += 1
+    assert checked >= 20
+    # the committed bound (results/sector_r20.json ships the distribution)
+    assert eps_max <= 0.05, eps_max
+
+
+# -- incremental repair ---------------------------------------------------
+
+def test_toggle_invalidation_matches_fresh_rebuild():
+    """apply_toggles (block AND unblock rounds) leaves the portal graph
+    and intra tables equal to a from-scratch rebuild on the final mask."""
+    rng = np.random.default_rng(7)
+    H = W = 96
+    free = rng.random((H, W)) > 0.15
+    pl = sector.SectorPlanner(free, s=32, use_jit=False)
+    cells = np.flatnonzero(free.reshape(-1))
+    blocked = [int(c) for c in rng.choice(cells, 40, replace=False)]
+    for c in blocked:
+        free.reshape(-1)[c] = False
+    pl.apply_toggles(blocked)
+    assert pl.graph_state() == sector.SectorPlanner(
+        free, s=32, use_jit=False).graph_state()
+    # unblock half of them again (border runs can merge back)
+    back = blocked[::2]
+    for c in back:
+        free.reshape(-1)[c] = True
+    pl.apply_toggles(back)
+    assert pl.graph_state() == sector.SectorPlanner(
+        free, s=32, use_jit=False).graph_state()
+
+
+def test_host_and_jit_window_paths_agree():
+    """The scipy host path and the pow2-padded jitted window path are
+    bit-identical: graph state, plan distances, packed rows — before and
+    after toggles."""
+    rng = np.random.default_rng(11)
+    H = W = 32
+    free = rng.random((H, W)) > 0.2
+    a = sector.SectorPlanner(free, s=16, use_jit=False)
+    b = sector.SectorPlanner(free, s=16, use_jit=True)
+    assert a.graph_state() == b.graph_state()
+    cells = np.flatnonzero(free.reshape(-1))
+    for t in range(2):
+        st, gl = (int(c) for c in rng.choice(cells, 2, replace=False))
+        pa = a.plan_goal(gl, [st], keep_dist=True)
+        pb = b.plan_goal(gl, [st], keep_dist=True)
+        assert np.array_equal(pa.dist, pb.dist), t
+        assert np.array_equal(pa.packed, pb.packed), t
+    tog = [int(c) for c in rng.choice(cells, 8, replace=False)]
+    for c in tog:
+        free.reshape(-1)[c] = False
+    a.apply_toggles(tog)
+    b.apply_toggles(tog)
+    assert a.graph_state() == b.graph_state()
+
+
+# -- serving-layer wiring -------------------------------------------------
+
+def _mk_service(free, monkeypatch, enabled, s=None, **kw):
+    from p2p_distributed_tswap_tpu.runtime.solverd import PlanService
+
+    if enabled:
+        monkeypatch.setenv("JG_SECTOR", "1")
+        if s is not None:
+            monkeypatch.setenv("JG_SECTOR_CELLS", str(s))
+    else:
+        monkeypatch.delenv("JG_SECTOR", raising=False)
+    monkeypatch.setenv("JG_DYNAMIC_WORLD", "1")
+    return PlanService(Grid(free.copy()), capacity_min=4, **kw)
+
+
+def _walk_to_goals(svc, free, fleet, max_steps):
+    """Drive the legacy plan() loop until every agent sits on its goal;
+    asserts wall legality every step.  Returns steps taken."""
+    pos = {pid: p for pid, p, _ in fleet}
+    goal = {pid: g for pid, _, g in fleet}
+    for step in range(max_steps):
+        moves = svc.plan([(pid, pos[pid], goal[pid]) for pid in pos])
+        for pid, np_, ng in moves:
+            assert free.reshape(-1)[np_], (pid, np_)
+            pos[pid], goal[pid] = np_, ng
+        if all(pos[p] == goal[p] for p in pos):
+            return step + 1
+    raise AssertionError(
+        f"stuck: {[(p, pos[p], goal[p]) for p in pos if pos[p] != goal[p]]}")
+
+
+def test_service_serves_corridor_rows_and_reenters(monkeypatch):
+    """JG_SECTOR=1 end to end on the legacy path: fresh goals are
+    corridor-planned (counter), agents reach goals on corridor fields,
+    and a lane dispatched from OUTSIDE an existing corridor triggers
+    exactly one re-entry extension."""
+    rng = np.random.default_rng(11)
+    free = rng.random((36, 36)) > 0.12
+    svc = _mk_service(free, monkeypatch, enabled=True, s=12)
+    assert svc.sector is not None and svc.sector.s == 12
+    reg = registry.get_registry()
+    r0 = reg.counter_value("solverd.sector_routes") or 0
+
+    cells = np.flatnonzero(free.reshape(-1))
+    fd = {}
+    fleet = []
+    while len(fleet) < 3:
+        s0, g0 = (int(c) for c in rng.choice(cells, 2, replace=False))
+        if g0 not in fd:
+            fd[g0] = _bfs_dist(free, g0)
+        if fd[g0][s0] < int(sector.INF):
+            fleet.append((f"a{len(fleet)}", s0, g0))
+    _walk_to_goals(svc, free, fleet, 600)
+    assert (reg.counter_value("solverd.sector_routes") or 0) >= r0 + 3
+
+    # re-entry: find a cell off one goal's corridor and dispatch from it
+    gl = fleet[0][2]
+    outside = [int(c) for c in cells if svc.sector.needs_reentry(gl, int(c))
+               and fd.setdefault(gl, _bfs_dist(free, gl))[int(c)]
+               < int(sector.INF)]
+    if not outside:
+        pytest.skip("corridor already covers every reachable cell")
+    before = reg.counter_value("solverd.sector_reentries") or 0
+    _walk_to_goals(svc, free, [("re", outside[0], gl)], 600)
+    assert (reg.counter_value("solverd.sector_reentries") or 0) == before + 1
+    assert not svc.sector.needs_reentry(gl, outside[0])
+
+
+def test_service_world_toggle_repairs_corridors(monkeypatch):
+    """A world toggle repairs the portal graph incrementally and the
+    staleness machinery re-plans corridors: agents still complete."""
+    rng = np.random.default_rng(4)
+    free = rng.random((36, 36)) > 0.12
+    svc = _mk_service(free, monkeypatch, enabled=True, s=12)
+    cells = np.flatnonzero(free.reshape(-1))
+    s0, g0 = (int(c) for c in rng.choice(cells, 2, replace=False))
+    while _bfs_dist(free, g0)[s0] >= int(sector.INF):
+        s0, g0 = (int(c) for c in rng.choice(cells, 2, replace=False))
+    svc.plan([("w", s0, g0)])
+    graph_before = svc.sector.graph_state()
+    pick = next(int(c) for c in rng.permutation(cells)
+                if int(c) not in (s0, g0))
+    assert svc.apply_world_update([(pick, True)]) == 1
+    free.reshape(-1)[pick] = False
+    del graph_before  # the repaired graph must equal a from-scratch build
+    assert svc.sector.graph_state() == sector.SectorPlanner(
+        svc.free_np, s=12, use_jit=False).graph_state()
+    if _bfs_dist(free, g0)[s0] < int(sector.INF):
+        _walk_to_goals(svc, free, [("w", s0, g0)], 800)
+
+
+def test_sector_unset_is_byte_identical(monkeypatch):
+    """The kill-switch pin, both halves:
+
+    1. JG_SECTOR unset: no planner is constructed, the corridor sweep
+       and re-entry hooks are provably never entered (they raise here),
+       and no hint state accumulates.
+    2. JG_SECTOR=1 with one sector spanning the grid: the corridor IS
+       the grid, so the full wire (moves AND returned goals) must be
+       byte-identical to the unset run — including across a mid-run
+       world toggle."""
+    from p2p_distributed_tswap_tpu.runtime.solverd import PlanService
+
+    rng = np.random.default_rng(9)
+    free = rng.random((32, 32)) > 0.1
+    cells = np.flatnonzero(free.reshape(-1))
+    fleet = [(f"a{i}", int(s), int(g)) for i, (s, g) in enumerate(
+        rng.choice(cells, (6, 2), replace=False))]
+    pick = int(next(c for c in rng.permutation(cells)
+                    if int(c) not in {x for _, s, g in fleet
+                                      for x in (s, g)}))
+
+    def run(svc):
+        out = []
+        cur = list(fleet)
+        for tick in range(20):
+            if tick == 10:
+                svc.apply_world_update([(pick, True)])
+            moves = svc.plan(cur)
+            out.append(moves)
+            cur = [(pid, p, g) for pid, p, g in moves]
+        return out
+
+    off = _mk_service(free, monkeypatch, enabled=False)
+    assert off.sector is None
+
+    def _boom(*a, **k):  # pragma: no cover - must never fire
+        raise AssertionError("sector path entered with JG_SECTOR unset")
+
+    monkeypatch.setattr(off, "_sector_sweep", _boom)
+    monkeypatch.setattr(off, "_sector_reenter", _boom)
+    base = run(off)
+    assert off.sector_hints == {}
+
+    on = _mk_service(free, monkeypatch, enabled=True, s=64)
+    assert on.sector is not None and on.sector.sy * on.sector.sx == 1
+    assert run(on) == base
+
+
+def test_resident_path_records_hints_and_parks(monkeypatch):
+    """Packed resident path with deferred fields: the snapshot banks
+    corridor start hints before lanes park on the STAY row, and the
+    idle-window sweep then plans corridors (not full sweeps) and
+    releases the lanes."""
+    from p2p_distributed_tswap_tpu.runtime import plan_codec as pc
+    from p2p_distributed_tswap_tpu.runtime.solverd import TickRunner
+
+    rng = np.random.default_rng(6)
+    free = rng.random((48, 48)) > 0.1
+    svc = _mk_service(free, monkeypatch, enabled=True, s=16)
+    svc.defer_fields = True
+    runner = TickRunner(svc, Grid(free.copy()))
+    enc = pc.PackedFleetEncoder(snapshot_every=1000)
+    cells = np.flatnonzero(free.reshape(-1))
+    s0, g0 = (int(c) for c in rng.choice(cells, 2, replace=False))
+    while _bfs_dist(free, g0)[s0] >= int(sector.INF) or s0 == g0:
+        s0, g0 = (int(c) for c in rng.choice(cells, 2, replace=False))
+    pkt = enc.encode_tick(1, [("a", s0, g0)])
+    resp = runner.handle({"type": "plan_request", "seq": 1,
+                          "codec": pc.CODEC_NAME, "caps": [pc.CODEC_NAME],
+                          "data": pc.encode_b64(pkt)})
+    # parked: hint banked for the queued corridor plan
+    assert pc.decode_b64(resp["data"]).idx.size == 0
+    assert s0 in svc.sector_hints.get(g0, set())
+    reg = registry.get_registry()
+    r0 = reg.counter_value("solverd.sector_routes") or 0
+    assert svc.process_field_queue() == 1
+    assert (reg.counter_value("solverd.sector_routes") or 0) == r0 + 1
+    assert svc.sector.manages(g0)
+    assert not svc.lane_wait
+
+
+def _safe_to_block(free_flat: np.ndarray, c: int, w: int, h: int) -> bool:
+    """True when blocking ``c`` cannot disconnect the grid: every pair
+    of its free 4-neighbors stays connected within the 3x3 patch around
+    ``c`` (with ``c`` removed), so any path through ``c`` reroutes
+    locally."""
+    cy, cx = divmod(c, w)
+    patch = {}
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            ny, nx = cy + dy, cx + dx
+            if (dy or dx) and 0 <= ny < h and 0 <= nx < w \
+                    and free_flat[ny * w + nx]:
+                patch[(ny, nx)] = None
+    n4 = [(cy + d, cx + e) for d, e in ((0, 1), (1, 0), (0, -1), (-1, 0))
+          if (cy + d, cx + e) in patch]
+    if len(n4) <= 1:
+        return True
+    seen = {n4[0]}
+    frontier = [n4[0]]
+    while frontier:
+        y, x = frontier.pop()
+        for d, e in ((0, 1), (1, 0), (0, -1), (-1, 0)):
+            q = (y + d, x + e)
+            if q in patch and q not in seen:
+                seen.add(q)
+                frontier.append(q)
+    return all(q in seen for q in n4)
+
+
+@pytest.mark.slow
+def test_live_churn_fleet_completes_every_task(monkeypatch):
+    """Slow e2e on a 256^2 world: a fleet keeps drawing fresh random
+    goals (every arrival assigns a new task) while obstacles toggle
+    mid-run; with JG_SECTOR=1 every task completes — completion ratio
+    1.0, the flagship-rung acceptance property."""
+    rng = np.random.default_rng(20)
+    H = W = 256
+    free = rng.random((H, W)) > 0.12
+    svc = _mk_service(free, monkeypatch, enabled=True, s=64)
+    cells = np.flatnonzero(free.reshape(-1))
+    comp = _bfs_dist(free, int(cells[0]))  # reachable component probe
+    live = [int(c) for c in cells if comp[int(c)] < int(sector.INF)]
+    rng.shuffle(live)
+
+    n_agents, tasks_per_agent = 24, 3
+    pos = {f"a{i}": live[i] for i in range(n_agents)}
+    goal = {}
+    remaining = {}
+    done = 0
+    for i in range(n_agents):
+        goal[f"a{i}"] = int(rng.choice(live))
+        remaining[f"a{i}"] = tasks_per_agent
+    total = n_agents * tasks_per_agent
+
+    toggled = []
+    for step in range(6000):
+        moves = svc.plan([(p, pos[p], goal[p]) for p in pos])
+        for pid, np_, ng in moves:
+            assert svc.free_np.reshape(-1)[np_], (pid, np_)
+            pos[pid], goal[pid] = np_, ng
+        arrivals = [p for p in pos if pos[p] == goal[p]]
+        for pid in arrivals:
+            remaining[pid] -= 1
+            done += 1
+            if remaining[pid] > 0:
+                goal[pid] = int(rng.choice(live))
+            else:
+                pos.pop(pid), goal.pop(pid)
+        if not pos:
+            break
+        if step % 40 == 20:
+            # live churn: block a free cell nobody stands on or wants,
+            # staying inside the walkable component's interior
+            occupied = set(pos.values()) | set(goal.values())
+            fl = svc.free_np.reshape(-1)
+            pick = next(c for c in rng.permutation(live)
+                        if int(c) not in occupied and fl[int(c)]
+                        and _safe_to_block(fl, int(c), W, H))
+            svc.apply_world_update([(int(pick), True)])
+            toggled.append(int(pick))
+    assert done == total, (done, total)
+    reg = registry.get_registry()
+    assert (reg.counter_value("solverd.sector_routes") or 0) > 0
+    assert len(toggled) > 0

@@ -243,6 +243,76 @@ def test_lane_torn_down_with_session(tmp_path, lane_dir, monkeypatch):
         log.close()
 
 
+def _busd_counters(port, wait_s=6.0):
+    """One sample of busd's own metrics beacon (proc=busd on
+    mapd.metrics, emitted every ~2 s)."""
+    watch = BusClient(port=port, peer_id="watch", registry=_reg.Registry(),
+                      shm=False)
+    _pump_welcome(watch)
+    watch.subscribe("mapd.metrics")
+    counters = None
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline and counters is None:
+        for f in watch.messages(0.5):
+            data = f.get("data") or {}
+            if data.get("proc") == "busd":
+                counters = (data.get("metrics") or {}).get("counters") or {}
+                break
+    watch.close()
+    return counters
+
+
+def test_shm_spin_budget_defers_park(tmp_path, lane_dir, monkeypatch):
+    """--shm-spin-us lifecycle: with the default budget (0) an idle lane
+    parks right away and bus.shm_parks counts the busy->parked
+    transition; with a large budget the reader keeps spinning and no
+    park is charged while the budget lasts.  Frames are delivered
+    identically in both modes."""
+    monkeypatch.setenv("JG_BUS_SHM", "1")
+
+    def one_run(extra):
+        proc, port, log = _spawn_busd(tmp_path, extra=extra)
+        try:
+            sub = BusClient(port=port, peer_id="s", registry=_reg.Registry(),
+                            shm=False)
+            pub = BusClient(port=port, peer_id="p",
+                            registry=_reg.Registry())
+            _pump_welcome(pub)
+            _pump_welcome(sub)
+            assert "shm1" in pub.hub_caps
+            sub.subscribe("mapd.pos.r0")
+            time.sleep(0.2)
+            beacon = {"type": "pos1",
+                      "data": base64.b64encode(
+                          plan_codec.encode_pos1(1, 2)).decode()}
+            for _ in range(4):
+                pub.publish("mapd.pos.r0", beacon)
+            got = [f for f in sub.messages(2.0)
+                   if f["topic"] == "mapd.pos.r0"]
+            assert len(got) == 4, got
+            counters = _busd_counters(port)
+            assert counters is not None, "no busd metrics beacon"
+            pub.close()
+            sub.close()
+            return counters
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+            log.close()
+
+    # default: park immediately when idle -> at least one busy->parked
+    # transition after the burst (and one park is one count, not one
+    # count per poll iteration)
+    parks = one_run(()).get("bus.shm_parks", 0)
+    assert parks >= 1, parks
+    assert parks < 1000, f"parks counted per-iteration, not per-transition: " \
+                         f"{parks}"
+    # a 30 s budget: the lane never goes unparked->parked inside this
+    # test window, so the counter stays at zero
+    assert one_run(("--shm-spin-us", "30000000")
+                   ).get("bus.shm_parks", 0) == 0
+
+
 # ---------------------------------------------------------------------------
 # kill switch: JG_BUS_SHM unset -> wire byte-identical
 # ---------------------------------------------------------------------------
